@@ -1,0 +1,63 @@
+"""sparkucx_tpu — a TPU-native shuffle framework.
+
+A brand-new framework with the capabilities of SparkUCX (a Spark ``ShuffleManager``
+plugin that replaces TCP shuffle with UCX/RDMA and, in the reference fork, offloads
+block storage/serving to a BlueField DPU over NVKV).  Instead of UCX active messages
+over RDMA, this framework targets TPU interconnects:
+
+* map-side shuffle blocks are staged into TPU **HBM** (the NVKV/DPU-NVMe analogue),
+* the reduce-side batch fetch lowers to a JAX **ragged all_to_all** over the ICI mesh
+  (DCN across slices) instead of UCP get/tag-recv,
+* the registered-bounce-buffer memory pool is rebuilt over pinned host /
+  ``jax.device_put``-backed arrays,
+* executor bootstrap discovers the TPU slice topology and builds the
+  executor<->chip mapping.
+
+Layer map (mirrors SURVEY.md section 1; reference file:line cites in each module):
+
+====  =====================================  =========================================
+L7    shuffle/manager.py                     plugin boundary (ShuffleManager SPI)
+L6    shuffle/manager.py (common base)       transport lifecycle + bootstrap kick-off
+L5    shuffle/reader.py                      reduce-side read path
+L4    shuffle/writer.py, shuffle/resolver.py map-side write path + block resolver
+L3    core/transport.py, transport/*         transport trait + loopback/TPU/peer impls
+L2    parallel/bootstrap.py, parallel/mesh.py control plane, topology discovery
+L1    memory/pool.py                         registered/staged memory pool
+L0    config.py, core/*, utils/*             contracts, config, low-level utils
+====  =====================================  =========================================
+"""
+
+from sparkucx_tpu.version import __version__
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import (
+    Block,
+    BlockId,
+    MemoryBlock,
+    ShuffleBlockId,
+)
+from sparkucx_tpu.core.operation import (
+    OperationCallback,
+    OperationResult,
+    OperationStats,
+    OperationStatus,
+    Request,
+    TransportError,
+)
+from sparkucx_tpu.core.transport import ShuffleTransport
+
+__all__ = [
+    "__version__",
+    "TpuShuffleConf",
+    "Block",
+    "BlockId",
+    "MemoryBlock",
+    "ShuffleBlockId",
+    "OperationCallback",
+    "OperationResult",
+    "OperationStats",
+    "OperationStatus",
+    "Request",
+    "TransportError",
+    "ShuffleTransport",
+]

@@ -1,0 +1,170 @@
+"""Typed configuration for the TPU shuffle framework.
+
+Counterpart of ``UcxShuffleConf`` (UcxShuffleConf.scala:18-93): a typed namespace over
+string key/value config, with the same knobs (renamed ``spark.shuffle.ucx.*`` ->
+``spark.shuffle.tpu.*``) plus the TPU-specific ones.  Hardcoded POC constants the
+reference buried in code are first-class options here (SURVEY.md section 5.6):
+device-space sizing (NvkvHandler.scala:26-29), store port 1338
+(CommonUcxShuffleManager.scala:84-89), 512-byte alignment (NvkvHandler.scala:244-256).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Tuple
+
+_SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kmgt]?i?b?)\s*$", re.IGNORECASE)
+_UNITS = {
+    "": 1, "b": 1,
+    "k": 1 << 10, "kb": 1 << 10, "kib": 1 << 10,
+    "m": 1 << 20, "mb": 1 << 20, "mib": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30, "gib": 1 << 30,
+    "t": 1 << 40, "tb": 1 << 40, "tib": 1 << 40,
+}
+
+
+def parse_size(text) -> int:
+    """Parse '4k' / '1m' / '30MB' style sizes (Spark's byte-string conf format)."""
+    if isinstance(text, (int, float)):
+        return int(text)
+    m = _SIZE_RE.match(str(text))
+    if not m:
+        raise ValueError(f"unparseable size: {text!r}")
+    return int(float(m.group(1)) * _UNITS[m.group(2).lower()])
+
+
+CONF_PREFIX = "spark.shuffle.tpu"
+
+
+@dataclass
+class TpuShuffleConf:
+    """All framework knobs.  Field-by-field provenance:
+
+    ===============================  ==============================================
+    prealloc_buffers                 spark.shuffle.ucx.memory.preAllocateBuffers
+                                     (UcxShuffleConf.scala:21-31) — size->count map
+    min_buffer_size                  ...memory.minBufferSize = 4096 (:33-39)
+    min_allocation_size              ...memory.minAllocationSize = 1 MiB (:41-48)
+    listener_address                 ...listener.sockaddr = "0.0.0.0:0" (:50-56)
+    use_wakeup                       ...useWakeup = true (:58-64)
+    num_io_threads                   ...numIoThreads = 1 (:66-71)
+    num_listener_threads             ...numListenerThreads = 3 (:73-78)
+    num_client_workers               ...numWorkers (defaults to executor cores,
+                                     :80-86)
+    max_blocks_per_request           ...maxBlocksPerRequest = 50 (:88-93)
+    block_alignment                  NVKV 512-byte write alignment
+                                     (NvkvHandler.scala:244-256); default 128 to
+                                     match the TPU lane width
+    staging_capacity_per_executor    NVKV device-space carve-up / 30 MB read buf
+                                     (NvkvHandler.scala:26-29,
+                                     NvkvShuffleMapOutputWriter.scala:94-103)
+    store_port                       DPU daemon port 1338
+                                     (CommonUcxShuffleManager.scala:84-89)
+    ===============================  ==============================================
+    """
+
+    # memory pool (L1)
+    prealloc_buffers: Dict[int, int] = field(default_factory=dict)
+    min_buffer_size: int = 4096
+    min_allocation_size: int = 1 << 20
+    max_host_pool_bytes: int = 1 << 31
+
+    # transport / workers (L3)
+    listener_address: Tuple[str, int] = ("0.0.0.0", 0)
+    use_wakeup: bool = True
+    num_io_threads: int = 1
+    num_listener_threads: int = 3
+    num_client_workers: int = 1
+    max_blocks_per_request: int = 50
+
+    # staged store (HBM; NVKV analogue)
+    block_alignment: int = 128
+    staging_capacity_per_executor: int = 64 << 20
+    store_port: int = 1338
+    serve_from_store: bool = True  # spark.dpuTest.enabled analogue
+    # (compat/spark_3_0/UcxShuffleBlockResolver.scala:86-90, default true)
+
+    # TPU mesh (L2)
+    mesh_axis_name: str = "ex"
+    num_executors: int = 1
+    exchange_dtype: str = "uint8"
+    use_pallas_exchange: bool = False
+
+    # instrumentation
+    collect_stats: bool = True
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_spark_conf(cls, conf: Mapping[str, str]) -> "TpuShuffleConf":
+        """Build from a flat spark-style key/value map.
+
+        Recognized keys: ``spark.shuffle.tpu.memory.preAllocateBuffers`` (a
+        ``size:count,size:count`` list — UcxShuffleConf.scala:21-31 format),
+        ``...memory.minBufferSize``, ``...memory.minAllocationSize``,
+        ``...listener.sockaddr``, ``...useWakeup``, ``...numIoThreads``,
+        ``...numListenerThreads``, ``...numClientWorkers``,
+        ``...maxBlocksPerRequest``, ``...blockAlignment``, ``...stagingCapacity``,
+        ``...storePort``, ``...serveFromStore``, ``...numExecutors``.
+        """
+        p = CONF_PREFIX
+
+        def get(key: str, default=None):
+            return conf.get(f"{p}.{key}", default)
+
+        out = cls()
+        pre = get("memory.preAllocateBuffers")
+        if pre:
+            buffers: Dict[int, int] = {}
+            for item in str(pre).split(","):
+                if not item.strip():
+                    continue
+                size_s, count_s = item.split(":")
+                buffers[parse_size(size_s)] = int(count_s)
+            out.prealloc_buffers = buffers
+        if get("memory.minBufferSize") is not None:
+            out.min_buffer_size = parse_size(get("memory.minBufferSize"))
+        if get("memory.minAllocationSize") is not None:
+            out.min_allocation_size = parse_size(get("memory.minAllocationSize"))
+        sock = get("listener.sockaddr")
+        if sock:
+            host, _, port = str(sock).rpartition(":")
+            out.listener_address = (host or "0.0.0.0", int(port))
+        for name, attr, conv in [
+            ("useWakeup", "use_wakeup", lambda v: str(v).lower() == "true"),
+            ("numIoThreads", "num_io_threads", int),
+            ("numListenerThreads", "num_listener_threads", int),
+            ("numClientWorkers", "num_client_workers", int),
+            ("maxBlocksPerRequest", "max_blocks_per_request", int),
+            ("blockAlignment", "block_alignment", parse_size),
+            ("stagingCapacity", "staging_capacity_per_executor", parse_size),
+            ("storePort", "store_port", int),
+            ("serveFromStore", "serve_from_store", lambda v: str(v).lower() == "true"),
+            ("numExecutors", "num_executors", int),
+            ("meshAxisName", "mesh_axis_name", str),
+            ("usePallasExchange", "use_pallas_exchange", lambda v: str(v).lower() == "true"),
+        ]:
+            v = get(name)
+            if v is not None:
+                setattr(out, attr, conv(v))
+        # spark.executor.cores fallback for worker count (UcxShuffleConf.scala:80-86)
+        if get("numClientWorkers") is None and "spark.executor.cores" in conf:
+            out.num_client_workers = int(conf["spark.executor.cores"])
+        out.validate()
+        return out
+
+    def validate(self) -> None:
+        if self.block_alignment <= 0 or (self.block_alignment & (self.block_alignment - 1)):
+            raise ValueError("block_alignment must be a positive power of two")
+        if self.min_buffer_size <= 0:
+            raise ValueError("min_buffer_size must be positive")
+        if self.max_blocks_per_request <= 0:
+            raise ValueError("max_blocks_per_request must be positive")
+        if self.num_executors <= 0:
+            raise ValueError("num_executors must be positive")
+
+    def replace(self, **kw) -> "TpuShuffleConf":
+        out = dataclasses.replace(self, **kw)
+        out.validate()
+        return out

@@ -1,0 +1,6 @@
+"""Core contracts of the shuffle framework (L0/L3 API layer).
+
+Python counterparts of the reference's pure-API file
+``shuffle/ucx/ShuffleTransport.scala`` (block/transport contracts) and
+``shuffle/ucx/Definitions.scala`` (wire-protocol ids).
+"""

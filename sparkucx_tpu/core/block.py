@@ -1,0 +1,193 @@
+"""Block identity and memory contracts.
+
+Counterpart of the reference's block/memory API surface:
+
+* ``BlockId`` / ``Block`` / ``MemoryBlock`` traits — ShuffleTransport.scala:13-53
+* ``UcxShuffleBlockId`` (shuffleId, mapId, reduceId) — UcxShuffleTransport.scala:55-72
+
+Differences by design (TPU-first):
+
+* ``MemoryBlock`` wraps a ``memoryview``/numpy buffer or a ``jax.Array`` rather than a
+  raw address; zero-copy views are ordinary array slices instead of
+  ``sun.nio.ch.DirectBuffer`` reflection (UnsafeUtils.scala:25-36).
+* ``ShuffleBlockId.serialize`` writes all three ids (12 bytes, little-endian int32).
+  The reference's fork elides shuffleId and writes 8 bytes
+  (UcxShuffleTransport.scala:55-72, "shuffleId commented out") — an acknowledged POC
+  shortcut we do not reproduce.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+import numpy as np
+
+#: Wire format of a ShuffleBlockId: little-endian (shuffle_id, map_id, reduce_id).
+_BLOCK_ID_STRUCT = struct.Struct("<iii")
+
+
+class BlockId(ABC):
+    """Opaque identifier of a shuffle block (ShuffleTransport.scala:22-27)."""
+
+    @abstractmethod
+    def serialized_size(self) -> int:
+        ...
+
+    @abstractmethod
+    def serialize(self) -> bytes:
+        ...
+
+
+@dataclass(frozen=True, order=True)
+class ShuffleBlockId(BlockId):
+    """(shuffleId, mapId, reduceId) triple (UcxShuffleTransport.scala:55-72)."""
+
+    shuffle_id: int
+    map_id: int
+    reduce_id: int
+
+    def serialized_size(self) -> int:
+        return _BLOCK_ID_STRUCT.size
+
+    def serialize(self) -> bytes:
+        return _BLOCK_ID_STRUCT.pack(self.shuffle_id, self.map_id, self.reduce_id)
+
+    @staticmethod
+    def deserialize(data: Union[bytes, memoryview]) -> "ShuffleBlockId":
+        s, m, r = _BLOCK_ID_STRUCT.unpack_from(data)
+        return ShuffleBlockId(s, m, r)
+
+    @property
+    def name(self) -> str:
+        return f"shuffle_{self.shuffle_id}_{self.map_id}_{self.reduce_id}"
+
+
+BufferLike = Union[np.ndarray, memoryview, bytearray]
+
+
+def _as_u8(buf: BufferLike) -> np.ndarray:
+    """View any writable byte-ish buffer as a 1-D uint8 numpy array (zero copy)."""
+    if isinstance(buf, np.ndarray):
+        return buf.reshape(-1).view(np.uint8)
+    return np.frombuffer(buf, dtype=np.uint8)
+
+
+@dataclass
+class MemoryBlock:
+    """A sized region of host or device memory (ShuffleTransport.scala:13-20).
+
+    ``data`` is either a host buffer (numpy uint8 array / memoryview) or a
+    ``jax.Array`` resident in HBM.  ``is_host_memory`` mirrors the reference field
+    that anticipated GPU buffers (ShuffleTransport.scala:16); here device memory is
+    the *normal* case for staged shuffle blocks.
+
+    ``close()`` releases the block back to its owning pool (MemoryPool.scala:22-24);
+    pools install ``_on_close``.
+    """
+
+    data: object  # np.ndarray[uint8] | jax.Array | memoryview
+    size: int
+    is_host_memory: bool = True
+    _on_close: Optional[callable] = field(default=None, repr=False)
+    _closed: bool = field(default=False, repr=False)
+
+    def host_view(self) -> np.ndarray:
+        """1-D uint8 view of the first ``size`` bytes (host memory only)."""
+        if not self.is_host_memory:
+            raise TransportMemoryError("host_view() on device MemoryBlock")
+        return _as_u8(self.data)[: self.size]
+
+    def to_bytes(self) -> bytes:
+        if self.is_host_memory:
+            return self.host_view().tobytes()
+        return np.asarray(self.data).reshape(-1).view(np.uint8)[: self.size].tobytes()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._on_close is not None:
+            self._on_close(self)
+
+
+class TransportMemoryError(RuntimeError):
+    pass
+
+
+class Block(ABC):
+    """Server-side registered block (ShuffleTransport.scala:29-53).
+
+    The reference guards mutation with a ``StampedLock`` (ShuffleTransport.scala:31-34,
+    unused in practice); we keep an honest ``threading.RLock`` used by
+    ``ShuffleTransport.mutate``.
+    """
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+
+    @abstractmethod
+    def get_size(self) -> int:
+        ...
+
+    @abstractmethod
+    def get_block(self, dest: BufferLike) -> None:
+        """Copy block contents into ``dest`` (at least ``get_size()`` bytes)."""
+
+    def get_memory_block(self) -> MemoryBlock:
+        """Materialize into a fresh host MemoryBlock.
+
+        The reference leaves this as an unimplemented stub (``???``,
+        ShuffleTransport.scala:43); here it is a working default.
+        """
+        out = np.empty(self.get_size(), dtype=np.uint8)
+        self.get_block(out)
+        return MemoryBlock(data=out, size=out.size, is_host_memory=True)
+
+
+class BytesBlock(Block):
+    """A block backed by an in-memory byte buffer (test/loopback helper)."""
+
+    def __init__(self, payload: Union[bytes, np.ndarray]) -> None:
+        super().__init__()
+        self._payload = _as_u8(np.asarray(bytearray(payload)) if isinstance(payload, (bytes, bytearray)) else payload)
+
+    def get_size(self) -> int:
+        return int(self._payload.size)
+
+    def get_block(self, dest: BufferLike) -> None:
+        view = _as_u8(dest)
+        view[: self._payload.size] = self._payload
+
+    def set_payload(self, payload: Union[bytes, np.ndarray]) -> None:
+        with self.lock:
+            self._payload = _as_u8(
+                np.asarray(bytearray(payload)) if isinstance(payload, (bytes, bytearray)) else payload
+            )
+
+
+class FileBackedBlock(Block):
+    """Positioned-read block over a file segment.
+
+    Counterpart of ``FileBackedMemoryBlock`` + the resolver's registered blocks that
+    do positioned ``FileChannel.read`` (CommonUcxShuffleBlockResolver.scala:37-61).
+    """
+
+    def __init__(self, path: str, offset: int, length: int) -> None:
+        super().__init__()
+        self.path = path
+        self.offset = int(offset)
+        self.length = int(length)
+
+    def get_size(self) -> int:
+        return self.length
+
+    def get_block(self, dest: BufferLike) -> None:
+        view = _as_u8(dest)
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            data = f.read(self.length)
+        view[: len(data)] = np.frombuffer(data, dtype=np.uint8)

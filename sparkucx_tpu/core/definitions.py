@@ -1,0 +1,95 @@
+"""Wire-protocol message ids and frame formats (control/data plane RPC schema).
+
+Counterpart of ``shuffle/ucx/Definitions.scala:22-29`` — the 5 UCX Active-Message ids
+the reference speaks with its DPU daemon.  Here the same schema is carried over TCP
+sockets (the peer/block-server path and the JVM<->Python plugin shim both speak it):
+
+====================  ==  =======================================================
+InitExecutorReq        0  executor handshake: staged-store context blob
+InitExecutorAck        1  handshake ack: remote store connected
+MapperInfo             2  map-side commit: {numPartitions, mapId, (offset,len)*R}
+FetchBlockReq          3  fetch one (shuffleId, mapId, reduceId) block
+FetchBlockReqAck       4  fetch reply: block bytes (eager) or rndv handle
+====================  ==  =======================================================
+
+Frame format (all little-endian):  ``<u32 am_id> <u64 header_len> <u64 body_len>
+<header bytes> <body bytes>`` — the (header, body) split mirrors jucx's
+``sendAmNonBlocking(header, body)`` (UcxWorkerWrapper.scala:96-126).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass
+from typing import List, Tuple
+
+
+class AmId(enum.IntEnum):
+    """Definitions.scala:22-29."""
+
+    INIT_EXECUTOR_REQ = 0
+    INIT_EXECUTOR_ACK = 1
+    MAPPER_INFO = 2
+    FETCH_BLOCK_REQ = 3
+    FETCH_BLOCK_REQ_ACK = 4
+
+
+_FRAME = struct.Struct("<IQQ")
+FRAME_HEADER_SIZE = _FRAME.size
+
+#: FetchBlockReq header: (shuffleId, mapId, reduceId) — 12 bytes, matching the
+#: reference's header layout (UcxWorkerWrapper.scala:96-126).
+_FETCH_REQ = struct.Struct("<iii")
+
+
+def pack_frame(am_id: AmId, header: bytes = b"", body: bytes = b"") -> bytes:
+    return _FRAME.pack(int(am_id), len(header), len(body)) + header + body
+
+
+def unpack_frame_header(data: bytes) -> Tuple[AmId, int, int]:
+    am_id, hlen, blen = _FRAME.unpack_from(data)
+    return AmId(am_id), hlen, blen
+
+
+def pack_fetch_req(shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
+    return _FETCH_REQ.pack(shuffle_id, map_id, reduce_id)
+
+
+def unpack_fetch_req(data: bytes) -> Tuple[int, int, int]:
+    return _FETCH_REQ.unpack_from(data)
+
+
+@dataclass(frozen=True)
+class MapperInfo:
+    """Map-side commit record.
+
+    Counterpart of the packed commit blob
+    ``{1, numPartitions, mapId, (offset, len) * numPartitions}``
+    (NvkvShuffleMapOutputWriter.scala:116-148).  We add shuffle_id explicitly
+    instead of relying on device-space carve-up by shuffleId.
+    """
+
+    shuffle_id: int
+    map_id: int
+    partitions: Tuple[Tuple[int, int], ...]  # (offset, length) per reduce partition
+
+    _HDR = struct.Struct("<iii")  # shuffle_id, map_id, num_partitions
+    _ENT = struct.Struct("<qq")  # offset, length
+
+    def pack(self) -> bytes:
+        out = bytearray(self._HDR.pack(self.shuffle_id, self.map_id, len(self.partitions)))
+        for off, ln in self.partitions:
+            out += self._ENT.pack(off, ln)
+        return bytes(out)
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "MapperInfo":
+        sid, mid, n = cls._HDR.unpack_from(data)
+        offs: List[Tuple[int, int]] = []
+        pos = cls._HDR.size
+        for _ in range(n):
+            off, ln = cls._ENT.unpack_from(data, pos)
+            offs.append((off, ln))
+            pos += cls._ENT.size
+        return cls(sid, mid, tuple(offs))

@@ -1,0 +1,132 @@
+"""Async-operation contracts: status, stats, results, requests.
+
+Counterpart of ShuffleTransport.scala:56-93 (``OperationStatus``, ``OperationStats``,
+``OperationCallback``, ``OperationResult``, ``Request``) and of the concrete
+``UcxStats``/``UcxRequest`` (UcxShuffleTransport.scala:23-53).
+
+TPU-first twist: the reference's explicit ``progress()`` polling contract
+(ShuffleTransport.scala:158-165) maps onto JAX's async dispatch.  A ``Request`` may
+wrap in-flight ``jax.Array`` results; ``completed()`` polls ``jax.Array.is_ready()``
+without blocking, and ``wait()`` blocks via ``block_until_ready`` — so the reduce-side
+spin loop (UcxShuffleReader.scala:116-134) has a faithful, non-blocking analogue.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from sparkucx_tpu.core.block import MemoryBlock
+
+
+class OperationStatus(enum.Enum):
+    """ShuffleTransport.scala:56-58."""
+
+    SUCCESS = "SUCCESS"
+    CANCELED = "CANCELED"
+    FAILURE = "FAILURE"
+
+
+class TransportError(RuntimeError):
+    """ShuffleTransport.scala:60-62 (``TransportError`` wraps an error message)."""
+
+
+@dataclass
+class OperationStats:
+    """Per-operation timing/size stats (ShuffleTransport.scala:64-69).
+
+    Concrete semantics follow ``UcxStats`` (UcxShuffleTransport.scala:36-53):
+    ``start_time_ns`` at submit, ``end_time_ns`` at callback, ``recv_size`` bytes
+    received, plus the fork's AM-handle timestamps.
+    """
+
+    start_time_ns: int = field(default_factory=time.monotonic_ns)
+    end_time_ns: Optional[int] = None
+    am_handle_start_ns: Optional[int] = None
+    am_handle_end_ns: Optional[int] = None
+    recv_size: int = 0
+
+    def elapsed_ns(self) -> int:
+        end = self.end_time_ns if self.end_time_ns is not None else time.monotonic_ns()
+        return end - self.start_time_ns
+
+    def mark_done(self, recv_size: int = 0) -> None:
+        self.end_time_ns = time.monotonic_ns()
+        self.recv_size += recv_size
+
+
+@dataclass
+class OperationResult:
+    """ShuffleTransport.scala:77-81: status + error + stats + resulting data."""
+
+    status: OperationStatus
+    error: Optional[TransportError] = None
+    stats: Optional[OperationStats] = None
+    data: Optional[MemoryBlock] = None
+
+
+#: ShuffleTransport.scala:71-75 — callback invoked on operation completion.
+OperationCallback = Callable[[OperationResult], None]
+
+
+class Request:
+    """Handle for an async transport operation (ShuffleTransport.scala:83-93).
+
+    ``completed()`` never blocks: it drains any attached futures whose results are
+    ready (``jax.Array.is_ready()``) and returns whether the whole operation
+    finished.  ``progress()`` on the owning transport drives completion.
+    """
+
+    def __init__(self, stats: Optional[OperationStats] = None) -> None:
+        self._done = threading.Event()
+        self._cancelled = False
+        self.stats = stats or OperationStats()
+        self.result: Optional[OperationResult] = None
+        self._poll: Optional[Callable[[], bool]] = None
+
+    def attach_poll(self, poll: Callable[[], bool]) -> None:
+        """Install a non-blocking poll that returns True once the op finished."""
+        self._poll = poll
+
+    def complete(self, result: OperationResult) -> None:
+        self.result = result
+        if result.stats is None:
+            result.stats = self.stats
+        self._done.set()
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        self.complete(OperationResult(OperationStatus.CANCELED, stats=self.stats))
+
+    def is_cancelled(self) -> bool:
+        return self._cancelled
+
+    def completed(self) -> bool:
+        if self._done.is_set():
+            return True
+        if self._poll is not None and self._poll():
+            return self._done.is_set()
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> OperationResult:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # Spin via the poll hook (the reference's while(!done) progress() loop,
+        # UcxShuffleClient.scala:44-46) but yield the GIL between polls.
+        while not self._done.is_set():
+            if self._poll is not None:
+                self._poll()
+            if self._done.wait(timeout=0.0005):
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("Request.wait timed out")
+        assert self.result is not None
+        return self.result
+
+
+def wait_all(requests: Sequence[Request], timeout: Optional[float] = None) -> List[OperationResult]:
+    """Wait for a batch of requests (the benchmark's outstanding-window join,
+    UcxPerfBenchmark.scala:129-151)."""
+    return [r.wait(timeout) for r in requests]
