@@ -1,0 +1,222 @@
+"""Size-bucketed pool of staged/registered buffers (L1).
+
+Counterpart of ``shuffle/ucx/memory/MemoryPool.scala`` (147 LoC):
+
+* sizes rounded up to powers of two with floor ``min_buffer_size``
+  (MemoryPool.scala:34-49),
+* a per-size free stack backed by real allocations (MemoryPool.scala:55-110),
+* small sizes batch-preallocated in ``min_allocation_size`` slabs carved into
+  refcounted views (MemoryPool.scala:64-70,84-95; refcounting cf.
+  UcxRefCountMemoryBlock, UcxWorkerWrapper.scala:36-56),
+* ``preallocate(size, count)`` warm-up from config (MemoryPool.scala:141-147),
+* ``close()`` releases every allocation (MemoryPool.scala:97-109).
+
+TPU-first substitutions: where the reference registers host memory with the RDMA NIC
+(``ucxContext.memoryMap``), we allocate page-aligned host arrays through the native
+arena when built (sparkucx_tpu/native, the jucx/nvkv replacement) or 64-byte-aligned
+numpy arrays otherwise — both are zero-copy convertible to ``jax.Array`` via
+``jax.device_put`` (the HBM staging path).  "Registration" on TPU means keeping the
+buffer alive and aligned so XLA's host-to-device DMA path can use it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import MemoryBlock
+
+
+def round_up_to_next_power_of_two(size: int) -> int:
+    """MemoryPool.scala:34-41."""
+    if size <= 0:
+        return 1
+    return 1 << (size - 1).bit_length()
+
+
+_DEFAULT_ALIGNMENT = 64
+
+
+def _alloc_aligned(nbytes: int, alignment: int = _DEFAULT_ALIGNMENT) -> np.ndarray:
+    """Allocate an aligned uint8 array (over-allocate + offset trick)."""
+    raw = np.empty(nbytes + alignment, dtype=np.uint8)
+    offset = (-raw.ctypes.data) % alignment
+    return raw[offset : offset + nbytes]
+
+
+class _Slab:
+    """One backing allocation, possibly shared by many pooled views.
+
+    The refcount mirrors the shared-refcount slab carve-up of
+    MemoryPool.scala:64-70 — the slab is only releasable when every view is back.
+    """
+
+    __slots__ = ("array", "refcount", "lock")
+
+    def __init__(self, array: np.ndarray) -> None:
+        self.array = array
+        self.refcount = 0
+        self.lock = threading.Lock()
+
+
+class AllocatorStack:
+    """Free-stack of equal-sized buffers for one bucket (MemoryPool.scala:55-110)."""
+
+    def __init__(self, size: int, min_allocation_size: int, alignment: int = _DEFAULT_ALIGNMENT) -> None:
+        self.size = size
+        self.min_allocation_size = min_allocation_size
+        self.alignment = alignment
+        self._free: List[MemoryBlock] = []
+        self._slabs: List[_Slab] = []
+        self._lock = threading.Lock()
+        self.total_allocated = 0  # bytes of backing allocations
+        self.total_requested = 0  # get() count for stats
+
+    def _carve(self, slab: _Slab) -> List[MemoryBlock]:
+        """Split a slab into ``size``-byte refcounted views."""
+        views = []
+        n = slab.array.size // self.size
+        for i in range(n):
+            view = slab.array[i * self.size : (i + 1) * self.size]
+            views.append(self._wrap(view, slab))
+        return views
+
+    def _wrap(self, view: np.ndarray, slab: _Slab) -> MemoryBlock:
+        # refcount counts *checked-out* views: incremented in get(), decremented
+        # on recycle — the slab is releasable iff refcount == 0.
+        def recycle(mb: MemoryBlock, _slab=slab) -> None:
+            # _closed stays True while the block sits in the free stack (re-armed
+            # at checkout in get()) so a stale holder's second close() is a no-op
+            # instead of a double-free.
+            with _slab.lock:
+                _slab.refcount -= 1
+            with self._lock:
+                self._free.append(mb)
+
+        mb = MemoryBlock(data=view, size=self.size, is_host_memory=True, _on_close=recycle)
+        mb._slab = slab
+        return mb
+
+    def _allocate_more(self) -> None:
+        # Small buckets allocate min_allocation_size slabs and carve them up;
+        # buckets >= the slab size allocate exactly one buffer (MemoryPool.scala:64-70).
+        alloc_size = max(self.size, self.min_allocation_size)
+        slab = _Slab(_alloc_aligned(alloc_size, self.alignment))
+        self._slabs.append(slab)
+        self.total_allocated += alloc_size
+        self._free.extend(self._carve(slab))
+
+    def get(self) -> MemoryBlock:
+        # The pop, the refcount increment, and the close re-arm happen under the
+        # stack lock so a concurrent close() can never observe a checked-out
+        # block with refcount 0.
+        with self._lock:
+            self.total_requested += 1
+            if not self._free:
+                self._allocate_more()
+            mb = self._free.pop()
+            with mb._slab.lock:
+                mb._slab.refcount += 1
+            mb._closed = False
+        return mb
+
+    def preallocate(self, count: int) -> None:
+        """MemoryPool.scala:141-147 warm-up."""
+        with self._lock:
+            while len(self._free) < count:
+                self._allocate_more()
+
+    @property
+    def num_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def close(self) -> None:
+        with self._lock:
+            leaked = [s for s in self._slabs if s.refcount > 0]
+            self._free.clear()
+            self._slabs.clear()
+            if leaked:
+                raise ResourceWarning(
+                    f"AllocatorStack(size={self.size}): {len(leaked)} slabs still referenced at close"
+                )
+
+
+class MemoryPool:
+    """Bucketed host bounce-buffer pool (``UcxHostBounceBuffersPool`` analogue).
+
+    ``get(size)`` returns a MemoryBlock whose ``size`` is the *requested* size but
+    whose backing buffer is the power-of-two bucket (the reference returns a sized
+    view the same way, MemoryPool.scala:117-131).  ``put``/``MemoryBlock.close()``
+    recycles.
+    """
+
+    def __init__(self, conf: Optional[TpuShuffleConf] = None) -> None:
+        self.conf = conf or TpuShuffleConf()
+        self._stacks: Dict[int, AllocatorStack] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _bucket(self, size: int) -> int:
+        return max(round_up_to_next_power_of_two(size), self.conf.min_buffer_size)
+
+    def _stack_for(self, bucket: int) -> AllocatorStack:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("MemoryPool is closed")
+            stack = self._stacks.get(bucket)
+            if stack is None:
+                stack = AllocatorStack(bucket, self.conf.min_allocation_size)
+                self._stacks[bucket] = stack
+            return stack
+
+    def get(self, size: int) -> MemoryBlock:
+        if size <= 0:
+            raise ValueError(f"invalid allocation size {size}")
+        mb = self._stack_for(self._bucket(size)).get()
+        mb.size = size  # sized view over the bucket buffer
+        return mb
+
+    def put(self, mb: MemoryBlock) -> None:
+        mb.close()
+
+    def preallocate(self, size: int, count: int) -> None:
+        self._stack_for(self._bucket(size)).preallocate(count)
+
+    def preallocate_from_conf(self) -> None:
+        """spark.shuffle.tpu.memory.preAllocateBuffers warm-up (MemoryPool.scala:141-147)."""
+        for size, count in self.conf.prealloc_buffers.items():
+            self.preallocate(size, count)
+
+    def stats(self) -> Dict[int, Dict[str, int]]:
+        with self._lock:
+            return {
+                b: {
+                    "allocated_bytes": s.total_allocated,
+                    "requests": s.total_requested,
+                    "free": s.num_free,
+                }
+                for b, s in sorted(self._stacks.items())
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            stacks, self._stacks = list(self._stacks.values()), {}
+            self._closed = True
+        errors = []
+        for s in stacks:
+            try:
+                s.close()
+            except ResourceWarning as e:  # collect, keep closing (MemoryPool.scala:97-109)
+                errors.append(e)
+        if errors:
+            raise ResourceWarning("; ".join(str(e) for e in errors))
+
+    def __enter__(self) -> "MemoryPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

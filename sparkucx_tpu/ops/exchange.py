@@ -1,0 +1,282 @@
+"""The shuffle exchange collective — ragged all_to_all over the executor mesh (L3 hot path).
+
+This is the TPU-native replacement for the reference's entire UCX data plane: where
+SparkUCX serves each ``FetchBlockReq`` with a UCP active message carrying the block
+bytes (UcxWorkerWrapper.scala:96-186, handleFetchBlockRequest :397-448), here a
+*superstep* of the shuffle — every reducer fetching from every mapper — lowers to ONE
+collective over the ICI mesh, letting XLA schedule the bidirectional ICI links
+instead of hand-driving RDMA endpoints.
+
+Protocol (mirrors the reference's two-phase metadata+data design):
+
+1. **Size-matrix exchange** — each executor contributes the row of element counts it
+   holds for every peer; an ``all_gather`` makes the full n x n matrix available
+   device-side.  This is the collective analogue of the ``MapperInfo`` commit
+   (NvkvShuffleMapOutputWriter.scala:116-148): senders publish sizes before any
+   data moves, exactly like the DPU daemon learns the offset table before serving.
+2. **Payload exchange** — two lowerings behind one interface:
+
+   * ``impl='ragged'`` (TPU): staging buffers are packed peer-major and *tight*;
+     offsets are computed inside jit from the gathered size matrix (exclusive
+     row-cumsum for send offsets, exclusive column-cumsum for each receiver's
+     landing offsets) and fed to ``jax.lax.ragged_all_to_all`` — zero padding
+     crosses the wire.
+   * ``impl='dense'`` (portable; XLA:CPU has no ragged-all-to-all kernel): the
+     staging buffer is carved into n fixed *slots*; a tiled ``lax.all_to_all``
+     moves the slots, then a static-shaped gather compacts the receive side into
+     the same tight sender-major layout the ragged path produces.  This is also
+     the path the driver's virtual-CPU ``dryrun_multichip`` executes.
+
+   Both lowerings produce bit-identical receive buffers, so every layer above is
+   implementation-agnostic.
+
+Everything is static-shaped: staging capacities are compile-time constants, sizes
+are runtime data.  No data-dependent Python control flow — the same compiled
+exchange serves every superstep of every shuffle.
+
+Payload dtype: buffers are logically bytes, but the exchange runs over a wider lane
+dtype (default int32) when alignment permits — ``block_alignment`` (config.py)
+guarantees every per-peer chunk starts on a lane boundary, the same role NVKV's
+512-byte write alignment plays in the reference (NvkvHandler.scala:244-256).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def exclusive_cumsum(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    return jnp.cumsum(x, axis=axis) - x
+
+
+@dataclass(frozen=True)
+class ExchangeSpec:
+    """Static description of one compiled exchange.
+
+    ``send_capacity`` / ``recv_capacity`` are per-executor staging sizes in
+    *elements* of ``dtype`` (the HBM analogue of the reference's fixed 30 MB NVKV
+    read buffers, NvkvHandler.scala:26-29).  ``impl`` is ``'ragged'`` | ``'dense'``
+    | ``'auto'`` (ragged iff the backend lowers it, i.e. TPU).
+    """
+
+    num_executors: int
+    send_capacity: int
+    recv_capacity: int
+    dtype: np.dtype = np.dtype(np.int32)
+    axis_name: str = "ex"
+    impl: str = "auto"
+
+    @property
+    def elem_bytes(self) -> int:
+        return np.dtype(self.dtype).itemsize
+
+    @property
+    def slot_capacity(self) -> int:
+        """Per-peer slot size (elements) for the dense lowering / slot packing."""
+        return self.send_capacity // self.num_executors
+
+    def resolve_impl(self, platform: Optional[str] = None) -> "ExchangeSpec":
+        if self.impl != "auto":
+            return self
+        if platform is None:
+            platform = jax.devices()[0].platform
+        return replace(self, impl="ragged" if platform == "tpu" else "dense")
+
+    def validate(self) -> None:
+        if self.impl == "dense" and self.send_capacity % self.num_executors:
+            raise ValueError("send_capacity must be divisible by num_executors for dense impl")
+
+
+def _sizes_and_offsets(spec: ExchangeSpec, size_row: jnp.ndarray):
+    """Phase 1 (shared): gather the size matrix, derive send/recv sizes + offsets."""
+    ax = spec.axis_name
+    me = jax.lax.axis_index(ax)
+    sizes = jax.lax.all_gather(size_row, ax, tiled=True)  # (n, n): sizes[i, j] = i -> j
+    send_sizes = sizes[me]                                # (n,)
+    recv_sizes = sizes[:, me]                             # (n,)
+    # Landing offset of MY chunk inside each receiver j's buffer: elements from
+    # senders i < me bound for j — exclusive cumsum down each column, row `me`.
+    output_offsets = exclusive_cumsum(sizes, axis=0)[me]  # (n,)
+    return me, send_sizes, recv_sizes, output_offsets
+
+
+def _exchange_shard_ragged(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.ndarray):
+    """Tight peer-major staging -> ragged_all_to_all -> tight sender-major recv."""
+    _, send_sizes, recv_sizes, output_offsets = _sizes_and_offsets(spec, size_row)
+    input_offsets = exclusive_cumsum(send_sizes)
+    out = jnp.zeros((spec.recv_capacity,), dtype=data.dtype)
+    out = jax.lax.ragged_all_to_all(
+        data,
+        out,
+        input_offsets.astype(jnp.int32),
+        send_sizes.astype(jnp.int32),
+        output_offsets.astype(jnp.int32),
+        recv_sizes.astype(jnp.int32),
+        axis_name=spec.axis_name,
+    )
+    return out, recv_sizes[None, :]
+
+
+def _exchange_shard_dense(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.ndarray):
+    """Slot-packed staging -> tiled all_to_all -> gather-compaction.
+
+    The compaction maps every output position p to its (sender k, within-chunk
+    delta) source inside the received slot grid, producing the same tight
+    sender-major layout as the ragged path — one static gather, MXU/VPU friendly,
+    no data-dependent shapes.
+    """
+    n = spec.num_executors
+    slot = spec.slot_capacity
+    _, _, recv_sizes, _ = _sizes_and_offsets(spec, size_row)
+
+    slots = data.reshape(n, slot)
+    received = jax.lax.all_to_all(slots, spec.axis_name, split_axis=0, concat_axis=0, tiled=True)
+    flat = received.reshape(n * slot)
+
+    starts = exclusive_cumsum(recv_sizes)                       # (n,)
+    cum = jnp.cumsum(recv_sizes)
+    total = cum[-1]
+    pos = jnp.arange(spec.recv_capacity, dtype=jnp.int32)
+    k = jnp.searchsorted(cum, pos, side="right").astype(jnp.int32)
+    k = jnp.clip(k, 0, n - 1)
+    src = k * slot + (pos - starts[k])
+    valid = pos < total
+    out = jnp.where(valid, flat[jnp.clip(src, 0, n * slot - 1)], jnp.zeros((), dtype=data.dtype))
+    return out, recv_sizes[None, :]
+
+
+def build_exchange(mesh: Mesh, spec: ExchangeSpec):
+    """Compile the shuffle-superstep exchange for ``mesh``.
+
+    Returns a jitted ``fn(data, size_matrix) -> (recv, recv_sizes)`` where
+
+    * ``data``: (n * send_capacity,) elements of ``spec.dtype``, sharded over
+      ``axis_name`` — executor i's staging buffer is shard i (packed per
+      ``staging_layout(spec)``);
+    * ``size_matrix``: (n, n) int32, row-sharded — row i is executor i's send sizes
+      in elements (padded to alignment);
+    * ``recv``: (n * recv_capacity,) sharded — shard j holds everything executor j
+      received, tightly packed sender-major;
+    * ``recv_sizes``: (n, n) int32 row-sharded — row j = elements j received from
+      each sender i.
+    """
+    if spec.num_executors != mesh.devices.size:
+        raise ValueError(f"spec.num_executors={spec.num_executors} != mesh size {mesh.devices.size}")
+    spec = spec.resolve_impl(platform=mesh.devices.reshape(-1)[0].platform)
+    spec.validate()
+    ax = spec.axis_name
+    body = _exchange_shard_ragged if spec.impl == "ragged" else _exchange_shard_dense
+
+    shard = jax.shard_map(
+        functools.partial(body, spec),
+        mesh=mesh,
+        in_specs=(P(ax), P(ax, None)),
+        out_specs=(P(ax), P(ax, None)),
+        check_vma=False,
+    )
+    data_sharding = NamedSharding(mesh, P(ax))
+    sizes_sharding = NamedSharding(mesh, P(ax, None))
+    # Donating the staging buffer halves peak HBM when the recv buffer can alias
+    # it (same shape/dtype); XLA can't alias mismatched sizes, so only donate then.
+    donate = (0,) if spec.send_capacity == spec.recv_capacity else ()
+    fn = jax.jit(
+        shard,
+        in_shardings=(data_sharding, sizes_sharding),
+        out_shardings=(data_sharding, sizes_sharding),
+        donate_argnums=donate,
+    )
+    fn.spec = spec
+    return fn
+
+
+# ----------------------------------------------------------------------------
+# Host-side planning helpers (used by the writer/transport and by tests)
+# ----------------------------------------------------------------------------
+
+
+def staging_layout(spec: ExchangeSpec) -> Optional[int]:
+    """Slot size in elements for slot packing, or None for tight packing."""
+    spec = spec.resolve_impl()
+    return None if spec.impl == "ragged" else spec.slot_capacity
+
+
+def pack_chunks_peer_major(
+    chunks: Sequence[bytes],
+    capacity_bytes: int,
+    alignment: int,
+    elem_bytes: int,
+    slot_elems: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack per-peer byte chunks into one staging buffer, peer-major, each chunk
+    padded to ``alignment`` (the writer-side 512-alignment analogue,
+    NvkvHandler.scala:244-256).
+
+    ``slot_elems=None`` packs tight (ragged layout); otherwise chunk j starts at
+    slot boundary ``j * slot_elems`` (dense layout).
+
+    Returns (uint8 buffer of length capacity_bytes, per-peer sizes in *elements*,
+    padding included).
+    """
+    if alignment % elem_bytes:
+        raise ValueError("alignment must be a multiple of the exchange element size")
+    buf = np.zeros(capacity_bytes, dtype=np.uint8)
+    sizes = np.zeros(len(chunks), dtype=np.int32)
+    pos = 0
+    for j, chunk in enumerate(chunks):
+        if slot_elems is not None:
+            pos = j * slot_elems * elem_bytes
+        padded = -(-len(chunk) // alignment) * alignment
+        if slot_elems is not None and padded > slot_elems * elem_bytes:
+            raise ValueError(
+                f"chunk for peer {j} ({padded} B padded) exceeds slot {slot_elems * elem_bytes} B"
+            )
+        if pos + padded > capacity_bytes:
+            raise ValueError(f"staging overflow: need {pos + padded} bytes > capacity {capacity_bytes}")
+        buf[pos : pos + len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        sizes[j] = padded // elem_bytes
+        pos += padded
+    return buf, sizes
+
+
+def unpack_received(
+    recv_shard_bytes: bytes, recv_sizes_row: np.ndarray, elem_bytes: int
+) -> List[bytes]:
+    """Split one receiver's tight sender-major buffer into per-sender chunks
+    (padding still attached; block-level slicing is the resolver's job)."""
+    out: List[bytes] = []
+    pos = 0
+    for sz in recv_sizes_row:
+        nbytes = int(sz) * elem_bytes
+        out.append(recv_shard_bytes[pos : pos + nbytes])
+        pos += nbytes
+    return out
+
+
+def oracle_exchange(per_device_chunks: Sequence[Sequence[bytes]]) -> List[bytes]:
+    """CPU reference: device j receives concat over senders i of chunk[i][j]
+    (each chunk alignment-padded by the sender).
+
+    The correctness oracle for the collective (SURVEY.md section 7: "bytes verified
+    against a CPU shuffle oracle").
+    """
+    n = len(per_device_chunks)
+    return [b"".join(per_device_chunks[i][j] for i in range(n)) for j in range(n)]
+
+
+def make_mesh(num_executors: int, axis_name: str = "ex", devices=None) -> Mesh:
+    """Build the 1-D executor mesh over the first ``num_executors`` devices.
+
+    Topology-aware placement lives in parallel/mesh.py; this is the plain
+    test-friendly constructor.
+    """
+    devs = list(devices if devices is not None else jax.devices())[:num_executors]
+    if len(devs) < num_executors:
+        raise ValueError(f"need {num_executors} devices, have {len(devs)}")
+    return Mesh(np.array(devs), (axis_name,))

@@ -10,12 +10,18 @@ designed for ("standalone testing purpose", ShuffleTransport.scala:124-128).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# A sitecustomize hook may have pinned jax_platforms to a hardware backend at
+# interpreter start (overriding the env var); force the CPU mesh for tests.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

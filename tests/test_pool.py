@@ -1,0 +1,126 @@
+"""Tests for the L1 memory pool (MemoryPool.scala semantics)."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.memory.pool import MemoryPool, round_up_to_next_power_of_two
+
+
+class TestRounding:
+    def test_power_of_two(self):
+        assert round_up_to_next_power_of_two(1) == 1
+        assert round_up_to_next_power_of_two(2) == 2
+        assert round_up_to_next_power_of_two(3) == 4
+        assert round_up_to_next_power_of_two(4096) == 4096
+        assert round_up_to_next_power_of_two(4097) == 8192
+
+
+class TestMemoryPool:
+    def test_get_respects_min_buffer_size(self):
+        with MemoryPool(TpuShuffleConf(min_buffer_size=4096)) as pool:
+            mb = pool.get(10)
+            assert mb.size == 10
+            assert mb.data.size == 4096  # bucket floor (MemoryPool.scala:34-49)
+            mb.close()
+
+    def test_recycling(self):
+        with MemoryPool() as pool:
+            mb = pool.get(100)
+            backing = mb.data
+            mb.host_view()[:] = 7
+            mb.close()
+            mb2 = pool.get(200)  # same 4096 bucket
+            assert mb2.data is backing  # LIFO reuse
+            mb2.close()
+
+    def test_distinct_buffers_when_held(self):
+        with MemoryPool() as pool:
+            a, b = pool.get(50), pool.get(50)
+            assert a.data.ctypes.data != b.data.ctypes.data
+            a.host_view()[:] = 1
+            b.host_view()[:] = 2
+            assert a.host_view()[0] == 1 and b.host_view()[0] == 2
+            a.close(); b.close()
+
+    def test_slab_carving_for_small_buckets(self):
+        conf = TpuShuffleConf(min_buffer_size=4096, min_allocation_size=1 << 20)
+        with MemoryPool(conf) as pool:
+            pool.preallocate(4096, 1)
+            stats = pool.stats()[4096]
+            # one 1 MiB slab carved into 256 x 4 KiB views (MemoryPool.scala:64-70)
+            assert stats["allocated_bytes"] == 1 << 20
+            assert stats["free"] == 256
+
+    def test_large_bucket_allocates_exact(self):
+        conf = TpuShuffleConf(min_allocation_size=1 << 20)
+        with MemoryPool(conf) as pool:
+            mb = pool.get(4 << 20)
+            assert pool.stats()[4 << 20]["allocated_bytes"] == 4 << 20
+            mb.close()
+
+    def test_preallocate_from_conf(self):
+        conf = TpuShuffleConf(prealloc_buffers={8192: 4, 1 << 16: 2})
+        with MemoryPool(conf) as pool:
+            pool.preallocate_from_conf()
+            assert pool.stats()[8192]["free"] >= 4
+            assert pool.stats()[1 << 16]["free"] >= 2
+
+    def test_alignment(self):
+        with MemoryPool() as pool:
+            for size in (100, 5000, 1 << 20):
+                mb = pool.get(size)
+                assert mb.data.ctypes.data % 64 == 0
+                mb.close()
+
+    def test_close_raises_on_leak(self):
+        pool = MemoryPool()
+        leaked = pool.get(128)
+        with pytest.raises(ResourceWarning):
+            pool.close()
+        leaked.close()
+
+    def test_get_after_close_fails(self):
+        pool = MemoryPool()
+        pool.close()
+        with pytest.raises(RuntimeError):
+            pool.get(16)
+
+    def test_double_close_is_noop(self):
+        # A stale holder's second close() must not double-free (no aliasing).
+        with MemoryPool() as pool:
+            mb = pool.get(100)
+            mb.close()
+            mb.close()
+            a, b = pool.get(100), pool.get(100)
+            assert a.data.ctypes.data != b.data.ctypes.data
+            a.close(); b.close()
+
+    def test_invalid_size(self):
+        with MemoryPool() as pool:
+            with pytest.raises(ValueError):
+                pool.get(0)
+
+    def test_concurrent_get_put(self):
+        import threading
+
+        with MemoryPool() as pool:
+            errors = []
+
+            def worker():
+                try:
+                    for _ in range(200):
+                        mb = pool.get(1000)
+                        view = mb.host_view()
+                        view[:] = 5
+                        assert view[-1] == 5
+                        mb.close()
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
