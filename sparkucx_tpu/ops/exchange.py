@@ -72,6 +72,12 @@ class ExchangeSpec:
     dtype: np.dtype = np.dtype(np.int32)
     axis_name: str = "ex"
     impl: str = "auto"
+    #: 'tight' — peer chunks packed back-to-back (cumsum offsets; ragged only);
+    #: 'slot'  — peer chunk j starts at region boundary j*slot_capacity (both
+    #: impls).  'slot' is what the HBM store produces: map writers append into
+    #: per-peer regions, so no repacking happens before the collective — the
+    #: ragged lowering simply sends each region's used prefix.
+    layout: str = "slot"
 
     @property
     def elem_bytes(self) -> int:
@@ -90,8 +96,12 @@ class ExchangeSpec:
         return replace(self, impl="ragged" if platform == "tpu" else "dense")
 
     def validate(self) -> None:
-        if self.impl == "dense" and self.send_capacity % self.num_executors:
-            raise ValueError("send_capacity must be divisible by num_executors for dense impl")
+        if self.layout not in ("tight", "slot"):
+            raise ValueError(f"unknown layout {self.layout!r}")
+        if self.impl == "dense" and self.layout != "slot":
+            raise ValueError("dense impl requires slot layout")
+        if self.layout == "slot" and self.send_capacity % self.num_executors:
+            raise ValueError("send_capacity must be divisible by num_executors for slot layout")
 
 
 def _sizes_and_offsets(spec: ExchangeSpec, size_row: jnp.ndarray):
@@ -108,9 +118,16 @@ def _sizes_and_offsets(spec: ExchangeSpec, size_row: jnp.ndarray):
 
 
 def _exchange_shard_ragged(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.ndarray):
-    """Tight peer-major staging -> ragged_all_to_all -> tight sender-major recv."""
+    """Peer-major staging -> ragged_all_to_all -> tight sender-major recv.
+
+    With slot layout only each region's used prefix crosses the wire — the
+    padding between regions stays home, unlike the dense lowering."""
     _, send_sizes, recv_sizes, output_offsets = _sizes_and_offsets(spec, size_row)
-    input_offsets = exclusive_cumsum(send_sizes)
+    if spec.layout == "slot":
+        n = spec.num_executors
+        input_offsets = jnp.arange(n, dtype=jnp.int32) * spec.slot_capacity
+    else:
+        input_offsets = exclusive_cumsum(send_sizes)
     out = jnp.zeros((spec.recv_capacity,), dtype=data.dtype)
     out = jax.lax.ragged_all_to_all(
         data,
@@ -203,8 +220,7 @@ def build_exchange(mesh: Mesh, spec: ExchangeSpec):
 
 def staging_layout(spec: ExchangeSpec) -> Optional[int]:
     """Slot size in elements for slot packing, or None for tight packing."""
-    spec = spec.resolve_impl()
-    return None if spec.impl == "ragged" else spec.slot_capacity
+    return None if spec.layout == "tight" else spec.slot_capacity
 
 
 def pack_chunks_peer_major(

@@ -182,5 +182,18 @@ class TestSpec:
             build_exchange(mesh, _spec(send_cap=1001, impl="dense"))
 
     def test_staging_layout(self):
-        assert staging_layout(_spec(impl="ragged")) is None
+        ragged_tight = ExchangeSpec(
+            num_executors=N, send_capacity=1024, recv_capacity=4096, impl="ragged", layout="tight"
+        )
+        assert staging_layout(ragged_tight) is None
         assert staging_layout(_spec(impl="dense")) == 1024 // N
+
+    def test_dense_requires_slot_layout(self, mesh):
+        with pytest.raises(ValueError, match="slot layout"):
+            build_exchange(
+                mesh,
+                ExchangeSpec(
+                    num_executors=N, send_capacity=1024, recv_capacity=1024,
+                    impl="dense", layout="tight",
+                ),
+            )

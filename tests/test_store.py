@@ -1,0 +1,217 @@
+"""Tests for the HBM block store (NvkvHandler/NvkvShuffleMapOutputWriter semantics)."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.definitions import MapperInfo
+from sparkucx_tpu.core.operation import TransportError
+from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
+
+ALIGN = 128
+
+
+@pytest.fixture
+def store():
+    s = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=1 << 20, block_alignment=ALIGN))
+    yield s
+    s.close()
+
+
+class TestPeerRanges:
+    def test_balanced(self):
+        assert default_peer_ranges(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+
+    def test_remainder(self):
+        assert default_peer_ranges(10, 4) == [(0, 3), (3, 6), (6, 8), (8, 10)]
+
+    def test_fewer_reducers_than_peers(self):
+        ranges = default_peer_ranges(2, 4)
+        assert ranges == [(0, 1), (1, 2), (2, 2), (2, 2)]
+
+
+class TestWriteReadback:
+    def test_write_then_read(self, store):
+        store.create_shuffle(0, num_mappers=2, num_reducers=4, peer_ranges=default_peer_ranges(4, 2))
+        w = store.map_writer(0, 0)
+        w.write_partition(0, b"r0-data")
+        w.write_partition(2, b"r2-data-xyz")
+        w.commit()
+        assert store.read_block(0, 0, 0) == b"r0-data"
+        assert store.read_block(0, 0, 2) == b"r2-data-xyz"
+        assert store.block_length(0, 0, 0) == 7
+        assert store.block_length(0, 0, 1) == 0  # never written
+
+    def test_streaming_writes(self, store):
+        store.create_shuffle(1, 1, 1)
+        w = store.map_writer(1, 0)
+        w.open_partition(0)
+        for i in range(10):
+            w.write(bytes([i]) * 100)
+        w.close_partition()
+        expected = b"".join(bytes([i]) * 100 for i in range(10))
+        assert store.read_block(1, 0, 0) == expected
+
+    def test_sequential_partition_protocol(self, store):
+        # NvkvShuffleMapOutputWriter.scala:108 — increasing reduce order enforced.
+        store.create_shuffle(2, 1, 4)
+        w = store.map_writer(2, 0)
+        w.write_partition(2, b"x")
+        with pytest.raises(TransportError, match="increasing reduce order"):
+            w.open_partition(1)
+        with pytest.raises(TransportError, match="no open partition"):
+            w.write(b"y")
+
+    def test_double_open_rejected(self, store):
+        store.create_shuffle(3, 1, 2)
+        w = store.map_writer(3, 0)
+        w.open_partition(0)
+        with pytest.raises(TransportError, match="still open"):
+            w.open_partition(1)
+
+    def test_region_overflow(self):
+        s = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=4096, block_alignment=ALIGN))
+        s.create_shuffle(0, 1, 2, peer_ranges=default_peer_ranges(2, 2))
+        w = s.map_writer(0, 0)
+        w.open_partition(0)
+        with pytest.raises(TransportError, match="region overflow"):
+            w.write(b"x" * 4096)
+
+    def test_empty_partition(self, store):
+        store.create_shuffle(4, 1, 2)
+        w = store.map_writer(4, 0)
+        w.write_partition(0, b"")
+        info = w.commit()
+        assert info.partitions[0] == (0, 0)
+        assert store.read_block(4, 0, 0) == b""
+
+
+class TestAlignmentAndLayout:
+    def test_blocks_aligned(self, store):
+        store.create_shuffle(0, 2, 2, peer_ranges=default_peer_ranges(2, 1))
+        w0 = store.map_writer(0, 0)
+        w0.write_partition(0, b"a" * 100)  # pads to 128
+        w0.write_partition(1, b"b" * 200)  # pads to 256
+        w1 = store.map_writer(0, 1)
+        w1.write_partition(0, b"c" * 50)
+        assert store.block_offset(0, 0, 0) == 0
+        assert store.block_offset(0, 0, 1) == 128
+        assert store.block_offset(0, 1, 0) == 128 + 256
+        stats = store.stats(0)
+        assert stats["bytes_staged"] == 350
+        assert stats["bytes_padded"] == 128 + 256 + 128
+
+    def test_peer_major_regions(self, store):
+        # Partitions land in their owning peer's region: this IS the exchange's
+        # slot layout — no repacking before the collective.
+        store.create_shuffle(0, 1, 4, peer_ranges=default_peer_ranges(4, 2))
+        w = store.map_writer(0, 0)
+        w.write_partition(0, b"p0")   # peer 0 region
+        w.write_partition(2, b"p2")   # peer 1 region
+        w.write_partition(3, b"p3")   # peer 1 region
+        st = store._state(0)
+        assert store.block_offset(0, 0, 0) == 0
+        assert store.block_offset(0, 0, 2) == st.region_size
+        assert store.block_offset(0, 0, 3) == st.region_size + ALIGN
+        assert st.region_used.tolist() == [ALIGN, 2 * ALIGN]
+
+    def test_interleaved_mappers_append_within_region(self, store):
+        store.create_shuffle(0, 2, 2, peer_ranges=default_peer_ranges(2, 2))
+        w0, w1 = store.map_writer(0, 0), store.map_writer(0, 1)
+        w0.write_partition(0, b"m0r0")
+        w1.write_partition(0, b"m1r0")
+        w0.write_partition(1, b"m0r1")
+        assert store.block_offset(0, 0, 0) == 0
+        assert store.block_offset(0, 1, 0) == ALIGN
+        assert store.read_block(0, 1, 0) == b"m1r0"
+
+
+class TestCommitAndSeal:
+    def test_mapper_info_roundtrip(self, store):
+        store.create_shuffle(0, 1, 3)
+        w = store.map_writer(0, 0)
+        w.write_partition(0, b"abc")
+        w.write_partition(2, b"defgh")
+        info = w.commit()
+        assert info == MapperInfo.unpack(info.pack())
+        assert info.partitions[0] == (0, 3)
+        assert info.partitions[1] == (0, 0)
+        assert info.partitions[2] == (128, 5)
+
+    def test_commit_with_open_partition_rejected(self, store):
+        store.create_shuffle(0, 1, 2)
+        w = store.map_writer(0, 0)
+        w.open_partition(0)
+        with pytest.raises(TransportError, match="open partition"):
+            w.commit()
+
+    def test_apply_mapper_info(self, store):
+        # Peer-process metadata install (the DPU-daemon side of AM id 2).
+        store.create_shuffle(0, 2, 2)
+        store.apply_mapper_info(MapperInfo(0, 1, ((0, 100), (256, 50))))
+        assert store.block_length(0, 1, 0) == 100
+        assert store.block_offset(0, 1, 1) == 256
+        assert 1 in store.stats(0)["committed_maps"]
+
+    def test_seal_returns_slot_payload_and_sizes(self, store):
+        store.create_shuffle(0, 1, 4, peer_ranges=default_peer_ranges(4, 2))
+        w = store.map_writer(0, 0)
+        w.write_partition(0, b"A" * 100)
+        w.write_partition(2, b"B" * 300)
+        payload, sizes = store.seal(0)
+        st = store._state(0)
+        assert payload.dtype == np.int32
+        assert sizes.tolist() == [128 // 4, 384 // 4]
+        raw = np.asarray(payload).view(np.uint8)
+        assert raw[:100].tobytes() == b"A" * 100
+        assert raw[st.region_size : st.region_size + 300].tobytes() == b"B" * 300
+
+    def test_read_after_seal(self, store):
+        store.create_shuffle(0, 1, 1)
+        w = store.map_writer(0, 0)
+        w.write_partition(0, b"persist-me")
+        store.seal(0)
+        assert store.read_block(0, 0, 0) == b"persist-me"
+
+    def test_no_writes_after_seal(self, store):
+        store.create_shuffle(0, 1, 1)
+        store.seal(0)
+        with pytest.raises(TransportError, match="sealed"):
+            store.map_writer(0, 0)
+
+    def test_double_seal_rejected(self, store):
+        store.create_shuffle(0, 1, 1)
+        store.seal(0)
+        with pytest.raises(TransportError, match="sealed"):
+            store.seal(0)
+
+
+class TestLifecycle:
+    def test_duplicate_shuffle_rejected(self, store):
+        store.create_shuffle(0, 1, 1)
+        with pytest.raises(TransportError, match="already exists"):
+            store.create_shuffle(0, 1, 1)
+
+    def test_remove_shuffle(self, store):
+        store.create_shuffle(0, 1, 1)
+        store.remove_shuffle(0)
+        with pytest.raises(TransportError, match="unknown shuffle"):
+            store.read_block(0, 0, 0)
+
+    def test_unknown_block(self, store):
+        store.create_shuffle(0, 1, 1)
+        with pytest.raises(TransportError, match="no block"):
+            store.read_block(0, 0, 0)
+
+    def test_bad_ids(self, store):
+        store.create_shuffle(0, 2, 2)
+        with pytest.raises(ValueError):
+            store.map_writer(0, 5)
+        w = store.map_writer(0, 0)
+        with pytest.raises(ValueError):
+            w.open_partition(7)
+
+    def test_capacity_too_small(self):
+        s = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=64))
+        with pytest.raises(ValueError, match="too small"):
+            s.create_shuffle(0, 1, 8, peer_ranges=default_peer_ranges(8, 8))

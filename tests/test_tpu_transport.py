@@ -1,0 +1,177 @@
+"""End-to-end shuffle through TpuShuffleCluster on the virtual 8-executor mesh.
+
+This is the minimum end-to-end slice of SURVEY.md section 7: M mappers write
+partition blocks into per-executor staging, ONE collective superstep moves
+everything, R reducers fetch and verify against a CPU shuffle oracle — the
+GroupByTest-equivalent without Spark.
+"""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus, TransportError
+from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+N_EXEC = 8
+
+
+def _buf(n):
+    return MemoryBlock(np.zeros(n, dtype=np.uint8), size=n)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    conf = TpuShuffleConf(
+        staging_capacity_per_executor=1 << 20, block_alignment=128, num_executors=N_EXEC
+    )
+    return TpuShuffleCluster(conf, num_executors=N_EXEC)
+
+
+def _run_shuffle(cluster, shuffle_id, num_mappers, num_reducers, rng, max_block=2000):
+    """Write random blocks, commit, exchange. Returns the oracle dict."""
+    meta = cluster.create_shuffle(shuffle_id, num_mappers, num_reducers)
+    oracle = {}
+    for m in range(num_mappers):
+        owner = meta.map_owner[m]
+        t = cluster.transport(owner)
+        w = t.store.map_writer(shuffle_id, m)
+        for r in range(num_reducers):
+            size = int(rng.integers(0, max_block))
+            payload = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+            oracle[(m, r)] = payload
+            w.write_partition(r, payload)
+        t.commit_block(w.commit().pack())
+    cluster.run_exchange(shuffle_id)
+    return meta, oracle
+
+
+class TestEndToEndShuffle:
+    def test_full_shuffle_vs_oracle(self, cluster, rng):
+        M, R = 16, 24
+        meta, oracle = _run_shuffle(cluster, 0, M, R, rng)
+        # every reducer fetches every one of its blocks on its owning executor
+        for r in range(R):
+            consumer = meta.owner_of_reduce(r)
+            t = cluster.transport(consumer)
+            bids = [ShuffleBlockId(0, m, r) for m in range(M)]
+            bufs = [_buf(4096) for _ in range(M)]
+            reqs = t.fetch_blocks_by_block_ids(consumer, bids, bufs, [None] * M)
+            while not all(q.completed() for q in reqs):
+                t.progress()
+            for m in range(M):
+                res = reqs[m].wait(1)
+                assert res.status == OperationStatus.SUCCESS, str(res.error)
+                assert bufs[m].host_view()[: bufs[m].size].tobytes() == oracle[(m, r)]
+
+    def test_skewed_and_empty_partitions(self, cluster, rng):
+        M, R = 4, 8
+        meta = cluster.create_shuffle(1, M, R)
+        # all data goes to reducer 5; everything else empty
+        big = rng.integers(0, 256, size=30_000, dtype=np.uint8).tobytes()
+        for m in range(M):
+            t = cluster.transport(meta.map_owner[m])
+            w = t.store.map_writer(1, m)
+            for r in range(R):
+                w.write_partition(r, big if r == 5 else b"")
+            t.commit_block(w.commit().pack())
+        cluster.run_exchange(1)
+        consumer = meta.owner_of_reduce(5)
+        t = cluster.transport(consumer)
+        bufs = [_buf(32768) for _ in range(M)]
+        reqs = t.fetch_blocks_by_block_ids(
+            consumer, [ShuffleBlockId(1, m, 5) for m in range(M)], bufs, [None] * M
+        )
+        for m in range(M):
+            assert reqs[m].wait(1).status == OperationStatus.SUCCESS
+            assert bufs[m].host_view()[: bufs[m].size].tobytes() == big
+        # empty block fetch succeeds with zero size
+        consumer0 = meta.owner_of_reduce(0)
+        t0 = cluster.transport(consumer0)
+        [req] = t0.fetch_blocks_by_block_ids(consumer0, [ShuffleBlockId(1, 0, 0)], [_buf(64)], [None])
+        res = req.wait(1)
+        assert res.status == OperationStatus.SUCCESS
+        assert res.stats.recv_size == 0
+
+    def test_fetch_wrong_owner_fails(self, cluster, rng):
+        meta, _ = _run_shuffle(cluster, 2, 4, 8, rng, max_block=100)
+        r = 0
+        wrong = (meta.owner_of_reduce(r) + 1) % N_EXEC
+        t = cluster.transport(wrong)
+        [req] = t.fetch_blocks_by_block_ids(wrong, [ShuffleBlockId(2, 0, r)], [_buf(256)], [None])
+        res = req.wait(1)
+        assert res.status == OperationStatus.FAILURE
+        assert "owned by" in str(res.error)
+
+    def test_exchange_requires_all_commits(self, cluster, rng):
+        meta = cluster.create_shuffle(3, 4, 4)
+        t = cluster.transport(meta.map_owner[0])
+        w = t.store.map_writer(3, 0)
+        w.write_partition(0, b"x")
+        t.commit_block(w.commit().pack())
+        with pytest.raises(TransportError, match="before all maps committed"):
+            cluster.run_exchange(3)
+
+    def test_double_exchange_rejected(self, cluster, rng):
+        _run_shuffle(cluster, 4, 2, 2, rng, max_block=50)
+        with pytest.raises(TransportError, match="already exchanged"):
+            cluster.run_exchange(4)
+
+    def test_fetch_before_exchange_fails(self, cluster, rng):
+        meta = cluster.create_shuffle(5, 1, 1)
+        t = cluster.transport(meta.owner_of_reduce(0))
+        [req] = t.fetch_blocks_by_block_ids(0, [ShuffleBlockId(5, 0, 0)], [_buf(8)], [None])
+        assert req.wait(1).status == OperationStatus.FAILURE
+
+
+class TestPullFallback:
+    def test_fetch_block_from_peer_store(self, cluster, rng):
+        # The straggler path: read a peer's staged block directly, pre-exchange.
+        meta = cluster.create_shuffle(6, 2, 2)
+        owner = meta.map_owner[1]
+        t_owner = cluster.transport(owner)
+        w = t_owner.store.map_writer(6, 1)
+        w.write_partition(0, b"straggler-block")
+        w.write_partition(1, b"")
+        t_owner.commit_block(w.commit().pack())
+
+        fetcher = cluster.transport((owner + 1) % N_EXEC)
+        out = _buf(64)
+        req = fetcher.fetch_block(owner, 6, 1, 0, out)
+        while not req.completed():
+            fetcher.progress()
+        assert req.wait(1).status == OperationStatus.SUCCESS
+        assert out.host_view()[: out.size].tobytes() == b"straggler-block"
+
+    def test_fetch_block_missing(self, cluster):
+        cluster.create_shuffle(7, 1, 1)
+        fetcher = cluster.transport(0)
+        req = fetcher.fetch_block(0, 7, 0, 0, _buf(8))
+        while not req.completed():
+            fetcher.progress()
+        assert req.wait(1).status == OperationStatus.FAILURE
+
+
+class TestStats:
+    def test_fetch_stats_recv_size(self, cluster, rng):
+        meta, oracle = _run_shuffle(cluster, 8, 2, 2, rng, max_block=500)
+        r = 0
+        consumer = meta.owner_of_reduce(r)
+        t = cluster.transport(consumer)
+        [req] = t.fetch_blocks_by_block_ids(consumer, [ShuffleBlockId(8, 1, r)], [_buf(1024)], [None])
+        res = req.wait(1)
+        assert res.stats.recv_size == len(oracle[(1, r)])
+        assert res.stats.elapsed_ns() > 0
+
+
+class TestRegistry:
+    def test_upstream_registry_parity(self, cluster):
+        from sparkucx_tpu.core.block import BytesBlock
+
+        t = cluster.transport(0)
+        bid = ShuffleBlockId(99, 0, 0)
+        t.register(bid, BytesBlock(b"reg"))
+        assert t.registered_block(bid) is not None
+        t.unregister_shuffle(99)
+        assert t.registered_block(bid) is None
