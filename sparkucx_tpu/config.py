@@ -79,8 +79,10 @@ class TpuShuffleConf:
     num_client_workers: int = 1
     max_blocks_per_request: int = 50
 
-    # staged store (HBM; NVKV analogue)
-    block_alignment: int = 128
+    # staged store (HBM; NVKV analogue).  512 = one exchange row (128 int32
+    # lanes, the native XLA:TPU tile width) and exactly NVKV's sector alignment
+    # (NvkvHandler.scala:244-256).
+    block_alignment: int = 512
     staging_capacity_per_executor: int = 64 << 20
     store_port: int = 1338
     serve_from_store: bool = True  # spark.dpuTest.enabled analogue
@@ -157,6 +159,8 @@ class TpuShuffleConf:
     def validate(self) -> None:
         if self.block_alignment <= 0 or (self.block_alignment & (self.block_alignment - 1)):
             raise ValueError("block_alignment must be a positive power of two")
+        if self.block_alignment % 4:
+            raise ValueError("block_alignment must be a multiple of 4 (int32 exchange lanes)")
         if self.min_buffer_size <= 0:
             raise ValueError("min_buffer_size must be positive")
         if self.max_blocks_per_request <= 0:

@@ -7,25 +7,32 @@ bytes (UcxWorkerWrapper.scala:96-186, handleFetchBlockRequest :397-448), here a
 collective over the ICI mesh, letting XLA schedule the bidirectional ICI links
 instead of hand-driving RDMA endpoints.
 
+Data unit: the exchange moves **rows** of ``lane`` int32 lanes (default 128 -> one
+512-byte row).  Two reasons: (a) a trailing 128-lane dimension is the shape XLA:TPU
+tiles natively — a 1-D byte/int stream gets pathologically padded to (x,1,128)
+tiles by the ragged-all-to-all lowering (observed 128x memory blowup); (b) 512 is
+exactly the sector alignment the reference's NVKV store enforces on every block
+write (NvkvHandler.scala:244-256), so block offsets are row-aligned by
+construction.
+
 Protocol (mirrors the reference's two-phase metadata+data design):
 
-1. **Size-matrix exchange** — each executor contributes the row of element counts it
-   holds for every peer; an ``all_gather`` makes the full n x n matrix available
+1. **Size-matrix exchange** — each executor contributes the row-counts it holds
+   for every peer; an ``all_gather`` makes the full n x n matrix available
    device-side.  This is the collective analogue of the ``MapperInfo`` commit
    (NvkvShuffleMapOutputWriter.scala:116-148): senders publish sizes before any
    data moves, exactly like the DPU daemon learns the offset table before serving.
 2. **Payload exchange** — two lowerings behind one interface:
 
-   * ``impl='ragged'`` (TPU): staging buffers are packed peer-major and *tight*;
-     offsets are computed inside jit from the gathered size matrix (exclusive
-     row-cumsum for send offsets, exclusive column-cumsum for each receiver's
-     landing offsets) and fed to ``jax.lax.ragged_all_to_all`` — zero padding
-     crosses the wire.
-   * ``impl='dense'`` (portable; XLA:CPU has no ragged-all-to-all kernel): the
-     staging buffer is carved into n fixed *slots*; a tiled ``lax.all_to_all``
-     moves the slots, then a static-shaped gather compacts the receive side into
-     the same tight sender-major layout the ragged path produces.  This is also
-     the path the driver's virtual-CPU ``dryrun_multichip`` executes.
+   * ``impl='ragged'`` (TPU): offsets are computed inside jit from the gathered
+     size matrix (slot starts for send offsets, exclusive column-cumsum for each
+     receiver's landing offsets) and fed to ``jax.lax.ragged_all_to_all`` — only
+     each region's used prefix crosses the wire.
+   * ``impl='dense'`` (portable; XLA:CPU has no ragged-all-to-all kernel): a
+     tiled ``lax.all_to_all`` moves whole fixed-size slots, then a static-shaped
+     row gather compacts the receive side into the same tight sender-major layout
+     the ragged path produces.  This is also the path the driver's virtual-CPU
+     ``dryrun_multichip`` executes.
 
    Both lowerings produce bit-identical receive buffers, so every layer above is
    implementation-agnostic.
@@ -33,11 +40,6 @@ Protocol (mirrors the reference's two-phase metadata+data design):
 Everything is static-shaped: staging capacities are compile-time constants, sizes
 are runtime data.  No data-dependent Python control flow — the same compiled
 exchange serves every superstep of every shuffle.
-
-Payload dtype: buffers are logically bytes, but the exchange runs over a wider lane
-dtype (default int32) when alignment permits — ``block_alignment`` (config.py)
-guarantees every per-peer chunk starts on a lane boundary, the same role NVKV's
-512-byte write alignment plays in the reference (NvkvHandler.scala:244-256).
 """
 
 from __future__ import annotations
@@ -60,33 +62,30 @@ def exclusive_cumsum(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
 class ExchangeSpec:
     """Static description of one compiled exchange.
 
-    ``send_capacity`` / ``recv_capacity`` are per-executor staging sizes in
-    *elements* of ``dtype`` (the HBM analogue of the reference's fixed 30 MB NVKV
-    read buffers, NvkvHandler.scala:26-29).  ``impl`` is ``'ragged'`` | ``'dense'``
-    | ``'auto'`` (ragged iff the backend lowers it, i.e. TPU).
+    ``send_rows`` / ``recv_rows`` are per-executor staging sizes in rows of
+    ``lane`` int32 elements (``row_bytes`` = 4*lane, default 512 — the HBM
+    analogue of the reference's fixed NVKV buffers, NvkvHandler.scala:26-29).
+    ``impl`` is ``'ragged'`` | ``'dense'`` | ``'auto'`` (ragged iff the backend
+    lowers it, i.e. TPU).  Layout is always *slot*: peer j's chunk starts at row
+    ``j * slot_rows`` — exactly the per-peer region layout the HBM store stages,
+    so nothing is repacked between "map output written" and "collective run".
     """
 
     num_executors: int
-    send_capacity: int
-    recv_capacity: int
-    dtype: np.dtype = np.dtype(np.int32)
+    send_rows: int
+    recv_rows: int
+    lane: int = 128
     axis_name: str = "ex"
     impl: str = "auto"
-    #: 'tight' — peer chunks packed back-to-back (cumsum offsets; ragged only);
-    #: 'slot'  — peer chunk j starts at region boundary j*slot_capacity (both
-    #: impls).  'slot' is what the HBM store produces: map writers append into
-    #: per-peer regions, so no repacking happens before the collective — the
-    #: ragged lowering simply sends each region's used prefix.
-    layout: str = "slot"
 
     @property
-    def elem_bytes(self) -> int:
-        return np.dtype(self.dtype).itemsize
+    def row_bytes(self) -> int:
+        return self.lane * 4
 
     @property
-    def slot_capacity(self) -> int:
-        """Per-peer slot size (elements) for the dense lowering / slot packing."""
-        return self.send_capacity // self.num_executors
+    def slot_rows(self) -> int:
+        """Per-peer region size in rows."""
+        return self.send_rows // self.num_executors
 
     def resolve_impl(self, platform: Optional[str] = None) -> "ExchangeSpec":
         if self.impl != "auto":
@@ -96,43 +95,40 @@ class ExchangeSpec:
         return replace(self, impl="ragged" if platform == "tpu" else "dense")
 
     def validate(self) -> None:
-        if self.layout not in ("tight", "slot"):
-            raise ValueError(f"unknown layout {self.layout!r}")
-        if self.impl == "dense" and self.layout != "slot":
-            raise ValueError("dense impl requires slot layout")
-        if self.layout == "slot" and self.send_capacity % self.num_executors:
-            raise ValueError("send_capacity must be divisible by num_executors for slot layout")
+        if self.send_rows % self.num_executors:
+            raise ValueError("send_rows must be divisible by num_executors (slot layout)")
+        if self.impl not in ("ragged", "dense"):
+            raise ValueError(f"unknown impl {self.impl!r}")
+        if self.lane <= 0:
+            raise ValueError("lane must be positive")
 
 
 def _sizes_and_offsets(spec: ExchangeSpec, size_row: jnp.ndarray):
     """Phase 1 (shared): gather the size matrix, derive send/recv sizes + offsets."""
     ax = spec.axis_name
     me = jax.lax.axis_index(ax)
-    sizes = jax.lax.all_gather(size_row, ax, tiled=True)  # (n, n): sizes[i, j] = i -> j
+    sizes = jax.lax.all_gather(size_row, ax, tiled=True)  # (n, n): sizes[i, j] = i -> j rows
     send_sizes = sizes[me]                                # (n,)
     recv_sizes = sizes[:, me]                             # (n,)
-    # Landing offset of MY chunk inside each receiver j's buffer: elements from
+    # Landing offset of MY chunk inside each receiver j's buffer: rows from
     # senders i < me bound for j — exclusive cumsum down each column, row `me`.
     output_offsets = exclusive_cumsum(sizes, axis=0)[me]  # (n,)
     return me, send_sizes, recv_sizes, output_offsets
 
 
 def _exchange_shard_ragged(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.ndarray):
-    """Peer-major staging -> ragged_all_to_all -> tight sender-major recv.
+    """Slot-region staging -> ragged_all_to_all over rows -> tight sender-major recv.
 
-    With slot layout only each region's used prefix crosses the wire — the
-    padding between regions stays home, unlike the dense lowering."""
+    Only each region's used prefix crosses the wire — the padding between
+    regions stays home, unlike the dense lowering."""
+    n = spec.num_executors
     _, send_sizes, recv_sizes, output_offsets = _sizes_and_offsets(spec, size_row)
-    if spec.layout == "slot":
-        n = spec.num_executors
-        input_offsets = jnp.arange(n, dtype=jnp.int32) * spec.slot_capacity
-    else:
-        input_offsets = exclusive_cumsum(send_sizes)
-    out = jnp.zeros((spec.recv_capacity,), dtype=data.dtype)
+    input_offsets = jnp.arange(n, dtype=jnp.int32) * spec.slot_rows
+    out = jnp.zeros((spec.recv_rows, spec.lane), dtype=data.dtype)
     out = jax.lax.ragged_all_to_all(
         data,
         out,
-        input_offsets.astype(jnp.int32),
+        input_offsets,
         send_sizes.astype(jnp.int32),
         output_offsets.astype(jnp.int32),
         recv_sizes.astype(jnp.int32),
@@ -142,30 +138,30 @@ def _exchange_shard_ragged(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.
 
 
 def _exchange_shard_dense(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.ndarray):
-    """Slot-packed staging -> tiled all_to_all -> gather-compaction.
+    """Slot staging -> tiled all_to_all -> row-gather compaction.
 
-    The compaction maps every output position p to its (sender k, within-chunk
-    delta) source inside the received slot grid, producing the same tight
-    sender-major layout as the ragged path — one static gather, MXU/VPU friendly,
-    no data-dependent shapes.
-    """
+    The compaction maps every output row p to its (sender k, within-chunk delta)
+    source inside the received slot grid, producing the same tight sender-major
+    layout as the ragged path — one static gather over rows, no data-dependent
+    shapes."""
     n = spec.num_executors
-    slot = spec.slot_capacity
+    slot = spec.slot_rows
     _, _, recv_sizes, _ = _sizes_and_offsets(spec, size_row)
 
-    slots = data.reshape(n, slot)
+    slots = data.reshape(n, slot, spec.lane)
     received = jax.lax.all_to_all(slots, spec.axis_name, split_axis=0, concat_axis=0, tiled=True)
-    flat = received.reshape(n * slot)
+    flat = received.reshape(n * slot, spec.lane)
 
     starts = exclusive_cumsum(recv_sizes)                       # (n,)
     cum = jnp.cumsum(recv_sizes)
     total = cum[-1]
-    pos = jnp.arange(spec.recv_capacity, dtype=jnp.int32)
+    pos = jnp.arange(spec.recv_rows, dtype=jnp.int32)
     k = jnp.searchsorted(cum, pos, side="right").astype(jnp.int32)
     k = jnp.clip(k, 0, n - 1)
     src = k * slot + (pos - starts[k])
     valid = pos < total
-    out = jnp.where(valid, flat[jnp.clip(src, 0, n * slot - 1)], jnp.zeros((), dtype=data.dtype))
+    rows = flat[jnp.clip(src, 0, n * slot - 1)]
+    out = jnp.where(valid[:, None], rows, jnp.zeros((), dtype=data.dtype))
     return out, recv_sizes[None, :]
 
 
@@ -174,14 +170,14 @@ def build_exchange(mesh: Mesh, spec: ExchangeSpec):
 
     Returns a jitted ``fn(data, size_matrix) -> (recv, recv_sizes)`` where
 
-    * ``data``: (n * send_capacity,) elements of ``spec.dtype``, sharded over
-      ``axis_name`` — executor i's staging buffer is shard i (packed per
-      ``staging_layout(spec)``);
-    * ``size_matrix``: (n, n) int32, row-sharded — row i is executor i's send sizes
-      in elements (padded to alignment);
-    * ``recv``: (n * recv_capacity,) sharded — shard j holds everything executor j
-      received, tightly packed sender-major;
-    * ``recv_sizes``: (n, n) int32 row-sharded — row j = elements j received from
+    * ``data``: (n * send_rows, lane) int32, row-sharded over ``axis_name`` —
+      executor i's staging buffer is shard i, slot layout (peer j's chunk at row
+      ``j * slot_rows``);
+    * ``size_matrix``: (n, n) int32, row-sharded — row i is executor i's send
+      sizes in rows (block padding included);
+    * ``recv``: (n * recv_rows, lane) row-sharded — shard j holds everything
+      executor j received, tightly packed sender-major;
+    * ``recv_sizes``: (n, n) int32 row-sharded — row j = rows j received from
       each sender i.
     """
     if spec.num_executors != mesh.devices.size:
@@ -194,15 +190,15 @@ def build_exchange(mesh: Mesh, spec: ExchangeSpec):
     shard = jax.shard_map(
         functools.partial(body, spec),
         mesh=mesh,
-        in_specs=(P(ax), P(ax, None)),
-        out_specs=(P(ax), P(ax, None)),
+        in_specs=(P(ax, None), P(ax, None)),
+        out_specs=(P(ax, None), P(ax, None)),
         check_vma=False,
     )
-    data_sharding = NamedSharding(mesh, P(ax))
+    data_sharding = NamedSharding(mesh, P(ax, None))
     sizes_sharding = NamedSharding(mesh, P(ax, None))
     # Donating the staging buffer halves peak HBM when the recv buffer can alias
     # it (same shape/dtype); XLA can't alias mismatched sizes, so only donate then.
-    donate = (0,) if spec.send_capacity == spec.recv_capacity else ()
+    donate = (0,) if spec.send_rows == spec.recv_rows else ()
     fn = jax.jit(
         shard,
         in_shardings=(data_sharding, sizes_sharding),
@@ -218,58 +214,39 @@ def build_exchange(mesh: Mesh, spec: ExchangeSpec):
 # ----------------------------------------------------------------------------
 
 
-def staging_layout(spec: ExchangeSpec) -> Optional[int]:
-    """Slot size in elements for slot packing, or None for tight packing."""
-    return None if spec.layout == "tight" else spec.slot_capacity
-
-
-def pack_chunks_peer_major(
+def pack_chunks_slots(
     chunks: Sequence[bytes],
-    capacity_bytes: int,
-    alignment: int,
-    elem_bytes: int,
-    slot_elems: Optional[int] = None,
+    slot_rows: int,
+    row_bytes: int = 512,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Pack per-peer byte chunks into one staging buffer, peer-major, each chunk
-    padded to ``alignment`` (the writer-side 512-alignment analogue,
-    NvkvHandler.scala:244-256).
+    """Pack per-peer byte chunks into a slot-layout staging buffer: chunk j starts
+    at row ``j * slot_rows``, padded to a whole row (the writer-side 512-byte
+    alignment analogue, NvkvHandler.scala:244-256).
 
-    ``slot_elems=None`` packs tight (ragged layout); otherwise chunk j starts at
-    slot boundary ``j * slot_elems`` (dense layout).
-
-    Returns (uint8 buffer of length capacity_bytes, per-peer sizes in *elements*,
-    padding included).
+    Returns ((n*slot_rows, row_bytes/4) int32 buffer, per-peer sizes in rows).
     """
-    if alignment % elem_bytes:
-        raise ValueError("alignment must be a multiple of the exchange element size")
-    buf = np.zeros(capacity_bytes, dtype=np.uint8)
-    sizes = np.zeros(len(chunks), dtype=np.int32)
-    pos = 0
+    n = len(chunks)
+    buf = np.zeros(n * slot_rows * row_bytes, dtype=np.uint8)
+    sizes = np.zeros(n, dtype=np.int32)
     for j, chunk in enumerate(chunks):
-        if slot_elems is not None:
-            pos = j * slot_elems * elem_bytes
-        padded = -(-len(chunk) // alignment) * alignment
-        if slot_elems is not None and padded > slot_elems * elem_bytes:
-            raise ValueError(
-                f"chunk for peer {j} ({padded} B padded) exceeds slot {slot_elems * elem_bytes} B"
-            )
-        if pos + padded > capacity_bytes:
-            raise ValueError(f"staging overflow: need {pos + padded} bytes > capacity {capacity_bytes}")
-        buf[pos : pos + len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
-        sizes[j] = padded // elem_bytes
-        pos += padded
-    return buf, sizes
+        rows = -(-len(chunk) // row_bytes)
+        if rows > slot_rows:
+            raise ValueError(f"chunk for peer {j} ({rows} rows) exceeds slot {slot_rows} rows")
+        start = j * slot_rows * row_bytes
+        buf[start : start + len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        sizes[j] = rows
+    return buf.view(np.int32).reshape(n * slot_rows, row_bytes // 4), sizes
 
 
 def unpack_received(
-    recv_shard_bytes: bytes, recv_sizes_row: np.ndarray, elem_bytes: int
+    recv_shard_bytes: bytes, recv_sizes_row: np.ndarray, row_bytes: int = 512
 ) -> List[bytes]:
     """Split one receiver's tight sender-major buffer into per-sender chunks
-    (padding still attached; block-level slicing is the resolver's job)."""
+    (row padding still attached; block-level slicing is the resolver's job)."""
     out: List[bytes] = []
     pos = 0
     for sz in recv_sizes_row:
-        nbytes = int(sz) * elem_bytes
+        nbytes = int(sz) * row_bytes
         out.append(recv_shard_bytes[pos : pos + nbytes])
         pos += nbytes
     return out
@@ -277,11 +254,10 @@ def unpack_received(
 
 def oracle_exchange(per_device_chunks: Sequence[Sequence[bytes]]) -> List[bytes]:
     """CPU reference: device j receives concat over senders i of chunk[i][j]
-    (each chunk alignment-padded by the sender).
+    (each chunk row-padded by the sender).
 
     The correctness oracle for the collective (SURVEY.md section 7: "bytes verified
-    against a CPU shuffle oracle").
-    """
+    against a CPU shuffle oracle")."""
     n = len(per_device_chunks)
     return [b"".join(per_device_chunks[i][j] for i in range(n)) for j in range(n)]
 
@@ -290,8 +266,7 @@ def make_mesh(num_executors: int, axis_name: str = "ex", devices=None) -> Mesh:
     """Build the 1-D executor mesh over the first ``num_executors`` devices.
 
     Topology-aware placement lives in parallel/mesh.py; this is the plain
-    test-friendly constructor.
-    """
+    test-friendly constructor."""
     devs = list(devices if devices is not None else jax.devices())[:num_executors]
     if len(devs) < num_executors:
         raise ValueError(f"need {num_executors} devices, have {len(devs)}")

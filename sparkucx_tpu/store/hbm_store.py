@@ -264,22 +264,22 @@ class HbmBlockStore:
 
     # -- seal + exchange hand-off -----------------------------------------
 
-    def seal(self, shuffle_id: int, elem_dtype: np.dtype = np.dtype(np.int32)):
+    def seal(self, shuffle_id: int):
         """Freeze the staging area and stage it into device HBM.
 
         Returns ``(payload, send_sizes)`` — payload is the full slot-layout
-        staging buffer viewed as ``elem_dtype`` (a ``jax.Array`` on
+        staging buffer shaped ``(total_rows, lane)`` int32 where one row is
+        ``alignment`` bytes (the exchange's wire unit; a ``jax.Array`` on
         ``self.device`` when set, else host ndarray); ``send_sizes[p]`` is the
-        used element count of peer p's region (exchange size-matrix row).
+        used row count of peer p's region (exchange size-matrix row).
         """
         st = self._state(shuffle_id)
         with self._lock:
             if st.sealed:
                 raise TransportError(f"shuffle {shuffle_id} already sealed")
-            if (st.region_used % elem_dtype.itemsize).any():
-                raise TransportError("region watermark not element-aligned")
-            payload = st.staging.view(elem_dtype)
-            send_sizes = (st.region_used // elem_dtype.itemsize).astype(np.int32)
+            lane = st.alignment // 4
+            payload = st.staging.view(np.int32).reshape(-1, lane)
+            send_sizes = (st.region_used // st.alignment).astype(np.int32)
             if self.device is not None:
                 import jax
 
@@ -287,8 +287,9 @@ class HbmBlockStore:
             st.sealed_payload = payload
         return payload, send_sizes
 
-    def region_slot_elems(self, shuffle_id: int, elem_dtype: np.dtype = np.dtype(np.int32)) -> int:
-        return self._state(shuffle_id).region_size // elem_dtype.itemsize
+    def region_slot_rows(self, shuffle_id: int) -> int:
+        st = self._state(shuffle_id)
+        return st.region_size // st.alignment
 
     # -- read path (serve staged blocks) ----------------------------------
 
@@ -303,7 +304,7 @@ class HbmBlockStore:
         if e.length == 0:
             return b""
         if st.sealed:
-            payload = np.asarray(st.sealed_payload).view(np.uint8)
+            payload = np.asarray(st.sealed_payload).reshape(-1).view(np.uint8)
             return payload[e.offset : e.offset + e.length].tobytes()
         return st.staging[e.offset : e.offset + e.length].tobytes()
 

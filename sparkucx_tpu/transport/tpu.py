@@ -55,8 +55,6 @@ from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
 
-ELEM_DTYPE = np.dtype(np.int32)
-
 
 @dataclass
 class _ShuffleMeta:
@@ -154,19 +152,22 @@ class TpuShuffleCluster:
 
     # -- the superstep -----------------------------------------------------
 
-    def _exchange_fn(self, send_capacity_elems: int):
-        key = (self.num_executors, send_capacity_elems, self.conf.exchange_dtype)
+    @property
+    def row_bytes(self) -> int:
+        return self.conf.block_alignment
+
+    def _exchange_fn(self, send_rows: int):
+        key = (self.num_executors, send_rows, self.row_bytes)
         with self._lock:
             fn = self._exchange_cache.get(key)
             if fn is None:
                 spec = ExchangeSpec(
                     num_executors=self.num_executors,
-                    send_capacity=send_capacity_elems,
-                    recv_capacity=send_capacity_elems,  # worst case: all regions full
-                    dtype=ELEM_DTYPE,
+                    send_rows=send_rows,
+                    recv_rows=send_rows,  # worst case: all regions full
+                    lane=self.row_bytes // 4,
                     axis_name=self.conf.mesh_axis_name,
                     impl="auto",
-                    layout="slot",
                 )
                 fn = build_exchange(self.mesh, spec)
                 self._exchange_cache[key] = fn
@@ -187,27 +188,34 @@ class TpuShuffleCluster:
 
         payloads, size_rows = [], []
         for t in self.transports:
-            payload, sizes = t.store.seal(shuffle_id, ELEM_DTYPE)
-            payloads.append(np.asarray(payload))
+            payload, sizes = t.store.seal(shuffle_id)
+            payloads.append(payload)
             size_rows.append(sizes)
-        send_capacity = payloads[0].size
-        fn = self._exchange_fn(send_capacity)
+        send_rows, lane = int(payloads[0].shape[0]), int(payloads[0].shape[1])
+        fn = self._exchange_fn(send_rows)
 
         ax = self.conf.mesh_axis_name
-        data = jax.device_put(
-            np.concatenate(payloads), NamedSharding(self.mesh, P(ax))
-        )
+        n = self.num_executors
+        data_sharding = NamedSharding(self.mesh, P(ax, None))
+        if all(isinstance(p, jax.Array) for p in payloads):
+            # Shards were sealed straight onto their executors' devices — assemble
+            # the global array without any host round-trip.
+            data = jax.make_array_from_single_device_arrays(
+                (n * send_rows, lane), data_sharding, payloads
+            )
+        else:
+            data = jax.device_put(np.concatenate([np.asarray(p) for p in payloads]), data_sharding)
         size_mat = jax.device_put(
             np.stack(size_rows).astype(np.int32), NamedSharding(self.mesh, P(ax, None))
         )
         recv, recv_sizes = fn(data, size_mat)
-        recv_host = np.asarray(recv).view(np.uint8)
         recv_sizes_host = np.asarray(recv_sizes)
 
-        eb = ELEM_DTYPE.itemsize
-        cap_bytes = send_capacity * eb
+        # One D2H per executor shard; fetches then slice host memory.
+        shard_by_device = {s.device: s.data for s in recv.addressable_shards}
+        devices = list(self.mesh.devices.reshape(-1))
         meta.recv_shards = [
-            recv_host[j * cap_bytes : (j + 1) * cap_bytes] for j in range(self.num_executors)
+            np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)
         ]
         meta.recv_sizes = recv_sizes_host
         meta.exchanged = True
@@ -248,8 +256,7 @@ class TpuShuffleCluster:
                 f"block ({shuffle_id},{map_id},{reduce_id}) offset {abs_offset} not in "
                 f"consumer {consumer}'s region"
             )
-        eb = ELEM_DTYPE.itemsize
-        chunk_start = int(meta.recv_sizes[consumer, :sender].sum()) * eb
+        chunk_start = int(meta.recv_sizes[consumer, :sender].sum()) * self.row_bytes
         shard = meta.recv_shards[consumer]
         start = chunk_start + region_rel
         return shard[start : start + length], length

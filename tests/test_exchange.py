@@ -16,41 +16,38 @@ from sparkucx_tpu.ops.exchange import (
     exclusive_cumsum,
     make_mesh,
     oracle_exchange,
-    pack_chunks_peer_major,
-    staging_layout,
+    pack_chunks_slots,
     unpack_received,
 )
 
 N = 8
-ALIGN = 128
-EB = 4  # int32 lanes
+LANE = 32           # 128-byte rows in tests (lane=128 / 512 B on real TPU)
+ROW_BYTES = LANE * 4
+SLOT_ROWS = 64      # per-peer region: 8 KiB
 
 
-def _spec(send_cap=1024, recv_cap=4096, impl="dense"):
+def _spec(impl="dense"):
     return ExchangeSpec(
-        num_executors=N, send_capacity=send_cap, recv_capacity=recv_cap,
-        dtype=np.dtype(np.int32), impl=impl,
+        num_executors=N,
+        send_rows=N * SLOT_ROWS,
+        recv_rows=N * SLOT_ROWS,
+        lane=LANE,
+        impl=impl,
     )
 
 
 def _run_exchange(chunks, spec, mesh, fn):
-    slot = spec.slot_capacity if spec.impl == "dense" else None
-    bufs, sizes = zip(
-        *[
-            pack_chunks_peer_major(chunks[i], spec.send_capacity * EB, ALIGN, EB, slot_elems=slot)
-            for i in range(N)
-        ]
-    )
-    data = np.concatenate([b.view(np.int32) for b in bufs])
+    bufs, sizes = zip(*[pack_chunks_slots(chunks[i], SLOT_ROWS, ROW_BYTES) for i in range(N)])
+    data = np.concatenate(bufs, axis=0)
     size_mat = np.stack(sizes).astype(np.int32)
-    data_j = jax.device_put(data, NamedSharding(mesh, P("ex")))
+    data_j = jax.device_put(data, NamedSharding(mesh, P("ex", None)))
     sm_j = jax.device_put(size_mat, NamedSharding(mesh, P("ex", None)))
     recv, recv_sizes = fn(data_j, sm_j)
     return np.asarray(recv), np.asarray(recv_sizes)
 
 
 def _padded(chunk):
-    pad = (-len(chunk)) % ALIGN
+    pad = (-len(chunk)) % ROW_BYTES
     return chunk + b"\x00" * pad
 
 
@@ -58,10 +55,10 @@ def _verify_against_oracle(chunks, recv, recv_sizes, spec):
     padded = [[_padded(c) for c in row] for row in chunks]
     expected = oracle_exchange(padded)
     for j in range(N):
-        shard = recv[j * spec.recv_capacity : (j + 1) * spec.recv_capacity].tobytes()
-        total = int(recv_sizes[j].sum()) * EB
+        shard = recv[j * spec.recv_rows : (j + 1) * spec.recv_rows].reshape(-1).view(np.uint8).tobytes()
+        total = int(recv_sizes[j].sum()) * ROW_BYTES
         assert shard[:total] == expected[j], f"receiver {j} mismatch"
-        per_sender = unpack_received(shard, recv_sizes[j], EB)
+        per_sender = unpack_received(shard, recv_sizes[j], ROW_BYTES)
         for i in range(N):
             assert per_sender[i][: len(chunks[i][j])] == chunks[i][j]
 
@@ -79,7 +76,7 @@ def dense_fn(mesh):
 class TestDenseExchange:
     def test_random_skewed_vs_oracle(self, mesh, dense_fn, rng):
         spec = dense_fn.spec
-        max_bytes = spec.slot_capacity * EB // 2
+        max_bytes = SLOT_ROWS * ROW_BYTES // 2
         chunks = [
             [rng.integers(0, 256, size=int(rng.integers(0, max_bytes)), dtype=np.uint8).tobytes() for _ in range(N)]
             for _ in range(N)
@@ -92,19 +89,17 @@ class TestDenseExchange:
         chunks = [[b"" for _ in range(N)] for _ in range(N)]
         chunks[3][5] = b"only-block" * 3
         recv, recv_sizes = _run_exchange(chunks, dense_fn.spec, mesh, dense_fn)
-        assert recv_sizes[5][3] == ALIGN // EB
-        assert recv_sizes.sum() == ALIGN // EB
+        assert recv_sizes[5][3] == 1  # 30 bytes -> 1 row
+        assert recv_sizes.sum() == 1
         _verify_against_oracle(chunks, recv, recv_sizes, dense_fn.spec)
 
-    def test_identity_diagonal(self, mesh, dense_fn, rng):
+    def test_identity_diagonal(self, mesh, dense_fn):
         # Every executor keeps one local chunk (self-send over the collective).
-        chunks = [
-            [b"" if i != j else bytes([i]) * 200 for j in range(N)] for i in range(N)
-        ]
+        chunks = [[b"" if i != j else bytes([i]) * 200 for j in range(N)] for i in range(N)]
         recv, recv_sizes = _run_exchange(chunks, dense_fn.spec, mesh, dense_fn)
         _verify_against_oracle(chunks, recv, recv_sizes, dense_fn.spec)
 
-    def test_reuse_compiled_across_supersteps(self, mesh, dense_fn, rng):
+    def test_reuse_compiled_across_supersteps(self, mesh, dense_fn):
         # One compiled exchange serves many supersteps (no retrace): different data.
         for step in range(3):
             chunks = [
@@ -115,13 +110,13 @@ class TestDenseExchange:
 
     def test_full_slots(self, mesh, dense_fn, rng):
         spec = dense_fn.spec
-        full = spec.slot_capacity * EB
+        full = SLOT_ROWS * ROW_BYTES
         chunks = [
             [rng.integers(0, 256, size=full, dtype=np.uint8).tobytes() for _ in range(N)]
             for _ in range(N)
         ]
         recv, recv_sizes = _run_exchange(chunks, spec, mesh, dense_fn)
-        assert int(recv_sizes.sum()) == N * N * spec.slot_capacity
+        assert int(recv_sizes.sum()) == N * N * SLOT_ROWS
         _verify_against_oracle(chunks, recv, recv_sizes, spec)
 
 
@@ -131,7 +126,7 @@ class TestRaggedLowering:
         # this pins the TPU path's graph without TPU hardware.
         spec = _spec(impl="ragged")
         fn = build_exchange(mesh, spec)
-        data = jax.ShapeDtypeStruct((N * spec.send_capacity,), np.int32)
+        data = jax.ShapeDtypeStruct((N * spec.send_rows, LANE), np.int32)
         sizes = jax.ShapeDtypeStruct((N, N), np.int32)
         text = fn.lower(data, sizes).as_text()
         assert "ragged_all_to_all" in text or "ragged-all-to-all" in text
@@ -142,28 +137,21 @@ class TestRaggedLowering:
 
 
 class TestPacking:
-    def test_tight_packing_offsets(self):
-        buf, sizes = pack_chunks_peer_major([b"a" * 100, b"b" * 300], 4096, ALIGN, EB)
-        assert sizes.tolist() == [ALIGN // EB, 3 * ALIGN // EB]  # 300 B pads to 384
-        assert buf[:100].tobytes() == b"a" * 100
-        assert buf[ALIGN : ALIGN + 300].tobytes() == b"b" * 300
-
     def test_slot_packing_offsets(self):
-        buf, sizes = pack_chunks_peer_major([b"a" * 100, b"b" * 300], 4096, ALIGN, EB, slot_elems=256)
-        assert buf[:100].tobytes() == b"a" * 100
-        assert buf[1024 : 1024 + 300].tobytes() == b"b" * 300
-
-    def test_overflow_raises(self):
-        with pytest.raises(ValueError, match="overflow"):
-            pack_chunks_peer_major([b"x" * 4096, b"y" * 4096], 4096, ALIGN, EB)
+        buf, sizes = pack_chunks_slots([b"a" * 100, b"b" * 300], slot_rows=8, row_bytes=128)
+        assert sizes.tolist() == [1, 3]  # 100 B -> 1 row, 300 B -> 3 rows
+        raw = buf.reshape(-1).view(np.uint8)
+        assert raw[:100].tobytes() == b"a" * 100
+        assert raw[8 * 128 : 8 * 128 + 300].tobytes() == b"b" * 300
 
     def test_slot_overflow_raises(self):
         with pytest.raises(ValueError, match="exceeds slot"):
-            pack_chunks_peer_major([b"x" * 2048], 4096, ALIGN, EB, slot_elems=256)
+            pack_chunks_slots([b"x" * 2048], slot_rows=8, row_bytes=128)
 
-    def test_alignment_must_match_dtype(self):
-        with pytest.raises(ValueError, match="multiple"):
-            pack_chunks_peer_major([b"x"], 4096, 3, EB)
+    def test_unpack_received(self):
+        shard = b"A" * 256 + b"B" * 128
+        parts = unpack_received(shard, np.array([2, 1]), 128)
+        assert parts == [b"A" * 256, b"B" * 128]
 
 
 class TestSpec:
@@ -175,25 +163,14 @@ class TestSpec:
 
     def test_mesh_size_mismatch_raises(self, mesh):
         with pytest.raises(ValueError, match="mesh size"):
-            build_exchange(mesh, ExchangeSpec(num_executors=4, send_capacity=64, recv_capacity=64))
+            build_exchange(mesh, ExchangeSpec(num_executors=4, send_rows=64, recv_rows=64))
 
-    def test_dense_divisibility(self, mesh):
+    def test_slot_divisibility(self, mesh):
         with pytest.raises(ValueError, match="divisible"):
-            build_exchange(mesh, _spec(send_cap=1001, impl="dense"))
-
-    def test_staging_layout(self):
-        ragged_tight = ExchangeSpec(
-            num_executors=N, send_capacity=1024, recv_capacity=4096, impl="ragged", layout="tight"
-        )
-        assert staging_layout(ragged_tight) is None
-        assert staging_layout(_spec(impl="dense")) == 1024 // N
-
-    def test_dense_requires_slot_layout(self, mesh):
-        with pytest.raises(ValueError, match="slot layout"):
             build_exchange(
-                mesh,
-                ExchangeSpec(
-                    num_executors=N, send_capacity=1024, recv_capacity=1024,
-                    impl="dense", layout="tight",
-                ),
+                mesh, ExchangeSpec(num_executors=N, send_rows=1001, recv_rows=1001, impl="dense")
             )
+
+    def test_row_bytes(self):
+        assert _spec().row_bytes == ROW_BYTES
+        assert ExchangeSpec(num_executors=1, send_rows=8, recv_rows=8).row_bytes == 512

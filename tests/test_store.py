@@ -161,8 +161,9 @@ class TestCommitAndSeal:
         payload, sizes = store.seal(0)
         st = store._state(0)
         assert payload.dtype == np.int32
-        assert sizes.tolist() == [128 // 4, 384 // 4]
-        raw = np.asarray(payload).view(np.uint8)
+        assert payload.shape[1] == ALIGN // 4  # one row per alignment unit
+        assert sizes.tolist() == [1, 3]  # row counts: 100 B -> 1, 300 B -> 3
+        raw = np.asarray(payload).reshape(-1).view(np.uint8)
         assert raw[:100].tobytes() == b"A" * 100
         assert raw[st.region_size : st.region_size + 300].tobytes() == b"B" * 300
 
