@@ -1,0 +1,216 @@
+"""Control-plane bootstrap: driver/executor address exchange over TCP (L2).
+
+Counterpart of the reference's Spark-RPC control plane (rpc/ directory):
+
+* ``DriverEndpoint`` == ``UcxDriverRpcEndpoint`` (UcxDriverRpcEndpoint.scala:21-42):
+  on ``ExecutorAdded`` it replies with ``IntroduceAllExecutors`` (current
+  membership) and broadcasts the newcomer to every registered executor.
+* ``ExecutorEndpoint`` == ``UcxExecutorRpcEndpoint`` (UcxExecutorRpcEndpoint.scala:19-39):
+  applies both message types by calling ``transport.add_executor(s)`` and
+  ``pre_connect`` on a worker thread.
+* Messages carry opaque serialized addresses like the reference's
+  ``SerializableDirectBuffer`` payloads (UcxRpcMessages.scala:15-21); here they are
+  length-prefixed JSON frames with base64 address blobs (no pickle — the control
+  plane must not execute peer-controlled bytes).
+
+The reference rides Spark's RpcEnv; this build has no Spark at the bottom, so the
+driver is a small threaded TCP server — the same role the dedicated "ucx-rpc-env"
+plays (CommonUcxShuffleManager.scala:73-78).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 16 << 20
+
+
+def _send_msg(sock: socket.socket, msg: dict) -> None:
+    payload = json.dumps(msg).encode()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Optional[dict]:
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (length,) = _LEN.unpack(hdr)
+    if length > _MAX_FRAME:
+        raise ValueError(f"control frame too large: {length}")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return json.loads(payload)
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class DriverEndpoint:
+    """The membership authority.  Thread-per-connection; connections stay open so
+    the driver can push ``ExecutorAdded`` broadcasts (the reference keeps
+    endpoint refs the same way, UcxDriverRpcEndpoint.scala:17-19)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.address: Tuple[str, int] = self._srv.getsockname()
+        self._members: Dict[ExecutorId, str] = {}  # executor -> b64 address blob
+        self._conns: Dict[ExecutorId, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        eid: Optional[ExecutorId] = None
+        try:
+            while self._running:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                if msg["type"] == "ExecutorAdded":
+                    eid = int(msg["executor_id"])
+                    with self._lock:
+                        existing = dict(self._members)
+                        self._members[eid] = msg["address"]
+                        peers = list(self._conns.items())
+                        self._conns[eid] = conn
+                    # reply with current membership (UcxDriverRpcEndpoint.scala:30-33)
+                    _send_msg(conn, {"type": "IntroduceAllExecutors", "executors": existing})
+                    # broadcast the newcomer to everyone else (:34-41)
+                    for peer_id, peer_conn in peers:
+                        try:
+                            _send_msg(
+                                peer_conn,
+                                {
+                                    "type": "ExecutorAdded",
+                                    "executor_id": eid,
+                                    "address": msg["address"],
+                                },
+                            )
+                        except OSError:
+                            pass
+        except (OSError, ValueError, KeyError):
+            pass
+        finally:
+            if eid is not None:
+                with self._lock:
+                    if self._conns.get(eid) is conn:
+                        del self._conns[eid]
+            conn.close()
+
+    @property
+    def members(self) -> Dict[ExecutorId, bytes]:
+        with self._lock:
+            return {k: _unb64(v) for k, v in self._members.items()}
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class ExecutorEndpoint:
+    """Executor-side client: registers, applies membership, listens for joins."""
+
+    def __init__(
+        self,
+        driver_address: Tuple[str, int],
+        executor_id: ExecutorId,
+        transport: ShuffleTransport,
+        on_member: Optional[Callable[[ExecutorId, bytes], None]] = None,
+    ) -> None:
+        self.executor_id = executor_id
+        self.transport = transport
+        self.on_member = on_member
+        self._sock = socket.create_connection(driver_address, timeout=10)
+        self.known: Dict[ExecutorId, bytes] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._introduced = threading.Event()
+        self._listener = threading.Thread(target=self._listen_loop, daemon=True)
+
+    def register(self, local_address: bytes, timeout: float = 10.0) -> None:
+        """ExecutorAdded ask + IntroduceAllExecutors apply
+        (CommonUcxShuffleManager.scala:91-97)."""
+        _send_msg(
+            self._sock,
+            {"type": "ExecutorAdded", "executor_id": self.executor_id, "address": _b64(local_address)},
+        )
+        self._listener.start()
+        if not self._introduced.wait(timeout):
+            raise TimeoutError("driver did not introduce executors in time")
+
+    def _apply(self, eid: ExecutorId, addr: bytes) -> None:
+        with self._lock:
+            self.known[eid] = addr
+        self.transport.add_executor(eid, addr)
+        self.transport.pre_connect()
+        if self.on_member is not None:
+            self.on_member(eid, addr)
+
+    def _listen_loop(self) -> None:
+        try:
+            while self._running:
+                msg = _recv_msg(self._sock)
+                if msg is None:
+                    return
+                if msg["type"] == "IntroduceAllExecutors":
+                    for eid_s, addr in msg["executors"].items():
+                        self._apply(int(eid_s), _unb64(addr))
+                    self._introduced.set()
+                elif msg["type"] == "ExecutorAdded":
+                    self._apply(int(msg["executor_id"]), _unb64(msg["address"]))
+        except (OSError, ValueError, KeyError):
+            pass
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass

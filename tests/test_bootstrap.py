@@ -1,0 +1,143 @@
+"""Tests for the L2 control plane (driver/executor address exchange) and mesh
+topology helpers."""
+
+import time
+
+import pytest
+
+from sparkucx_tpu.parallel.bootstrap import DriverEndpoint, ExecutorEndpoint
+from sparkucx_tpu.parallel.mesh import (
+    discover_topology,
+    executor_mesh,
+    executor_for_device,
+)
+from sparkucx_tpu.transport.loopback import LoopbackFabric, LoopbackTransport
+
+
+def _wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestBootstrap:
+    def test_three_executors_converge(self):
+        driver = DriverEndpoint()
+        fabric = LoopbackFabric()
+        endpoints = []
+        try:
+            for eid in (1, 2, 3):
+                t = LoopbackTransport(executor_id=eid, fabric=fabric)
+                addr = t.init()
+                ep = ExecutorEndpoint(driver.address, eid, t)
+                ep.register(addr)
+                endpoints.append((ep, t))
+            # Every executor learns every *other* executor (driver replies with
+            # pre-existing members; broadcasts cover the rest).
+            assert _wait_until(
+                lambda: all(
+                    set(ep.known) == {1, 2, 3} - {ep.executor_id} for ep, _ in endpoints
+                )
+            ), [set(ep.known) for ep, _ in endpoints]
+            # transports got add_executor for each peer
+            for ep, t in endpoints:
+                for other_ep, _ in endpoints:
+                    if other_ep.executor_id != ep.executor_id:
+                        assert other_ep.executor_id in t._peers
+            assert set(driver.members) == {1, 2, 3}
+        finally:
+            for ep, t in endpoints:
+                ep.close()
+                t.close()
+            driver.close()
+
+    def test_late_joiner_broadcast(self):
+        driver = DriverEndpoint()
+        fabric = LoopbackFabric()
+        t1 = LoopbackTransport(executor_id=1, fabric=fabric)
+        ep1 = ExecutorEndpoint(driver.address, 1, t1)
+        try:
+            ep1.register(t1.init())
+            assert ep1.known == {}
+            t2 = LoopbackTransport(executor_id=2, fabric=fabric)
+            ep2 = ExecutorEndpoint(driver.address, 2, t2)
+            ep2.register(t2.init())
+            try:
+                assert _wait_until(lambda: 2 in ep1.known)  # pushed, not polled
+                assert _wait_until(lambda: 1 in ep2.known)
+            finally:
+                ep2.close()
+                t2.close()
+        finally:
+            ep1.close()
+            t1.close()
+            driver.close()
+
+    def test_member_callback_fires(self):
+        driver = DriverEndpoint()
+        fabric = LoopbackFabric()
+        seen = []
+        t1 = LoopbackTransport(executor_id=1, fabric=fabric)
+        t2 = LoopbackTransport(executor_id=2, fabric=fabric)
+        ep1 = ExecutorEndpoint(driver.address, 1, t1, on_member=lambda e, a: seen.append(e))
+        ep2 = ExecutorEndpoint(driver.address, 2, t2)
+        try:
+            ep1.register(t1.init())
+            ep2.register(t2.init())
+            assert _wait_until(lambda: seen == [2])
+        finally:
+            ep1.close(); ep2.close(); t1.close(); t2.close(); driver.close()
+
+    def test_register_timeout_without_driver_reply(self):
+        import socket
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        fabric = LoopbackFabric()
+        t = LoopbackTransport(executor_id=1, fabric=fabric)
+        ep = ExecutorEndpoint(srv.getsockname(), 1, t)
+        try:
+            with pytest.raises(TimeoutError):
+                ep.register(t.init(), timeout=0.3)
+        finally:
+            ep.close(); t.close(); srv.close()
+
+
+class TestTopology:
+    def test_discover_topology(self):
+        topo = discover_topology()
+        assert topo.num_devices >= 8  # the forced CPU mesh
+        assert topo.process_count == 1
+        assert not topo.multi_host
+
+    def test_executor_mesh(self):
+        mesh = executor_mesh(8)
+        assert mesh.devices.size == 8
+        assert mesh.axis_names == ("ex",)
+        dev = mesh.devices.reshape(-1)[3]
+        assert executor_for_device(mesh, dev) == 3
+
+    def test_executor_mesh_too_many(self):
+        with pytest.raises(ValueError, match="need"):
+            executor_mesh(10_000)
+
+    def test_ici_order_with_coords(self):
+        # Fake devices exposing coords: snake order should sort (z, y, x-snaked).
+        class FakeDev:
+            def __init__(self, x, y, z):
+                self.coords = (x, y, z)
+                self.core_on_chip = 0
+
+            def __repr__(self):
+                return f"D{self.coords}"
+
+        from sparkucx_tpu.parallel.mesh import _ici_order
+
+        devs = [FakeDev(x, y, 0) for y in range(2) for x in range(2)]
+        ordered = _ici_order(devs[::-1])
+        coords = [d.coords for d in ordered]
+        assert coords == [(0, 0, 0), (1, 0, 0), (1, 1, 0), (0, 1, 0)]  # snake

@@ -1,0 +1,195 @@
+"""Tests for the peer socket path: block server, batched fetch, handshake,
+mapper-info broadcast, and a true multi-process executor pair."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import BytesBlock, MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus
+from sparkucx_tpu.transport.peer import (
+    PeerTransport,
+    pack_batch_fetch_req,
+    unpack_batch_fetch_req,
+)
+
+
+def _buf(n):
+    return MemoryBlock(np.zeros(n, dtype=np.uint8), size=n)
+
+
+@pytest.fixture
+def pair():
+    conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20, max_blocks_per_request=4)
+    a = PeerTransport(conf, executor_id=1)
+    b = PeerTransport(conf, executor_id=2)
+    addr_a, addr_b = a.init(), b.init()
+    a.add_executor(2, addr_b)
+    b.add_executor(1, addr_a)
+    yield a, b
+    a.close()
+    b.close()
+
+
+def _drive(t, reqs, timeout=5.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while not all(r.completed() for r in reqs):
+        t.progress()
+        if time.monotonic() > deadline:
+            raise TimeoutError("requests did not complete")
+        time.sleep(0.001)
+
+
+class TestWire:
+    def test_batch_header_roundtrip(self):
+        bids = [ShuffleBlockId(1, 2, 3), ShuffleBlockId(4, 5, 6)]
+        tag, got = unpack_batch_fetch_req(pack_batch_fetch_req(77, bids))
+        assert tag == 77 and got == bids
+
+
+class TestPeerFetch:
+    def test_registered_block_fetch(self, pair):
+        a, b = pair
+        bid = ShuffleBlockId(0, 0, 0)
+        b.register(bid, BytesBlock(b"over-the-wire"))
+        out = _buf(64)
+        [req] = a.fetch_blocks_by_block_ids(2, [bid], [out], [None])
+        assert not req.completed()  # explicit-poll contract
+        _drive(a, [req])
+        assert req.wait(1).status == OperationStatus.SUCCESS
+        assert out.host_view()[: out.size].tobytes() == b"over-the-wire"
+
+    def test_batched_fetch_with_windowing(self, pair):
+        a, b = pair
+        payloads = {r: bytes([r + 1]) * (100 * (r + 1)) for r in range(10)}
+        for r, p in payloads.items():
+            b.register(ShuffleBlockId(1, 0, r), BytesBlock(p))
+        bids = [ShuffleBlockId(1, 0, r) for r in range(10)]
+        bufs = [_buf(2048) for _ in range(10)]
+        reqs = a.fetch_blocks_by_block_ids(2, bids, bufs, [None] * 10)  # 3 windows of 4
+        _drive(a, reqs)
+        for r in range(10):
+            assert reqs[r].wait(1).status == OperationStatus.SUCCESS
+            assert bufs[r].host_view()[: bufs[r].size].tobytes() == payloads[r]
+
+    def test_partial_batch_failure(self, pair):
+        a, b = pair
+        b.register(ShuffleBlockId(2, 0, 0), BytesBlock(b"found"))
+        bids = [ShuffleBlockId(2, 0, 0), ShuffleBlockId(2, 0, 99)]
+        bufs = [_buf(64), _buf(64)]
+        reqs = a.fetch_blocks_by_block_ids(2, bids, bufs, [None, None])
+        _drive(a, reqs)
+        assert reqs[0].wait(1).status == OperationStatus.SUCCESS
+        res1 = reqs[1].wait(1)
+        assert res1.status == OperationStatus.FAILURE
+        assert "not found" in str(res1.error)
+
+    def test_staged_store_fetch(self, pair):
+        a, b = pair
+        b.store.create_shuffle(3, 1, 2)
+        w = b.store.map_writer(3, 0)
+        w.write_partition(0, b"staged-over-wire")
+        w.commit()
+        out = _buf(64)
+        req = a.fetch_block(2, 3, 0, 0, out)
+        _drive(a, [req])
+        assert req.wait(1).status == OperationStatus.SUCCESS
+        assert out.host_view()[: out.size].tobytes() == b"staged-over-wire"
+
+    def test_unknown_executor(self, pair):
+        a, _ = pair
+        [req] = a.fetch_blocks_by_block_ids(42, [ShuffleBlockId(0, 0, 0)], [_buf(8)], [None])
+        assert req.wait(1).status == OperationStatus.FAILURE
+
+    def test_callbacks_fire_under_progress(self, pair):
+        a, b = pair
+        b.register(ShuffleBlockId(4, 0, 0), BytesBlock(b"cb"))
+        got = []
+        [req] = a.fetch_blocks_by_block_ids(2, [ShuffleBlockId(4, 0, 0)], [_buf(8)], [got.append])
+        _drive(a, [req])
+        assert got and got[0].status == OperationStatus.SUCCESS
+
+
+class TestControlMessages:
+    def test_init_executor_handshake(self, pair):
+        a, b = pair
+        a.init_executor(4, 8)
+        assert b.server.handshaken[1] == b"4x8"
+
+    def test_commit_block_broadcast(self, pair):
+        from sparkucx_tpu.core.definitions import MapperInfo
+        import time
+
+        a, b = pair
+        b.store.create_shuffle(5, 2, 2)
+        blob = MapperInfo(5, 1, ((0, 64), (512, 32))).pack()
+        a.commit_block(blob)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if b.store.block_length(5, 1, 0) == 64:
+                break
+            time.sleep(0.01)
+        assert b.store.block_length(5, 1, 0) == 64
+        assert b.store.block_length(5, 1, 1) == 32
+
+
+class TestMultiProcess:
+    def test_two_process_shuffle(self, tmp_path):
+        """A real second process serves blocks over its BlockServer."""
+        import subprocess
+        import sys
+        import textwrap
+
+        script = textwrap.dedent(
+            """
+            import sys, numpy as np
+            sys.path.insert(0, %r)
+            from sparkucx_tpu.config import TpuShuffleConf
+            from sparkucx_tpu.transport.peer import PeerTransport
+
+            conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20)
+            t = PeerTransport(conf, executor_id=2)
+            addr = t.init()
+            t.store.create_shuffle(0, 1, 4)
+            w = t.store.map_writer(0, 0)
+            for r in range(4):
+                w.write_partition(r, bytes([r]) * (100 + r))
+            w.commit()
+            print(addr.decode(), flush=True)
+            sys.stdin.readline()  # hold until parent is done
+            t.close()
+            """
+            % __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE,
+            stdin=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            addr = proc.stdout.readline().strip().encode()
+            assert addr, "child failed to start"
+            conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20)
+            a = PeerTransport(conf, executor_id=1)
+            a.init()
+            a.add_executor(2, addr)
+            bufs = [_buf(256) for _ in range(4)]
+            reqs = a.fetch_blocks_by_block_ids(
+                2, [ShuffleBlockId(0, 0, r) for r in range(4)], bufs, [None] * 4
+            )
+            _drive(a, reqs, timeout=10)
+            for r in range(4):
+                assert reqs[r].wait(1).status == OperationStatus.SUCCESS
+                assert bufs[r].host_view()[: bufs[r].size].tobytes() == bytes([r]) * (100 + r)
+            a.close()
+        finally:
+            try:
+                proc.stdin.write("done\n")
+                proc.stdin.flush()
+            except OSError:
+                pass
+            proc.terminate()
+            proc.wait(timeout=10)
