@@ -87,6 +87,10 @@ class TpuShuffleConf:
     store_port: int = 1338
     serve_from_store: bool = True  # spark.dpuTest.enabled analogue
     # (compat/spark_3_0/UcxShuffleBlockResolver.scala:86-90, default true)
+    #: Stage shuffle output in named shared memory so co-located executor
+    #: processes serve blocks zero-copy (single-host NVKV-store analogue).
+    use_shm_staging: bool = False
+    shm_namespace: str = "sparkucx_tpu"
 
     # TPU mesh (L2)
     mesh_axis_name: str = "ex"
@@ -143,6 +147,8 @@ class TpuShuffleConf:
             ("stagingCapacity", "staging_capacity_per_executor", parse_size),
             ("storePort", "store_port", int),
             ("serveFromStore", "serve_from_store", lambda v: str(v).lower() == "true"),
+            ("useShmStaging", "use_shm_staging", lambda v: str(v).lower() == "true"),
+            ("shmNamespace", "shm_namespace", str),
             ("numExecutors", "num_executors", int),
             ("meshAxisName", "mesh_axis_name", str),
             ("usePallasExchange", "use_pallas_exchange", lambda v: str(v).lower() == "true"),

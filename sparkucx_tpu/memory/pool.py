@@ -40,11 +40,24 @@ def round_up_to_next_power_of_two(size: int) -> int:
 _DEFAULT_ALIGNMENT = 64
 
 
-def _alloc_aligned(nbytes: int, alignment: int = _DEFAULT_ALIGNMENT) -> np.ndarray:
-    """Allocate an aligned uint8 array (over-allocate + offset trick)."""
+def _alloc_aligned(nbytes: int, alignment: int = _DEFAULT_ALIGNMENT):
+    """Allocate an aligned uint8 array.
+
+    Prefers the native pinned allocator (the registered-memory analogue of
+    ``ucxContext.memoryMap``, MemoryPool.scala:55-110) — pinned pages let XLA's
+    host->HBM DMA stream without bouncing; falls back to an over-allocated numpy
+    array.  Returns (array, closer)."""
+    try:
+        from sparkucx_tpu import native
+
+        if native.native_available():
+            buf = native.PinnedBuffer(nbytes, alignment=max(alignment, 4096), pin=True)
+            return buf.array, buf.close
+    except Exception:
+        pass
     raw = np.empty(nbytes + alignment, dtype=np.uint8)
     offset = (-raw.ctypes.data) % alignment
-    return raw[offset : offset + nbytes]
+    return raw[offset : offset + nbytes], None
 
 
 class _Slab:
@@ -54,12 +67,19 @@ class _Slab:
     MemoryPool.scala:64-70 — the slab is only releasable when every view is back.
     """
 
-    __slots__ = ("array", "refcount", "lock")
+    __slots__ = ("array", "refcount", "lock", "closer")
 
-    def __init__(self, array: np.ndarray) -> None:
+    def __init__(self, array: np.ndarray, closer=None) -> None:
         self.array = array
         self.refcount = 0
         self.lock = threading.Lock()
+        self.closer = closer
+
+    def release(self) -> None:
+        self.array = None
+        if self.closer is not None:
+            self.closer()
+            self.closer = None
 
 
 class AllocatorStack:
@@ -104,7 +124,8 @@ class AllocatorStack:
         # Small buckets allocate min_allocation_size slabs and carve them up;
         # buckets >= the slab size allocate exactly one buffer (MemoryPool.scala:64-70).
         alloc_size = max(self.size, self.min_allocation_size)
-        slab = _Slab(_alloc_aligned(alloc_size, self.alignment))
+        array, closer = _alloc_aligned(alloc_size, self.alignment)
+        slab = _Slab(array, closer)
         self._slabs.append(slab)
         self.total_allocated += alloc_size
         self._free.extend(self._carve(slab))
@@ -137,8 +158,11 @@ class AllocatorStack:
     def close(self) -> None:
         with self._lock:
             leaked = [s for s in self._slabs if s.refcount > 0]
+            releasable = [s for s in self._slabs if s.refcount == 0]
             self._free.clear()
             self._slabs.clear()
+            for s in releasable:
+                s.release()
             if leaked:
                 raise ResourceWarning(
                     f"AllocatorStack(size={self.size}): {len(leaked)} slabs still referenced at close"

@@ -1,0 +1,148 @@
+// Native memory arena + batch copy for the TPU shuffle framework.
+//
+// This is the in-repo replacement for the two JNI libraries the reference
+// delegates all native work to (SURVEY.md §2 "Native / non-JVM components"):
+//
+//  * jucx's registered-memory role (ucxContext.memoryMap behind
+//    MemoryPool.scala:55-110): ts_alloc_aligned/ts_mlock provide page-aligned,
+//    optionally pinned host slabs that XLA's host->HBM DMA path can stream from
+//    without bouncing.
+//  * nvkv's shared block-device role (NvkvHandler.scala): ts_shm_* exposes a
+//    named shared-memory arena so executor processes on one host stage and
+//    serve shuffle blocks zero-copy — the single-host analogue of the
+//    DPU-attached NVMe store every executor's daemon can read.
+//  * the server-side parallel block gather (ForkJoin ioThreadPool,
+//    UcxWorkerWrapper.scala:416-426): ts_batch_copy moves N scattered segments
+//    with a thread team sized to the total byte count.
+//
+// Plain C ABI; bound from Python with ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Aligned (optionally pinned) private allocations
+// ---------------------------------------------------------------------------
+
+void* ts_alloc_aligned(uint64_t size, uint64_t alignment) {
+  void* ptr = nullptr;
+  if (posix_memalign(&ptr, alignment, size) != 0) return nullptr;
+  return ptr;
+}
+
+void ts_free_aligned(void* ptr) { free(ptr); }
+
+// Pin pages (registered-memory analogue). Returns 0 on success, errno on failure
+// (callers treat failure as advisory: unpinned staging still works, like the
+// reference running UCX without ODP).
+int ts_mlock(void* ptr, uint64_t size) {
+  return mlock(ptr, size) == 0 ? 0 : errno;
+}
+
+int ts_munlock(void* ptr, uint64_t size) {
+  return munlock(ptr, size) == 0 ? 0 : errno;
+}
+
+// ---------------------------------------------------------------------------
+// Named shared-memory arenas (cross-process staging)
+// ---------------------------------------------------------------------------
+
+struct TsShm {
+  void* addr;
+  uint64_t size;
+  int fd;
+};
+
+// create=1: O_CREAT|O_EXCL + ftruncate (the owner); create=0: attach existing.
+TsShm* ts_shm_open(const char* name, uint64_t size, int create) {
+  int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  if (create && ftruncate(fd, (off_t)size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  if (!create) {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < size) {
+      close(fd);
+      return nullptr;
+    }
+  }
+  void* addr = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (addr == MAP_FAILED) {
+    close(fd);
+    if (create) shm_unlink(name);
+    return nullptr;
+  }
+  TsShm* handle = new TsShm{addr, size, fd};
+  return handle;
+}
+
+void* ts_shm_addr(TsShm* handle) { return handle ? handle->addr : nullptr; }
+uint64_t ts_shm_size(TsShm* handle) { return handle ? handle->size : 0; }
+
+void ts_shm_close(TsShm* handle) {
+  if (!handle) return;
+  munmap(handle->addr, handle->size);
+  close(handle->fd);
+  delete handle;
+}
+
+int ts_shm_unlink(const char* name) {
+  return shm_unlink(name) == 0 ? 0 : errno;
+}
+
+// ---------------------------------------------------------------------------
+// Batched scattered copy (server-side gather / client-side scatter)
+// ---------------------------------------------------------------------------
+
+struct TsSegment {
+  uint64_t dst_off;
+  uint64_t src_off;
+  uint64_t len;
+};
+
+// Copy n segments from src to dst. Splits the segment list across a thread team
+// when total bytes exceed ~4 MiB (below that, spawn cost dominates).
+void ts_batch_copy(uint8_t* dst, const uint8_t* src, const TsSegment* segs,
+                   uint64_t n, int max_threads) {
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; ++i) total += segs[i].len;
+  int hw = (int)std::thread::hardware_concurrency();
+  int threads = max_threads > 0 ? max_threads : (hw > 0 ? hw : 1);
+  if (total < (4u << 20) || threads <= 1 || n <= 1) {
+    for (uint64_t i = 0; i < n; ++i)
+      memcpy(dst + segs[i].dst_off, src + segs[i].src_off, segs[i].len);
+    return;
+  }
+  std::atomic<uint64_t> next{0};
+  auto worker = [&]() {
+    for (;;) {
+      uint64_t i = next.fetch_add(1);
+      if (i >= n) return;
+      memcpy(dst + segs[i].dst_off, src + segs[i].src_off, segs[i].len);
+    }
+  };
+  std::vector<std::thread> team;
+  int spawn = threads - 1;
+  for (int t = 0; t < spawn; ++t) team.emplace_back(worker);
+  worker();
+  for (auto& th : team) th.join();
+}
+
+uint64_t ts_version() { return 1; }
+
+}  // extern "C"

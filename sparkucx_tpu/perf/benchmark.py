@@ -1,0 +1,181 @@
+"""Standalone transport benchmark CLI — the ``UcxPerfBenchmark`` analogue.
+
+Counterpart of ``shuffle/ucx/perf/UcxPerfBenchmark.scala`` (221 LoC): a
+no-Spark-required driver for the transport layers.  Same CLI shape
+(UcxPerfBenchmark.scala:41-59):
+
+========  ==========================================  =====================
+flag      reference meaning                            here
+========  ==========================================  =====================
+-a        server socket address                        same (host:port)
+-f        file to serve blocks from                    same (optional)
+-n        number of blocks                             same
+-s        block size                                   same (byte suffixes ok)
+-i        iterations                                   same
+-o        outstanding requests per batch               same
+-r        requests in flight / reuse address           iterations per print
+-t        client threads                               same
+========  ==========================================  =====================
+
+Modes:
+
+* ``server`` — register -n blocks of -s bytes (file-backed when -f is given,
+  synthetic otherwise) on a PeerTransport BlockServer and wait
+  (UcxPerfBenchmark.scala:156-208).
+* ``client`` — connect, issue -o-deep batches of ``fetch_blocks_by_block_ids``
+  across -t threads, spin ``progress()``, print per-batch bandwidth
+  (UcxPerfBenchmark.scala:100-154, bandwidth print :140-143).
+* ``superstep`` — the TPU-only mode with no reference counterpart: time the
+  collective exchange on the local mesh (what bench.py wraps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from typing import List
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf, parse_size
+from sparkucx_tpu.core.block import BytesBlock, FileBackedBlock, MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus
+from sparkucx_tpu.transport.peer import PeerTransport
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(prog="sparkucx-tpu-perf", description=__doc__.split("\n")[0])
+    p.add_argument("mode", choices=["server", "client", "superstep"])
+    p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
+    p.add_argument("-f", "--file", default=None, help="file to serve blocks from (server)")
+    p.add_argument("-n", "--num-blocks", type=int, default=8)
+    p.add_argument("-s", "--block-size", default="4m")
+    p.add_argument("-i", "--iterations", type=int, default=5)
+    p.add_argument("-o", "--outstanding", type=int, default=8)
+    p.add_argument("-r", "--reports", type=int, default=1, help="batches per bandwidth print")
+    p.add_argument("-t", "--threads", type=int, default=1)
+    p.add_argument("--executors", type=int, default=1, help="mesh size (superstep mode)")
+    return p.parse_args(argv)
+
+
+def run_server(args) -> None:
+    host, _, port = args.address.rpartition(":")
+    size = parse_size(args.block_size)
+    conf = TpuShuffleConf(listener_address=(host or "127.0.0.1", int(port)))
+    transport = PeerTransport(conf, executor_id=0)
+    addr = transport.init()
+    rng = np.random.default_rng(0)
+    for i in range(args.num_blocks):
+        if args.file:
+            block = FileBackedBlock(args.file, offset=(i * size), length=size)
+        else:
+            block = BytesBlock(rng.integers(0, 256, size=size, dtype=np.uint8))
+        transport.register(ShuffleBlockId(0, 0, i), block)
+    print(f"serving {args.num_blocks} x {size} B blocks on {addr.decode()}", flush=True)
+    try:
+        while True:
+            time.sleep(1)  # server threads do the work (UcxPerfBenchmark.scala:204-207)
+    except KeyboardInterrupt:
+        transport.close()
+
+
+def run_client(args) -> None:
+    host, _, port = args.address.rpartition(":")
+    size = parse_size(args.block_size)
+    conf = TpuShuffleConf(max_blocks_per_request=max(args.outstanding, 1))
+    results_lock = threading.Lock()
+    printed: List[str] = []
+
+    def worker(tid: int) -> None:
+        transport = PeerTransport(conf, executor_id=100 + tid)
+        transport.add_executor(0, f"{host or '127.0.0.1'}:{port}".encode())
+        bufs = [MemoryBlock(np.zeros(size, dtype=np.uint8), size=size) for _ in range(args.outstanding)]
+        for it in range(args.iterations):
+            t0 = time.perf_counter()
+            done_bytes = 0
+            for base in range(0, args.num_blocks, args.outstanding):
+                bids = [
+                    ShuffleBlockId(0, 0, (base + k) % args.num_blocks)
+                    for k in range(args.outstanding)
+                ]
+                reqs = transport.fetch_blocks_by_block_ids(
+                    0, bids, bufs[: len(bids)], [None] * len(bids)
+                )
+                while not all(r.completed() for r in reqs):
+                    transport.progress()
+                for r in reqs:
+                    res = r.wait(1)
+                    assert res.status == OperationStatus.SUCCESS, str(res.error)
+                    done_bytes += res.stats.recv_size
+            dt = time.perf_counter() - t0
+            # Mb/s like the reference print (UcxPerfBenchmark.scala:140-143)
+            line = (
+                f"[thread {tid}] iter {it}: {done_bytes} bytes in {dt*1e3:.1f} ms "
+                f"= {done_bytes * 8 / dt / 1e6:.0f} Mb/s ({done_bytes / dt / 1e9:.2f} GB/s)"
+            )
+            with results_lock:
+                printed.append(line)
+                print(line, flush=True)
+        transport.close()
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(args.threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def run_superstep(args) -> None:
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
+
+    size = parse_size(args.block_size)
+    n = args.executors
+    rows_per_peer = max(1, size // 512)
+    send_rows = n * rows_per_peer
+    spec = ExchangeSpec(num_executors=n, send_rows=send_rows, recv_rows=send_rows, lane=128)
+    mesh = make_mesh(n)
+    fn = build_exchange(mesh, spec)
+    rng = np.random.default_rng(0)
+    data = jax.device_put(
+        rng.integers(-100, 100, size=(n * send_rows, 128), dtype=np.int32),
+        NamedSharding(mesh, P("ex", None)),
+    )
+    sizes = jax.device_put(
+        np.full((n, n), rows_per_peer, dtype=np.int32), NamedSharding(mesh, P("ex", None))
+    )
+    out, _ = fn(data, sizes)
+    jax.block_until_ready(out)
+    moved = n * n * rows_per_peer * 512
+    for it in range(args.iterations):
+        t0 = time.perf_counter()
+        cur = out
+        for _ in range(args.outstanding):
+            cur, _ = fn(cur, sizes)
+        jax.block_until_ready(cur)
+        dt = time.perf_counter() - t0
+        out = cur
+        total = moved * args.outstanding
+        print(
+            f"iter {it}: {total} bytes in {dt*1e3:.1f} ms = {total * 8 / dt / 1e6:.0f} Mb/s "
+            f"({total / dt / 1e9:.2f} GB/s) [impl={fn.spec.impl}]",
+            flush=True,
+        )
+
+
+def main(argv=None) -> None:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if args.mode == "server":
+        run_server(args)
+    elif args.mode == "client":
+        run_client(args)
+    else:
+        run_superstep(args)
+
+
+if __name__ == "__main__":
+    main()

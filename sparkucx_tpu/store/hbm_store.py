@@ -77,17 +77,25 @@ class _ShuffleState:
         peer_ranges: List[Tuple[int, int]],
         capacity: int,
         alignment: int,
+        staging: Optional[np.ndarray] = None,
+        staging_closer=None,
     ) -> None:
         self.shuffle_id = shuffle_id
         self.num_mappers = num_mappers
         self.num_reducers = num_reducers
         self.peer_ranges = peer_ranges
         self.alignment = alignment
+        self.staging_closer = staging_closer
         n = len(peer_ranges)
         self.region_size = (capacity // n) // alignment * alignment
         if self.region_size <= 0:
             raise ValueError(f"staging capacity {capacity} too small for {n} regions")
-        self.staging = np.zeros(n * self.region_size, dtype=np.uint8)
+        if staging is not None:
+            if staging.size < n * self.region_size:
+                raise ValueError("provided staging buffer too small")
+            self.staging = staging[: n * self.region_size]
+        else:
+            self.staging = np.zeros(n * self.region_size, dtype=np.uint8)
         self.region_used = np.zeros(n, dtype=np.int64)
         self.blocks: Dict[Tuple[int, int], _BlockEntry] = {}  # (map, reduce) -> entry
         self.committed_maps: set = set()
@@ -196,11 +204,28 @@ class MapWriter:
 class HbmBlockStore:
     """Per-executor staged shuffle store.  See module docstring."""
 
-    def __init__(self, conf: Optional[TpuShuffleConf] = None, device=None) -> None:
+    def __init__(
+        self, conf: Optional[TpuShuffleConf] = None, device=None, executor_id: int = 0
+    ) -> None:
         self.conf = conf or TpuShuffleConf()
         self.device = device
+        self.executor_id = executor_id
         self._shuffles: Dict[int, _ShuffleState] = {}
         self._lock = threading.RLock()
+
+    def _shm_staging(self, shuffle_id: int, nbytes: int):
+        """Shared-memory staging for single-host zero-copy serving
+        (conf.use_shm_staging — the NVKV shared-store analogue)."""
+        from sparkucx_tpu import native
+
+        name = f"/{self.conf.shm_namespace}_e{self.executor_id}_s{shuffle_id}"
+        arena = native.SharedArena(name, nbytes, create=True)
+
+        def closer(_arena=arena):
+            _arena.close()
+            _arena.unlink()
+
+        return arena.array, closer
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -216,23 +241,38 @@ class HbmBlockStore:
             if shuffle_id in self._shuffles:
                 raise TransportError(f"shuffle {shuffle_id} already exists")
             ranges = list(peer_ranges) if peer_ranges is not None else default_peer_ranges(num_reducers, 1)
+            cap = capacity if capacity is not None else self.conf.staging_capacity_per_executor
+            staging, closer = None, None
+            if self.conf.use_shm_staging:
+                n = len(ranges)
+                region = (cap // n) // self.conf.block_alignment * self.conf.block_alignment
+                staging, closer = self._shm_staging(shuffle_id, max(n * region, 1))
             self._shuffles[shuffle_id] = _ShuffleState(
                 shuffle_id,
                 num_mappers,
                 num_reducers,
                 ranges,
-                capacity if capacity is not None else self.conf.staging_capacity_per_executor,
+                cap,
                 self.conf.block_alignment,
+                staging=staging,
+                staging_closer=closer,
             )
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         """unregisterShuffle analogue (UcxShuffleTransport.scala:249-259)."""
         with self._lock:
-            self._shuffles.pop(shuffle_id, None)
+            st = self._shuffles.pop(shuffle_id, None)
+        if st is not None and st.staging_closer is not None:
+            st.staging = None
+            st.staging_closer()
 
     def close(self) -> None:
         with self._lock:
-            self._shuffles.clear()
+            states, self._shuffles = list(self._shuffles.values()), {}
+        for st in states:
+            if st.staging_closer is not None:
+                st.staging = None
+                st.staging_closer()
 
     def _state(self, shuffle_id: int) -> _ShuffleState:
         with self._lock:

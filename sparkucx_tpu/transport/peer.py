@@ -273,7 +273,7 @@ class PeerTransport(ShuffleTransport):
     ) -> None:
         self.conf = conf or TpuShuffleConf()
         self.executor_id = executor_id
-        self.store = store if store is not None else HbmBlockStore(self.conf)
+        self.store = store if store is not None else HbmBlockStore(self.conf, executor_id=executor_id)
         self._registry: Dict[BlockId, Block] = {}
         self._registry_lock = threading.Lock()
         self.server: Optional[BlockServer] = None

@@ -269,7 +269,7 @@ class TpuShuffleTransport(ShuffleTransport):
         self.cluster = cluster
         self.executor_id = executor_id
         self.device = device
-        self.store = HbmBlockStore(cluster.conf, device=device)
+        self.store = HbmBlockStore(cluster.conf, device=device, executor_id=executor_id)
         self._registry: Dict[BlockId, Block] = {}
         self._registry_lock = threading.Lock()
         self._outstanding: List[Request] = []

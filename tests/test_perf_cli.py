@@ -1,0 +1,60 @@
+"""Smoke tests for the perf benchmark CLI (UcxPerfBenchmark analogue)."""
+
+import threading
+import time
+
+import pytest
+
+from sparkucx_tpu.perf import benchmark
+
+
+def test_client_server_roundtrip(capsys):
+    # server in a daemon thread (it loops forever; we only need it serving)
+    srv = threading.Thread(
+        target=benchmark.run_server,
+        args=(benchmark._parse_args(["server", "-a", "127.0.0.1:0", "-n", "4", "-s", "64k"]),),
+        daemon=True,
+    )
+    # run_server binds its own port; to discover it we use a fixed port instead
+    args_srv = benchmark._parse_args(["server", "-a", "127.0.0.1:13979", "-n", "4", "-s", "64k"])
+    srv = threading.Thread(target=benchmark.run_server, args=(args_srv,), daemon=True)
+    srv.start()
+    deadline = time.monotonic() + 10
+    ready = False
+    import socket
+
+    while time.monotonic() < deadline and not ready:
+        try:
+            socket.create_connection(("127.0.0.1", 13979), timeout=0.2).close()
+            ready = True
+        except OSError:
+            time.sleep(0.05)
+    assert ready, "server did not come up"
+    benchmark.run_client(
+        benchmark._parse_args(
+            ["client", "-a", "127.0.0.1:13979", "-n", "4", "-s", "64k", "-i", "2", "-o", "2"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "Mb/s" in out
+    assert out.count("iter") >= 2
+
+
+def test_superstep_mode(capsys):
+    benchmark.run_superstep(
+        benchmark._parse_args(
+            ["superstep", "-s", "64k", "-i", "2", "-o", "2", "--executors", "4"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "impl=dense" in out  # CPU mesh resolves to the portable lowering
+    assert out.count("GB/s") == 2
+
+
+def test_cli_flags_match_reference():
+    # -a/-f/-n/-s/-i/-o/-r/-t (UcxPerfBenchmark.scala:41-59)
+    args = benchmark._parse_args(
+        ["client", "-a", "h:1", "-f", "f", "-n", "2", "-s", "1k", "-i", "3", "-o", "4", "-r", "5", "-t", "6"]
+    )
+    assert (args.address, args.file, args.num_blocks) == ("h:1", "f", 2)
+    assert (args.iterations, args.outstanding, args.reports, args.threads) == (3, 4, 5, 6)
