@@ -1,0 +1,147 @@
+package org.apache.spark.shuffle.tpu;
+
+import java.io.DataInputStream;
+import java.io.DataOutputStream;
+import java.io.IOException;
+import java.net.Socket;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+
+/**
+ * Client for the TPU shuffle daemon protocol (docs/SHIM_PROTOCOL.md).
+ *
+ * Frame layout (little-endian): u32 op | u64 headerLen | u64 bodyLen | header | body.
+ * Control headers are JSON; the batched fetch (op 3/4) uses the binary batch
+ * header of the AM protocol. The Python twin of this class is
+ * sparkucx_tpu.shuffle.daemon.DaemonClient, which is covered by tests.
+ */
+public final class DaemonClient implements AutoCloseable {
+  public static final int OP_CREATE_SHUFFLE = 16;
+  public static final int OP_OPEN_MAP_WRITER = 17;
+  public static final int OP_WRITE_PARTITION = 18;
+  public static final int OP_COMMIT_MAP = 19;
+  public static final int OP_RUN_EXCHANGE = 20;
+  public static final int OP_REMOVE_SHUFFLE = 21;
+  public static final int OP_FETCH = 3;          // AM FetchBlockReq
+  public static final int OP_FETCH_ACK = 4;      // AM FetchBlockReqAck
+
+  private final Socket socket;
+  private final DataOutputStream out;
+  private final DataInputStream in;
+
+  public DaemonClient(String host, int port) throws IOException {
+    this.socket = new Socket(host, port);
+    this.socket.setTcpNoDelay(true);
+    this.out = new DataOutputStream(socket.getOutputStream());
+    this.in = new DataInputStream(socket.getInputStream());
+  }
+
+  private static byte[] le32(int v) {
+    return ByteBuffer.allocate(4).order(ByteOrder.LITTLE_ENDIAN).putInt(v).array();
+  }
+
+  private static byte[] le64(long v) {
+    return ByteBuffer.allocate(8).order(ByteOrder.LITTLE_ENDIAN).putLong(v).array();
+  }
+
+  private synchronized byte[][] call(int op, String jsonHeader, byte[] body) throws IOException {
+    byte[] header = jsonHeader == null ? new byte[0] : jsonHeader.getBytes(StandardCharsets.UTF_8);
+    byte[] payload = body == null ? new byte[0] : body;
+    out.write(le32(op));
+    out.write(le64(header.length));
+    out.write(le64(payload.length));
+    out.write(header);
+    out.write(payload);
+    out.flush();
+    byte[] frameHeader = new byte[20];
+    in.readFully(frameHeader);
+    ByteBuffer bb = ByteBuffer.wrap(frameHeader).order(ByteOrder.LITTLE_ENDIAN);
+    bb.getInt(); // reply op
+    int hlen = (int) bb.getLong();
+    int blen = (int) bb.getLong();
+    byte[] replyHeader = new byte[hlen];
+    byte[] replyBody = new byte[blen];
+    in.readFully(replyHeader);
+    in.readFully(replyBody);
+    return new byte[][] {replyHeader, replyBody};
+  }
+
+  private byte[][] controlCall(int op, String jsonHeader, byte[] body) throws IOException {
+    byte[][] reply = call(op, jsonHeader, body);
+    String ack = new String(reply[0], StandardCharsets.UTF_8);
+    if (!ack.contains("\"ok\": true") && !ack.contains("\"ok\":true")) {
+      throw new IOException("daemon error: " + ack);
+    }
+    return reply;
+  }
+
+  public void createShuffle(int shuffleId, int numMappers, int numReducers) throws IOException {
+    controlCall(OP_CREATE_SHUFFLE,
+        String.format("{\"shuffle_id\": %d, \"num_mappers\": %d, \"num_reducers\": %d}",
+            shuffleId, numMappers, numReducers), null);
+  }
+
+  public int openMapWriter(int shuffleId, int mapId) throws IOException {
+    byte[][] reply = controlCall(OP_OPEN_MAP_WRITER,
+        String.format("{\"shuffle_id\": %d, \"map_id\": %d}", shuffleId, mapId), null);
+    String ack = new String(reply[0], StandardCharsets.UTF_8);
+    int idx = ack.indexOf("\"writer\":");
+    return Integer.parseInt(ack.substring(idx + 9).replaceAll("[^0-9].*$", "").trim());
+  }
+
+  public void writePartition(int writer, int reduceId, byte[] data, int off, int len)
+      throws IOException {
+    byte[] chunk = new byte[len];
+    System.arraycopy(data, off, chunk, 0, len);
+    controlCall(OP_WRITE_PARTITION,
+        String.format("{\"writer\": %d, \"reduce_id\": %d}", writer, reduceId), chunk);
+  }
+
+  public long[] commitMap(int writer) throws IOException {
+    byte[][] reply = controlCall(OP_COMMIT_MAP, String.format("{\"writer\": %d}", writer), null);
+    ByteBuffer bb = ByteBuffer.wrap(reply[1]).order(ByteOrder.LITTLE_ENDIAN);
+    long[] lengths = new long[reply[1].length / 8];
+    for (int i = 0; i < lengths.length; i++) lengths[i] = bb.getLong();
+    return lengths;
+  }
+
+  public void runExchange(int shuffleId) throws IOException {
+    controlCall(OP_RUN_EXCHANGE, String.format("{\"shuffle_id\": %d}", shuffleId), null);
+  }
+
+  /** Batched fetch: returns one byte[] per requested block; null marks a miss. */
+  public byte[][] fetchBlocks(int shuffleId, int[] mapIds, int[] reduceIds) throws IOException {
+    int n = mapIds.length;
+    ByteBuffer req = ByteBuffer.allocate(12 + 12 * n).order(ByteOrder.LITTLE_ENDIAN);
+    req.putLong(0L);           // tag
+    req.putInt(n);             // count
+    for (int i = 0; i < n; i++) {
+      req.putInt(shuffleId).putInt(mapIds[i]).putInt(reduceIds[i]);
+    }
+    byte[][] reply = call(OP_FETCH, null, req.array());
+    ByteBuffer hdr = ByteBuffer.wrap(reply[0]).order(ByteOrder.LITTLE_ENDIAN);
+    hdr.getLong();             // tag echo
+    int count = hdr.getInt();
+    long[] sizes = new long[count];
+    for (int i = 0; i < count; i++) sizes[i] = hdr.getLong();
+    byte[][] blocks = new byte[count][];
+    int pos = 0;
+    for (int i = 0; i < count; i++) {
+      if (sizes[i] < 0) { blocks[i] = null; continue; }
+      blocks[i] = new byte[(int) sizes[i]];
+      System.arraycopy(reply[1], pos, blocks[i], 0, (int) sizes[i]);
+      pos += (int) sizes[i];
+    }
+    return blocks;
+  }
+
+  public void removeShuffle(int shuffleId) throws IOException {
+    controlCall(OP_REMOVE_SHUFFLE, String.format("{\"shuffle_id\": %d}", shuffleId), null);
+  }
+
+  @Override
+  public void close() throws IOException {
+    socket.close();
+  }
+}

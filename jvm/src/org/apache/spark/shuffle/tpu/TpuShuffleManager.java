@@ -1,0 +1,138 @@
+package org.apache.spark.shuffle.tpu;
+
+import java.io.IOException;
+import java.io.OutputStream;
+import java.io.ByteArrayInputStream;
+import java.io.InputStream;
+import java.util.Iterator;
+
+import org.apache.spark.ShuffleDependency;
+import org.apache.spark.SparkConf;
+import org.apache.spark.TaskContext;
+import org.apache.spark.shuffle.ShuffleBlockResolver;
+import org.apache.spark.shuffle.ShuffleHandle;
+import org.apache.spark.shuffle.ShuffleManager;
+import org.apache.spark.shuffle.ShuffleReadMetricsReporter;
+import org.apache.spark.shuffle.ShuffleReader;
+import org.apache.spark.shuffle.ShuffleWriteMetricsReporter;
+import org.apache.spark.shuffle.ShuffleWriter;
+import org.apache.spark.storage.BlockManagerId;
+
+/**
+ * The {@code spark.shuffle.manager} entry point delegating the shuffle data
+ * plane to the TPU runtime daemon (sparkucx_tpu.shuffle.daemon).
+ *
+ * Role parity with the reference plugin (its class is named in
+ * spark.shuffle.manager the same way — compat/spark_3_0/UcxShuffleManager.scala:25):
+ * registerShuffle forwards dimensions to the daemon, getWriter streams partition
+ * bytes over OP_WRITE_PARTITION (the staged-store write path), and getReader
+ * pulls post-exchange blocks with the batched OP_FETCH — the daemon side of all
+ * of these is exercised by tests/test_daemon.py.
+ *
+ * NOTE: compiles against spark-core 3.x (provided); see jvm/README.md. The
+ * generics/SPI surface here intentionally stays minimal — serialization uses the
+ * dependency's serializer exactly as stock Spark writers do.
+ */
+public class TpuShuffleManager implements ShuffleManager {
+  private final SparkConf conf;
+  private volatile DaemonClient client;
+
+  public TpuShuffleManager(SparkConf conf) {
+    this.conf = conf;
+  }
+
+  private DaemonClient daemon() throws IOException {
+    DaemonClient c = client;
+    if (c == null) {
+      synchronized (this) {
+        if (client == null) {
+          String host = conf.get("spark.shuffle.tpu.daemon.host", "127.0.0.1");
+          int port = conf.getInt("spark.shuffle.tpu.daemon.port", 1338);
+          client = new DaemonClient(host, port);
+        }
+        c = client;
+      }
+    }
+    return c;
+  }
+
+  static final class TpuShuffleHandle<K, V, C> extends ShuffleHandle {
+    final ShuffleDependency<K, V, C> dependency;
+    final int numMaps;
+
+    TpuShuffleHandle(int shuffleId, int numMaps, ShuffleDependency<K, V, C> dependency) {
+      super(shuffleId);
+      this.numMaps = numMaps;
+      this.dependency = dependency;
+    }
+  }
+
+  @Override
+  public <K, V, C> ShuffleHandle registerShuffle(
+      int shuffleId, ShuffleDependency<K, V, C> dependency) {
+    try {
+      daemon().createShuffle(
+          shuffleId,
+          dependency.rdd().getNumPartitions(),
+          dependency.partitioner().numPartitions());
+    } catch (IOException e) {
+      throw new RuntimeException("TPU shuffle daemon unreachable", e);
+    }
+    return new TpuShuffleHandle<>(shuffleId, dependency.rdd().getNumPartitions(), dependency);
+  }
+
+  @Override
+  @SuppressWarnings("unchecked")
+  public <K, V> ShuffleWriter<K, V> getWriter(
+      ShuffleHandle handle, long mapId, TaskContext context,
+      ShuffleWriteMetricsReporter metrics) {
+    TpuShuffleHandle<K, V, ?> h = (TpuShuffleHandle<K, V, ?>) handle;
+    try {
+      return new TpuShuffleWriter<>(daemon(), h, (int) mapId, metrics);
+    } catch (IOException e) {
+      throw new RuntimeException(e);
+    }
+  }
+
+  @Override
+  @SuppressWarnings("unchecked")
+  public <K, C> ShuffleReader<K, C> getReader(
+      ShuffleHandle handle, int startMapIndex, int endMapIndex,
+      int startPartition, int endPartition, TaskContext context,
+      ShuffleReadMetricsReporter metrics) {
+    TpuShuffleHandle<K, ?, C> h = (TpuShuffleHandle<K, ?, C>) handle;
+    try {
+      return new TpuShuffleReader<>(daemon(), h, startPartition, endPartition, metrics);
+    } catch (IOException e) {
+      throw new RuntimeException(e);
+    }
+  }
+
+  @Override
+  public boolean unregisterShuffle(int shuffleId) {
+    try {
+      daemon().removeShuffle(shuffleId);
+      return true;
+    } catch (IOException e) {
+      return false;
+    }
+  }
+
+  @Override
+  public ShuffleBlockResolver shuffleBlockResolver() {
+    // Blocks live in the daemon; local disk resolution is never used. Mirrors
+    // the reference disabling readHostLocalDisk (buildlib/test.sh:123).
+    return null;
+  }
+
+  @Override
+  public void stop() {
+    DaemonClient c = client;
+    if (c != null) {
+      try {
+        c.close();
+      } catch (IOException ignored) {
+      }
+    }
+  }
+}

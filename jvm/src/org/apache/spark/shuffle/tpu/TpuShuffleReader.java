@@ -1,0 +1,79 @@
+package org.apache.spark.shuffle.tpu;
+
+import java.io.ByteArrayInputStream;
+import java.io.IOException;
+import java.io.SequenceInputStream;
+import java.util.ArrayList;
+import java.util.Collections;
+import java.util.List;
+
+import org.apache.spark.InterruptibleIterator;
+import org.apache.spark.TaskContext;
+import org.apache.spark.serializer.DeserializationStream;
+import org.apache.spark.serializer.SerializerInstance;
+import org.apache.spark.shuffle.ShuffleReadMetricsReporter;
+import org.apache.spark.shuffle.ShuffleReader;
+
+import scala.Product2;
+import scala.collection.Iterator;
+
+/**
+ * Reduce-side reader: batched OP_FETCH of every (map, reduce) block in
+ * [startPartition, endPartition), then the dependency serializer's
+ * deserialization stream — the reader pipeline of
+ * compat/spark_3_0/UcxShuffleReader.scala:137-199 with the daemon replacing the
+ * ShuffleBlockFetcherIterator + UcxShuffleClient pair. Aggregation/ordering are
+ * left to Spark (the dependency's aggregator runs above the reader in 3.x).
+ */
+public class TpuShuffleReader<K, C> implements ShuffleReader<K, C> {
+  private final DaemonClient daemon;
+  private final TpuShuffleManager.TpuShuffleHandle<K, ?, C> handle;
+  private final int startPartition;
+  private final int endPartition;
+  private final ShuffleReadMetricsReporter metrics;
+
+  public TpuShuffleReader(
+      DaemonClient daemon, TpuShuffleManager.TpuShuffleHandle<K, ?, C> handle,
+      int startPartition, int endPartition, ShuffleReadMetricsReporter metrics) {
+    this.daemon = daemon;
+    this.handle = handle;
+    this.startPartition = startPartition;
+    this.endPartition = endPartition;
+    this.metrics = metrics;
+  }
+
+  @Override
+  @SuppressWarnings("unchecked")
+  public Iterator<Product2<K, C>> read() {
+    try {
+      int numMaps = handle.numMaps;
+      List<ByteArrayInputStream> chunks = new ArrayList<>();
+      long t0 = System.nanoTime();
+      for (int p = startPartition; p < endPartition; p++) {
+        int[] mapIds = new int[numMaps];
+        int[] reduceIds = new int[numMaps];
+        for (int m = 0; m < numMaps; m++) {
+          mapIds[m] = m;
+          reduceIds[m] = p;
+        }
+        byte[][] blocks = daemon.fetchBlocks(handle.shuffleId(), mapIds, reduceIds);
+        for (byte[] b : blocks) {
+          if (b != null && b.length > 0) {
+            chunks.add(new ByteArrayInputStream(b));
+            metrics.incRemoteBytesRead(b.length);
+            metrics.incRemoteBlocksFetched(1);
+          }
+        }
+      }
+      metrics.incFetchWaitTime((System.nanoTime() - t0) / 1_000_000);
+      SerializerInstance ser = handle.dependency.serializer().newInstance();
+      SequenceInputStream all =
+          new SequenceInputStream(Collections.enumeration(chunks));
+      DeserializationStream stream = ser.deserializeStream(all);
+      return (Iterator<Product2<K, C>>) (Iterator<?>)
+          new InterruptibleIterator<>(TaskContext.get(), stream.asKeyValueIterator());
+    } catch (IOException e) {
+      throw new RuntimeException("TPU shuffle fetch failed", e);
+    }
+  }
+}

@@ -1,0 +1,84 @@
+package org.apache.spark.shuffle.tpu;
+
+import java.io.ByteArrayOutputStream;
+import java.io.IOException;
+import java.util.Iterator;
+
+import org.apache.spark.scheduler.MapStatus;
+import org.apache.spark.scheduler.MapStatus$;
+import org.apache.spark.SparkEnv;
+import org.apache.spark.serializer.SerializationStream;
+import org.apache.spark.serializer.SerializerInstance;
+import org.apache.spark.shuffle.ShuffleWriteMetricsReporter;
+import org.apache.spark.shuffle.ShuffleWriter;
+import org.apache.spark.storage.BlockManagerId;
+
+import scala.Option;
+import scala.Product2;
+import scala.collection.JavaConverters;
+
+/**
+ * Map-side writer: partitions records with the dependency's partitioner,
+ * serializes each bucket with the dependency's serializer, and streams buckets
+ * to the daemon in increasing partition order (the staged store enforces the
+ * same sequential protocol the reference writer does,
+ * NvkvShuffleMapOutputWriter.scala:108).
+ */
+public class TpuShuffleWriter<K, V> extends ShuffleWriter<K, V> {
+  private final DaemonClient daemon;
+  private final TpuShuffleManager.TpuShuffleHandle<K, V, ?> handle;
+  private final int mapId;
+  private final ShuffleWriteMetricsReporter metrics;
+  private long[] partitionLengths;
+  private boolean stopped = false;
+
+  public TpuShuffleWriter(
+      DaemonClient daemon, TpuShuffleManager.TpuShuffleHandle<K, V, ?> handle,
+      int mapId, ShuffleWriteMetricsReporter metrics) {
+    this.daemon = daemon;
+    this.handle = handle;
+    this.mapId = mapId;
+    this.metrics = metrics;
+  }
+
+  @Override
+  public void write(scala.collection.Iterator<Product2<K, V>> records) throws IOException {
+    int numPartitions = handle.dependency.partitioner().numPartitions();
+    SerializerInstance ser = handle.dependency.serializer().newInstance();
+
+    // Bucket serialize: one buffer per partition, then ship in ascending order.
+    ByteArrayOutputStream[] buckets = new ByteArrayOutputStream[numPartitions];
+    SerializationStream[] streams = new SerializationStream[numPartitions];
+    Iterator<Product2<K, V>> it = JavaConverters.asJavaIterator(records);
+    while (it.hasNext()) {
+      Product2<K, V> rec = it.next();
+      int p = handle.dependency.partitioner().getPartition(rec._1());
+      if (buckets[p] == null) {
+        buckets[p] = new ByteArrayOutputStream();
+        streams[p] = ser.serializeStream(buckets[p]);
+      }
+      streams[p].writeKey(rec._1(), null);
+      streams[p].writeValue(rec._2(), null);
+      metrics.incRecordsWritten(1);
+    }
+
+    int writer = daemon.openMapWriter(handle.shuffleId(), mapId);
+    for (int p = 0; p < numPartitions; p++) {
+      if (buckets[p] == null) continue;
+      streams[p].close();
+      byte[] data = buckets[p].toByteArray();
+      daemon.writePartition(writer, p, data, 0, data.length);
+      metrics.incBytesWritten(data.length);
+    }
+    partitionLengths = daemon.commitMap(writer);
+  }
+
+  @Override
+  public Option<MapStatus> stop(boolean success) {
+    if (stopped) return Option.empty();
+    stopped = true;
+    if (!success || partitionLengths == null) return Option.empty();
+    BlockManagerId id = SparkEnv.get().blockManager().shuffleServerId();
+    return Option.apply(MapStatus$.MODULE$.apply(id, partitionLengths, mapId));
+  }
+}

@@ -1,0 +1,332 @@
+"""Shuffle daemon — the host-engine boundary (L7 wire side).
+
+The reference preserves Spark compatibility by splitting into a JVM plugin and an
+out-of-repo daemon: the plugin (``spark.shuffle.manager`` =
+``UcxShuffleManager``) speaks AM ids 0-4 to a DPU-side daemon on port 1338
+(CommonUcxShuffleManager.scala:84-89, Definitions.scala:22-29).  This module is
+that daemon, TPU-side: a standalone process hosting a ``TpuShuffleManager`` and
+serving a framed protocol any host engine can speak — the JVM shim under
+``jvm/`` (the ``spark.shuffle.manager`` entry point), the benchmark CLI, or
+tests.
+
+Protocol: the data-plane messages are exactly AM ids 0-4 (handshake, commit,
+fetch — see core/definitions.py and transport/peer.py's BlockServer which serves
+them); shuffle *lifecycle* adds daemon ops >= 16 (the part Spark does through the
+ShuffleManager SPI rather than the wire, so the reference has no AM ids for it):
+
+==================  ==  =======================================================
+CreateShuffle       16  header: json {shuffle_id, num_mappers, num_reducers}
+OpenMapWriter       17  header: json {shuffle_id, map_id} -> writer handle
+WritePartition      18  header: json {writer, reduce_id}; body: bytes (repeat ok)
+CommitMap           19  header: json {writer} -> partition lengths
+RunExchange         20  header: json {shuffle_id}
+FetchBlock           3  AM FetchBlockReq (batched form, peer.py framing)
+RemoveShuffle       21  header: json {shuffle_id}
+Stats               22  header: json {shuffle_id}
+Shutdown            23  —
+==================  ==  =======================================================
+
+Every control op gets an ``Ack`` (id 24) with ``{ok, error?, ...result}``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.definitions import (
+    FRAME_HEADER_SIZE,
+    AmId,
+    pack_frame,
+    unpack_frame_header,
+)
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+from sparkucx_tpu.transport.peer import _recv_exact, _recv_frame, pack_batch_fetch_req, unpack_batch_fetch_req
+import struct
+
+_TAG = struct.Struct("<Q")
+_COUNT = struct.Struct("<I")
+_SIZE = struct.Struct("<q")
+
+
+class DaemonOp:
+    CREATE_SHUFFLE = 16
+    OPEN_MAP_WRITER = 17
+    WRITE_PARTITION = 18
+    COMMIT_MAP = 19
+    RUN_EXCHANGE = 20
+    REMOVE_SHUFFLE = 21
+    STATS = 22
+    SHUTDOWN = 23
+    ACK = 24
+
+
+def _frame(op: int, header: dict, body: bytes = b"") -> bytes:
+    # reuse the AM frame layout with op ids beyond the AM enum
+    payload = json.dumps(header).encode()
+    return struct.pack("<IQQ", op, len(payload), len(body)) + payload + body
+
+
+def _read_frame(sock) -> Optional[Tuple[int, dict, bytes]]:
+    hdr = _recv_exact(sock, FRAME_HEADER_SIZE)
+    if hdr is None:
+        return None
+    op, hlen, blen = struct.unpack("<IQQ", hdr)
+    header = _recv_exact(sock, hlen) if hlen else b""
+    body = _recv_exact(sock, blen) if blen else b""
+    if (hlen and header is None) or (blen and body is None):
+        return None
+    meta = json.loads(header) if header else {}
+    return op, meta, body
+
+
+class ShuffleDaemon:
+    """Hosts a TpuShuffleManager behind the wire protocol."""
+
+    def __init__(
+        self,
+        conf: Optional[TpuShuffleConf] = None,
+        num_executors: Optional[int] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.conf = conf or TpuShuffleConf()
+        self.manager = TpuShuffleManager(self.conf, num_executors=num_executors)
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.address: Tuple[str, int] = self._srv.getsockname()
+        self._running = True
+        self._writers: Dict[int, object] = {}
+        self._streams: Dict[Tuple[int, int], object] = {}
+        self._next_writer = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _ack(self, conn, ok: bool, body: bytes = b"", **extra) -> None:
+        conn.sendall(_frame(DaemonOp.ACK, {"ok": ok, **extra}, body))
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while self._running:
+                frame = _read_frame(conn)
+                if frame is None:
+                    return
+                op, meta, body = frame
+                try:
+                    self._dispatch(conn, op, meta, body)
+                except Exception as e:
+                    self._ack(conn, False, error=f"{type(e).__name__}: {e}")
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _dispatch(self, conn, op: int, meta: dict, body: bytes) -> None:
+        mgr = self.manager
+        if op == DaemonOp.CREATE_SHUFFLE:
+            mgr.register_shuffle(int(meta["shuffle_id"]), int(meta["num_mappers"]), int(meta["num_reducers"]))
+            self._ack(conn, True)
+        elif op == DaemonOp.OPEN_MAP_WRITER:
+            writer = mgr.get_writer(int(meta["shuffle_id"]), int(meta["map_id"]))
+            with self._lock:
+                handle = self._next_writer
+                self._next_writer += 1
+                self._writers[handle] = writer
+            self._ack(conn, True, writer=handle)
+        elif op == DaemonOp.WRITE_PARTITION:
+            handle, reduce_id = int(meta["writer"]), int(meta["reduce_id"])
+            writer = self._writers[handle]
+            key = (handle, reduce_id)
+            stream = self._streams.get(key)
+            if stream is None:
+                # close any open stream of this writer (sequential protocol)
+                for k in [k for k in self._streams if k[0] == handle]:
+                    self._streams.pop(k).close()
+                stream = writer.get_partition_writer(reduce_id).open_stream()
+                self._streams[key] = stream
+            stream.write(body)
+            self._ack(conn, True, written=len(body))
+        elif op == DaemonOp.COMMIT_MAP:
+            handle = int(meta["writer"])
+            for k in [k for k in self._streams if k[0] == handle]:
+                self._streams.pop(k).close()
+            writer = self._writers.pop(handle)
+            lengths = writer.commit_all_partitions()
+            self._ack(conn, True, body=np.asarray(lengths, dtype="<i8").tobytes())
+        elif op == DaemonOp.RUN_EXCHANGE:
+            mgr.run_exchange(int(meta["shuffle_id"]))
+            self._ack(conn, True)
+        elif op == DaemonOp.REMOVE_SHUFFLE:
+            mgr.unregister_shuffle(int(meta["shuffle_id"]))
+            self._ack(conn, True)
+        elif op == DaemonOp.STATS:
+            sid = int(meta["shuffle_id"])
+            meta_obj = mgr.cluster.meta(sid)
+            sizes = {
+                f"{m}": [ln for (_, ln) in info.partitions]
+                for m, info in meta_obj.mapper_infos.items()
+            }
+            self._ack(conn, True, num_mappers=meta_obj.num_mappers,
+                      num_reducers=meta_obj.num_reducers, exchanged=meta_obj.exchanged,
+                      block_lengths=sizes)
+        elif op == int(AmId.FETCH_BLOCK_REQ):
+            # data-plane fetch: batched AM form (binary batch header travels in
+            # the body so the JSON control framing stays uniform)
+            tag, bids = unpack_batch_fetch_req(body)
+            self._serve_fetch(conn, tag, bids)
+        elif op == DaemonOp.SHUTDOWN:
+            self._ack(conn, True)
+            self.close()
+        else:
+            self._ack(conn, False, error=f"unknown op {op}")
+
+    def _serve_fetch(self, conn, tag, bids) -> None:
+        payloads = []
+        for bid in bids:
+            try:
+                meta_obj = self.manager.cluster.meta(bid.shuffle_id)
+                consumer = meta_obj.owner_of_reduce(bid.reduce_id)
+                view, length = self.manager.cluster.locate_received_block(
+                    consumer, bid.shuffle_id, bid.map_id, bid.reduce_id
+                )
+                payloads.append(bytes(view[:length]))
+            except Exception:
+                payloads.append(None)
+        sizes = b"".join(_SIZE.pack(-1 if p is None else len(p)) for p in payloads)
+        reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
+        reply_body = b"".join(p for p in payloads if p is not None)
+        conn.sendall(pack_frame(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, reply_body))
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self.manager.stop()
+
+
+class DaemonClient:
+    """What the JVM shim (jvm/TpuShuffleManager.java) speaks — also usable from
+    Python for tests and tooling."""
+
+    def __init__(self, address: Tuple[str, int]) -> None:
+        self._sock = socket.create_connection(address, timeout=30)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _call(self, op: int, header: dict, body: bytes = b"") -> Tuple[dict, bytes]:
+        with self._lock:
+            self._sock.sendall(_frame(op, header, body))
+            frame = _read_frame(self._sock)
+        if frame is None:
+            raise ConnectionError("daemon closed connection")
+        _, meta, ack_body = frame
+        if not meta.get("ok"):
+            raise RuntimeError(meta.get("error", "daemon error"))
+        return meta, ack_body
+
+    def create_shuffle(self, shuffle_id: int, num_mappers: int, num_reducers: int) -> None:
+        self._call(DaemonOp.CREATE_SHUFFLE, {
+            "shuffle_id": shuffle_id, "num_mappers": num_mappers, "num_reducers": num_reducers,
+        })
+
+    def open_map_writer(self, shuffle_id: int, map_id: int) -> int:
+        meta, _ = self._call(DaemonOp.OPEN_MAP_WRITER, {"shuffle_id": shuffle_id, "map_id": map_id})
+        return int(meta["writer"])
+
+    def write_partition(self, writer: int, reduce_id: int, data: bytes) -> None:
+        self._call(DaemonOp.WRITE_PARTITION, {"writer": writer, "reduce_id": reduce_id}, data)
+
+    def commit_map(self, writer: int) -> np.ndarray:
+        _, body = self._call(DaemonOp.COMMIT_MAP, {"writer": writer})
+        return np.frombuffer(body, dtype="<i8")
+
+    def run_exchange(self, shuffle_id: int) -> None:
+        self._call(DaemonOp.RUN_EXCHANGE, {"shuffle_id": shuffle_id})
+
+    def fetch_blocks(self, block_ids) -> list:
+        """Batched data-plane fetch (AM ids 3/4). Returns list of bytes|None."""
+        with self._lock:
+            self._sock.sendall(
+                struct.pack("<IQQ", int(AmId.FETCH_BLOCK_REQ), 0, len(pack_batch_fetch_req(0, block_ids)))
+                + pack_batch_fetch_req(0, block_ids)
+            )
+            frame = _recv_frame(self._sock)
+        if frame is None:
+            raise ConnectionError("daemon closed connection")
+        _, header, body = frame
+        (count,) = _COUNT.unpack_from(header, _TAG.size)
+        sizes = [
+            _SIZE.unpack_from(header, _TAG.size + _COUNT.size + i * _SIZE.size)[0]
+            for i in range(count)
+        ]
+        out, pos = [], 0
+        for s in sizes:
+            if s < 0:
+                out.append(None)
+            else:
+                out.append(body[pos : pos + s])
+                pos += s
+        return out
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        self._call(DaemonOp.REMOVE_SHUFFLE, {"shuffle_id": shuffle_id})
+
+    def stats(self, shuffle_id: int) -> dict:
+        meta, _ = self._call(DaemonOp.STATS, {"shuffle_id": shuffle_id})
+        return meta
+
+    def shutdown(self) -> None:
+        try:
+            self._call(DaemonOp.SHUTDOWN, {})
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="sparkucx-tpu-daemon")
+    p.add_argument("--port", type=int, default=1338)  # the reference's DPU port
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--executors", type=int, default=1)
+    args = p.parse_args(argv)
+    daemon = ShuffleDaemon(num_executors=args.executors, host=args.host, port=args.port)
+    print(f"shuffle daemon on {daemon.address[0]}:{daemon.address[1]}", flush=True)
+    try:
+        while daemon._running:
+            import time
+
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        daemon.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Tests for the shuffle daemon + client — the JVM-shim protocol surface."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import ShuffleBlockId
+from sparkucx_tpu.shuffle.daemon import DaemonClient, ShuffleDaemon
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    d = ShuffleDaemon(
+        TpuShuffleConf(staging_capacity_per_executor=1 << 20, num_executors=2),
+        num_executors=2,
+    )
+    yield d
+    d.close()
+
+
+@pytest.fixture
+def client(daemon):
+    c = DaemonClient(daemon.address)
+    yield c
+    c.close()
+
+
+class TestDaemonFlow:
+    def test_full_shuffle_through_wire(self, client, rng):
+        M, R, SID = 3, 4, 0
+        client.create_shuffle(SID, M, R)
+        oracle = {}
+        for m in range(M):
+            w = client.open_map_writer(SID, m)
+            for r in range(R):
+                payload = rng.integers(0, 256, size=int(rng.integers(1, 3000)), dtype=np.uint8).tobytes()
+                oracle[(m, r)] = payload
+                # stream in two chunks to exercise repeated WritePartition
+                client.write_partition(w, r, payload[: len(payload) // 2])
+                client.write_partition(w, r, payload[len(payload) // 2 :])
+            lengths = client.commit_map(w)
+            assert lengths.tolist() == [len(oracle[(m, r)]) for r in range(R)]
+        stats = client.stats(SID)
+        assert stats["num_mappers"] == M and not stats["exchanged"]
+        client.run_exchange(SID)
+        assert client.stats(SID)["exchanged"]
+
+        bids = [ShuffleBlockId(SID, m, r) for m in range(M) for r in range(R)]
+        blocks = client.fetch_blocks(bids)
+        for bid, blk in zip(bids, blocks):
+            assert blk == oracle[(bid.map_id, bid.reduce_id)]
+        client.remove_shuffle(SID)
+
+    def test_error_propagation(self, client):
+        with pytest.raises(RuntimeError, match="unknown shuffle|KeyError"):
+            client.run_exchange(777)
+
+    def test_fetch_miss_returns_none(self, client):
+        client.create_shuffle(1, 1, 1)
+        w = client.open_map_writer(1, 0)
+        client.write_partition(w, 0, b"only")
+        client.commit_map(w)
+        client.run_exchange(1)
+        [hit, miss] = client.fetch_blocks([ShuffleBlockId(1, 0, 0), ShuffleBlockId(1, 0, 99)])
+        assert hit == b"only"
+        assert miss is None
+        client.remove_shuffle(1)
+
+    def test_two_clients_one_daemon(self, daemon, rng):
+        # two executor connections writing different maps of one shuffle
+        c1, c2 = DaemonClient(daemon.address), DaemonClient(daemon.address)
+        try:
+            c1.create_shuffle(2, 2, 2)
+            w1 = c1.open_map_writer(2, 0)
+            c1.write_partition(w1, 0, b"from-c1")
+            c1.commit_map(w1)
+            w2 = c2.open_map_writer(2, 1)
+            c2.write_partition(w2, 1, b"from-c2")
+            c2.commit_map(w2)
+            c1.run_exchange(2)
+            [a] = c2.fetch_blocks([ShuffleBlockId(2, 0, 0)])
+            [b] = c1.fetch_blocks([ShuffleBlockId(2, 1, 1)])
+            assert a == b"from-c1" and b == b"from-c2"
+            c1.remove_shuffle(2)
+        finally:
+            c1.close()
+            c2.close()
+
+    def test_unknown_op_acks_error(self, daemon):
+        import socket
+        import struct
+
+        s = socket.create_connection(daemon.address)
+        s.sendall(struct.pack("<IQQ", 99, 2, 0) + b"{}")
+        hdr = b""
+        while len(hdr) < 20:
+            hdr += s.recv(20 - len(hdr))
+        op, hlen, blen = struct.unpack("<IQQ", hdr)
+        payload = b""
+        while len(payload) < hlen:
+            payload += s.recv(hlen - len(payload))
+        assert b'"ok": false' in payload
+        s.close()
