@@ -22,7 +22,7 @@ from __future__ import annotations
 import enum
 import struct
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 
 class AmId(enum.IntEnum):
@@ -67,20 +67,31 @@ class MapperInfo:
     Counterpart of the packed commit blob
     ``{1, numPartitions, mapId, (offset, len) * numPartitions}``
     (NvkvShuffleMapOutputWriter.scala:116-148).  We add shuffle_id explicitly
-    instead of relying on device-space carve-up by shuffleId.
+    instead of relying on device-space carve-up by shuffleId, and an optional
+    per-partition staging-round index (multi-round spill) carried as a
+    backward-compatible tail: blobs without the tail decode with all rounds 0.
     """
 
     shuffle_id: int
     map_id: int
     partitions: Tuple[Tuple[int, int], ...]  # (offset, length) per reduce partition
+    rounds: Optional[Tuple[int, ...]] = None  # staging round per partition
 
     _HDR = struct.Struct("<iii")  # shuffle_id, map_id, num_partitions
     _ENT = struct.Struct("<qq")  # offset, length
+    _RND = struct.Struct("<i")  # round index
+
+    def round_of(self, reduce_id: int) -> int:
+        return self.rounds[reduce_id] if self.rounds is not None else 0
 
     def pack(self) -> bytes:
         out = bytearray(self._HDR.pack(self.shuffle_id, self.map_id, len(self.partitions)))
         for off, ln in self.partitions:
             out += self._ENT.pack(off, ln)
+        if self.rounds is not None and any(self.rounds):
+            out += b"\x01"
+            for r in self.rounds:
+                out += self._RND.pack(r)
         return bytes(out)
 
     @classmethod
@@ -92,4 +103,8 @@ class MapperInfo:
             off, ln = cls._ENT.unpack_from(data, pos)
             offs.append((off, ln))
             pos += cls._ENT.size
-        return cls(sid, mid, tuple(offs))
+        rounds: Optional[Tuple[int, ...]] = None
+        if pos < len(data) and data[pos] == 1:
+            pos += 1
+            rounds = tuple(cls._RND.unpack_from(data, pos + i * cls._RND.size)[0] for i in range(n))
+        return cls(sid, mid, tuple(offs), rounds)

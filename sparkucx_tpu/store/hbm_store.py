@@ -63,9 +63,10 @@ def default_peer_ranges(num_reducers: int, num_peers: int) -> List[Tuple[int, in
 
 @dataclass
 class _BlockEntry:
-    offset: int  # absolute offset in the staging buffer
+    offset: int  # absolute offset in the staging buffer (of its round)
     length: int  # true payload bytes
     padded: int  # bytes including alignment padding
+    round: int = 0  # staging round (multi-round spill; round 0 = common case)
 
 
 class _ShuffleState:
@@ -96,6 +97,13 @@ class _ShuffleState:
             self.staging = staging[: n * self.region_size]
         else:
             self.staging = np.zeros(n * self.region_size, dtype=np.uint8)
+        #: Multi-round spill state: when a region fills, the whole staging epoch
+        #: is snapshotted and writing continues in a fresh round — the exchange
+        #: then runs one collective per round.  This is the data-volume scaling
+        #: the reference windows with maxBlocksPerRequest/numOutstanding
+        #: (SURVEY.md section 5.7) applied to the bulk-synchronous plane.
+        self.round = 0
+        self.prev_rounds: List[Tuple[np.ndarray, np.ndarray]] = []  # (staging, region_used)
         self.region_used = np.zeros(n, dtype=np.int64)
         self.blocks: Dict[Tuple[int, int], _BlockEntry] = {}  # (map, reduce) -> entry
         self.committed_maps: set = set()
@@ -120,16 +128,30 @@ class MapWriter:
     (NvkvShuffleMapOutputWriter.scala:108), a partition's bytes stream in via any
     number of ``write`` calls, and ``close_partition`` pads to alignment and
     records (offset, length) (:236-246).
+
+    Concurrency: streamed bytes buffer writer-locally (the role of the
+    reference's 8 KB pinned write buffer, NvkvHandler.scala:26,213-242) and the
+    region allocate + copy + table record happen atomically at close — so any
+    number of map tasks can write concurrently, and a staging-round rollover can
+    never interleave with a half-written partition.
     """
 
-    def __init__(self, store: "HbmBlockStore", state: _ShuffleState, map_id: int) -> None:
+    def __init__(
+        self, store: "HbmBlockStore", state: _ShuffleState, map_id: int, discard: bool = False
+    ) -> None:
         self._store = store
         self._state = state
         self.map_id = map_id
         self._last_reduce = -1
         self._open_reduce: Optional[int] = None
-        self._open_start: Optional[int] = None
+        self._chunks: List[bytes] = []
         self._written = 0
+        #: First-commit-wins task-retry semantics: when a successful commit for
+        #: this map already exists, the retry attempt's writes are swallowed and
+        #: commit() returns the existing table — the reference's atomic
+        #: check-or-replace protocol (IndexShuffleBlockResolver.scala:161-217:
+        #: "if an existing index is valid, keep it and discard this attempt").
+        self._discard = discard
 
     def open_partition(self, reduce_id: int) -> None:
         if self._open_reduce is not None:
@@ -139,27 +161,21 @@ class MapWriter:
                 f"partitions must be opened in increasing reduce order "
                 f"(got {reduce_id} after {self._last_reduce})"
             )
-        st = self._state
-        peer = st.owner_of(reduce_id)
-        with self._store._lock:
-            self._open_start = peer * st.region_size + int(st.region_used[peer])
+        self._state.owner_of(reduce_id)  # validate range
         self._open_reduce = reduce_id
+        self._chunks = []
         self._written = 0
 
     def write(self, data: bytes) -> None:
         if self._open_reduce is None:
             raise TransportError("no open partition")
-        st = self._state
-        peer = st.owner_of(self._open_reduce)
-        with self._store._lock:
-            pos = self._open_start + self._written
-            end_of_region = (peer + 1) * st.region_size
-            if pos + len(data) > end_of_region:
-                raise TransportError(
-                    f"region overflow: peer {peer} region full writing "
-                    f"({self.map_id},{self._open_reduce}) — raise stagingCapacity"
-                )
-            st.staging[pos : pos + len(data)] = np.frombuffer(data, dtype=np.uint8)
+        if self._written + len(data) > self._state.region_size and not self._discard:
+            raise TransportError(
+                f"single partition ({self.map_id},{self._open_reduce}) exceeds a "
+                f"whole region ({self._state.region_size} B) — raise stagingCapacity"
+            )
+        if not self._discard:
+            self._chunks.append(bytes(data))
         self._written += len(data)
 
     def close_partition(self) -> None:
@@ -168,15 +184,30 @@ class MapWriter:
         st = self._state
         reduce_id = self._open_reduce
         peer = st.owner_of(reduce_id)
-        padded = -(-self._written // st.alignment) * st.alignment
-        with self._store._lock:
-            st.blocks[(self.map_id, reduce_id)] = _BlockEntry(
-                offset=self._open_start, length=self._written, padded=padded
-            )
-            st.region_used[peer] += padded
+        if not self._discard:
+            padded = -(-self._written // st.alignment) * st.alignment
+            with self._store._lock:
+                # Allocate in the current round; roll the staging epoch when the
+                # region can't take this partition (multi-round spill).
+                if int(st.region_used[peer]) + padded > st.region_size:
+                    if st.staging_closer is not None:
+                        raise TransportError(
+                            "region overflow with shm staging — multi-round spill "
+                            "requires private staging; raise stagingCapacity"
+                        )
+                    self._store._rollover(st)
+                start = peer * st.region_size + int(st.region_used[peer])
+                pos = start
+                for chunk in self._chunks:
+                    st.staging[pos : pos + len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+                    pos += len(chunk)
+                st.blocks[(self.map_id, reduce_id)] = _BlockEntry(
+                    offset=start, length=self._written, padded=padded, round=st.round
+                )
+                st.region_used[peer] += padded
         self._last_reduce = reduce_id
         self._open_reduce = None
-        self._open_start = None
+        self._chunks = []
 
     def write_partition(self, reduce_id: int, data: bytes) -> None:
         """Convenience: open + write + close in one call."""
@@ -188,17 +219,26 @@ class MapWriter:
     def commit(self) -> MapperInfo:
         """Commit this map task's outputs — the ``commitAllPartitions`` packing
         (NvkvShuffleMapOutputWriter.scala:116-148).  Returns the MapperInfo blob
-        object the transport ships as AM id 2."""
+        object the transport ships as AM id 2.  For a retry attempt (discard
+        mode) this returns the FIRST successful attempt's table."""
         if self._open_reduce is not None:
             raise TransportError("commit with open partition")
         st = self._state
-        parts = []
+        parts, rounds = [], []
         for r in range(st.num_reducers):
             e = st.blocks.get((self.map_id, r))
             parts.append((e.offset, e.length) if e is not None else (0, 0))
+            rounds.append(e.round if e is not None else 0)
         with self._store._lock:
             st.committed_maps.add(self.map_id)
-        return MapperInfo(st.shuffle_id, self.map_id, tuple(parts))
+        return MapperInfo(
+            st.shuffle_id, self.map_id, tuple(parts),
+            tuple(rounds) if any(rounds) else None,
+        )
+
+    @property
+    def is_retry_discard(self) -> bool:
+        return self._discard
 
 
 class HbmBlockStore:
@@ -281,6 +321,14 @@ class HbmBlockStore:
             raise TransportError(f"unknown shuffle {shuffle_id}")
         return st
 
+    def _rollover(self, st: _ShuffleState) -> None:
+        """Snapshot the current staging epoch and start a fresh round (caller
+        holds self._lock)."""
+        st.prev_rounds.append((st.staging, st.region_used))
+        st.staging = np.zeros_like(st.staging)
+        st.region_used = np.zeros_like(st.region_used)
+        st.round += 1
+
     # -- write path --------------------------------------------------------
 
     def map_writer(self, shuffle_id: int, map_id: int) -> MapWriter:
@@ -289,7 +337,9 @@ class HbmBlockStore:
             raise TransportError(f"shuffle {shuffle_id} already sealed")
         if not (0 <= map_id < st.num_mappers):
             raise ValueError(f"map_id {map_id} out of range [0, {st.num_mappers})")
-        return MapWriter(self, st, map_id)
+        with self._lock:
+            discard = map_id in st.committed_maps  # first commit wins (task retry)
+        return MapWriter(self, st, map_id, discard=discard)
 
     def apply_mapper_info(self, info: MapperInfo) -> None:
         """Install commit metadata received from a peer process (AM id 2 inbound —
@@ -299,7 +349,7 @@ class HbmBlockStore:
             for r, (off, ln) in enumerate(info.partitions):
                 if ln:
                     padded = -(-ln // st.alignment) * st.alignment
-                    st.blocks[(info.map_id, r)] = _BlockEntry(off, ln, padded)
+                    st.blocks[(info.map_id, r)] = _BlockEntry(off, ln, padded, info.round_of(r))
             st.committed_maps.add(info.map_id)
 
     # -- seal + exchange hand-off -----------------------------------------
@@ -307,25 +357,42 @@ class HbmBlockStore:
     def seal(self, shuffle_id: int):
         """Freeze the staging area and stage it into device HBM.
 
-        Returns ``(payload, send_sizes)`` — payload is the full slot-layout
-        staging buffer shaped ``(total_rows, lane)`` int32 where one row is
-        ``alignment`` bytes (the exchange's wire unit; a ``jax.Array`` on
-        ``self.device`` when set, else host ndarray); ``send_sizes[p]`` is the
-        used row count of peer p's region (exchange size-matrix row).
+        Returns a list with one ``(payload, send_sizes)`` entry per staging
+        round (a single entry in the common no-spill case) — payload is that
+        round's slot-layout staging buffer shaped ``(total_rows, lane)`` int32
+        where one row is ``alignment`` bytes (the exchange's wire unit; a
+        ``jax.Array`` on ``self.device`` when set, else host ndarray);
+        ``send_sizes[p]`` is the used row count of peer p's region (the round's
+        exchange size-matrix row).
         """
         st = self._state(shuffle_id)
         with self._lock:
             if st.sealed:
                 raise TransportError(f"shuffle {shuffle_id} already sealed")
             lane = st.alignment // 4
-            payload = st.staging.view(np.int32).reshape(-1, lane)
-            send_sizes = (st.region_used // st.alignment).astype(np.int32)
-            if self.device is not None:
-                import jax
+            rounds = st.prev_rounds + [(st.staging, st.region_used)]
+            out = []
+            # Staging (all rounds) stays host-resident until remove_shuffle — it
+            # is the shuffle's backing store, the same retention contract as
+            # Spark's map-output files on disk.  HBM is only committed one round
+            # at a time: the single-round common case seals straight to device;
+            # multi-round payloads are uploaded per-round by the exchange so
+            # device memory stays bounded by one round.
+            device_put_here = self.device is not None and len(rounds) == 1
+            for staging, used in rounds:
+                payload = staging.view(np.int32).reshape(-1, lane)
+                sizes = (used // st.alignment).astype(np.int32)
+                if device_put_here:
+                    import jax
 
-                payload = jax.device_put(payload, self.device)
-            st.sealed_payload = payload
-        return payload, send_sizes
+                    payload = jax.device_put(payload, self.device)
+                out.append((payload, sizes))
+            st.sealed_payload = [p for p, _ in out]
+        return out
+
+    def num_rounds(self, shuffle_id: int) -> int:
+        st = self._state(shuffle_id)
+        return st.round + 1
 
     def region_slot_rows(self, shuffle_id: int) -> int:
         st = self._state(shuffle_id)
@@ -344,9 +411,10 @@ class HbmBlockStore:
         if e.length == 0:
             return b""
         if st.sealed:
-            payload = np.asarray(st.sealed_payload).reshape(-1).view(np.uint8)
+            payload = np.asarray(st.sealed_payload[e.round]).reshape(-1).view(np.uint8)
             return payload[e.offset : e.offset + e.length].tobytes()
-        return st.staging[e.offset : e.offset + e.length].tobytes()
+        staging = st.staging if e.round == st.round else st.prev_rounds[e.round][0]
+        return staging[e.offset : e.offset + e.length].tobytes()
 
     def block_length(self, shuffle_id: int, map_id: int, reduce_id: int) -> int:
         """getPartitonLength analogue (NvkvHandler.scala:258-265)."""

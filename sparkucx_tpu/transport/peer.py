@@ -50,6 +50,10 @@ from sparkucx_tpu.core.operation import (
 )
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.store.hbm_store import HbmBlockStore
+from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.stats import StatsAggregator
+
+logger = get_logger("transport.peer")
 
 _TAG = struct.Struct("<Q")
 _COUNT = struct.Struct("<I")
@@ -137,6 +141,8 @@ class BlockServer:
             if self.conf.num_io_threads > 1
             else None
         )
+        self._accepted: list = []
+        self._accepted_lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._accept_loop, daemon=True)
             for _ in range(1)
@@ -155,6 +161,8 @@ class BlockServer:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 return
+            with self._accepted_lock:
+                self._accepted.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _read_one(self, bid: ShuffleBlockId) -> Optional[bytes]:
@@ -204,6 +212,11 @@ class BlockServer:
             pass
         finally:
             conn.close()
+            with self._accepted_lock:
+                try:
+                    self._accepted.remove(conn)
+                except ValueError:
+                    pass
 
     def close(self) -> None:
         self._running = False
@@ -211,6 +224,17 @@ class BlockServer:
             self._srv.close()
         except OSError:
             pass
+        with self._accepted_lock:
+            accepted, self._accepted = list(self._accepted), []
+        for conn in accepted:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         if self._io is not None:
             self._io.shutdown(wait=False)
 
@@ -282,7 +306,8 @@ class PeerTransport(ShuffleTransport):
         self._conn_lock = threading.Lock()
         self._next_tag = 0
         self._tag_lock = threading.Lock()
-        self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]]]] = {}
+        self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]], Optional[_PeerConnection]]] = {}
+        self.stats_agg = StatsAggregator() if self.conf.collect_stats else None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -301,7 +326,7 @@ class PeerTransport(ShuffleTransport):
             self._conns.clear()
         for c in conns:
             c.close()
-        for reqs, _, _ in list(self._inflight.values()):
+        for reqs, _, _, _ in list(self._inflight.values()):
             for r in reqs:
                 if not r.completed():
                     r.cancel()
@@ -404,11 +429,26 @@ class PeerTransport(ShuffleTransport):
         with self._tag_lock:
             tag = self._next_tag
             self._next_tag += 1
-            self._inflight[tag] = (reqs, bufs, cbs)
+            self._inflight[tag] = (reqs, bufs, cbs, None)
         try:
             conn = self._connection(executor_id)
+            with self._tag_lock:
+                if tag in self._inflight:
+                    self._inflight[tag] = (reqs, bufs, cbs, conn)
             conn.send(pack_frame(AmId.FETCH_BLOCK_REQ, pack_batch_fetch_req(tag, bids)))
         except (TransportError, OSError) as e:
+            # endpoint failure: evict the cached connection and fail the batch —
+            # the reference's error-handler drop-from-cache path
+            # (UcxShuffleTransport.scala:93-103, UcxWorkerWrapper.scala:248-253),
+            # distinguishing connection reset like its CONNECTION_RESET branch.
+            reset = isinstance(e, (ConnectionResetError, BrokenPipeError))
+            logger.warning(
+                "send to executor %s failed%s: %s",
+                executor_id,
+                " (connection reset)" if reset else "",
+                e,
+            )
+            self._evict(executor_id)
             with self._tag_lock:
                 self._inflight.pop(tag, None)
             err = e if isinstance(e, TransportError) else TransportError(str(e))
@@ -419,9 +459,39 @@ class PeerTransport(ShuffleTransport):
                 if cb is not None:
                     cb(result)
 
+    def _evict(self, executor_id: ExecutorId) -> None:
+        with self._conn_lock:
+            conn = self._conns.pop(executor_id, None)
+        if conn is not None:
+            conn.close()
+            # Other batches still riding this connection will never get acks —
+            # fail them now rather than leaving their reducers spinning.
+            self._fail_conn_inflight([conn])
+
+    def _fail_conn_inflight(self, conns) -> None:
+        with self._tag_lock:
+            doomed = [
+                (tag, entry) for tag, entry in self._inflight.items() if entry[3] in conns
+            ]
+            for tag, _ in doomed:
+                del self._inflight[tag]
+        for tag, (reqs, bufs, cbs, _) in doomed:
+            logger.warning("connection lost with %d in-flight request(s)", len(reqs))
+            err = TransportError("peer connection lost")
+            for req, buf, cb in zip(reqs, bufs, cbs):
+                if req.completed():
+                    continue
+                req.stats.mark_done()
+                result = OperationResult(OperationStatus.FAILURE, error=err, stats=req.stats)
+                req.complete(result)
+                if cb is not None:
+                    cb(result)
+
     def progress(self) -> None:
         """Drain parked ack frames and complete their requests — the explicit
-        progress pump (ShuffleTransport.scala:158-165)."""
+        progress pump (ShuffleTransport.scala:158-165).  Also detects dead
+        connections and fails their in-flight batches (the reference only logs
+        and leaks them, UcxWorkerWrapper.scala:351-353 — we do better)."""
         with self._conn_lock:
             conns = list(self._conns.values())
         for conn in conns:
@@ -430,6 +500,9 @@ class PeerTransport(ShuffleTransport):
                 if frame is None:
                     break
                 self._handle_frame(frame)
+        dead = [c for c in conns if not c.alive]
+        if dead:
+            self._fail_conn_inflight(dead)
 
     def _handle_frame(self, frame: Tuple[AmId, bytes, bytes]) -> None:
         am_id, header, body = frame
@@ -441,7 +514,7 @@ class PeerTransport(ShuffleTransport):
             entry = self._inflight.pop(tag, None)
         if entry is None:
             return
-        reqs, bufs, cbs = entry
+        reqs, bufs, cbs, _conn = entry
         sizes = [
             _SIZE.unpack_from(header, _TAG.size + _COUNT.size + i * _SIZE.size)[0]
             for i in range(count)
@@ -474,6 +547,8 @@ class PeerTransport(ShuffleTransport):
                     buf.size = size
                     req.stats.mark_done(recv_size=size)
                     result = OperationResult(OperationStatus.SUCCESS, stats=req.stats, data=buf)
+                    if self.stats_agg is not None:
+                        self.stats_agg.record("fetch", req.stats)
             req.complete(result)
             if cb is not None:
                 cb(result)

@@ -68,9 +68,10 @@ class _ShuffleMeta:
     map_owner: List[ExecutorId]                      # map task -> executor
     peer_ranges: List[Tuple[int, int]]               # reducer ownership
     mapper_infos: Dict[int, MapperInfo] = field(default_factory=dict)
-    # post-exchange receive state, per executor:
-    recv_shards: Optional[List[np.ndarray]] = None   # uint8 views, tight sender-major
-    recv_sizes: Optional[np.ndarray] = None          # (n, n) elements j<-i
+    # post-exchange receive state, one entry per staging round (multi-round
+    # spill; a single round in the common case), each per executor:
+    recv_shards: Optional[List[List[np.ndarray]]] = None  # [round][executor] uint8
+    recv_sizes: Optional[List[np.ndarray]] = None         # [round] (n, n) rows j<-i
     exchanged: bool = False
 
     def owner_of_reduce(self, reduce_id: int) -> ExecutorId:
@@ -186,38 +187,46 @@ class TpuShuffleCluster:
                 f"exchange before all maps committed ({committed}/{meta.num_mappers})"
             )
 
-        payloads, size_rows = [], []
-        for t in self.transports:
-            payload, sizes = t.store.seal(shuffle_id)
-            payloads.append(payload)
-            size_rows.append(sizes)
-        send_rows, lane = int(payloads[0].shape[0]), int(payloads[0].shape[1])
+        sealed = [t.store.seal(shuffle_id) for t in self.transports]
+        num_rounds = max(len(s) for s in sealed)
+        first_payload = sealed[0][0][0]
+        send_rows, lane = int(first_payload.shape[0]), int(first_payload.shape[1])
         fn = self._exchange_fn(send_rows)
 
         ax = self.conf.mesh_axis_name
         n = self.num_executors
         data_sharding = NamedSharding(self.mesh, P(ax, None))
-        if all(isinstance(p, jax.Array) for p in payloads):
-            # Shards were sealed straight onto their executors' devices — assemble
-            # the global array without any host round-trip.
-            data = jax.make_array_from_single_device_arrays(
-                (n * send_rows, lane), data_sharding, payloads
-            )
-        else:
-            data = jax.device_put(np.concatenate([np.asarray(p) for p in payloads]), data_sharding)
-        size_mat = jax.device_put(
-            np.stack(size_rows).astype(np.int32), NamedSharding(self.mesh, P(ax, None))
-        )
-        recv, recv_sizes = fn(data, size_mat)
-        recv_sizes_host = np.asarray(recv_sizes)
-
-        # One D2H per executor shard; fetches then slice host memory.
-        shard_by_device = {s.device: s.data for s in recv.addressable_shards}
         devices = list(self.mesh.devices.reshape(-1))
-        meta.recv_shards = [
-            np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)
-        ]
-        meta.recv_sizes = recv_sizes_host
+        meta.recv_shards, meta.recv_sizes = [], []
+        for rnd in range(num_rounds):
+            payloads, size_rows = [], []
+            for s in sealed:
+                if rnd < len(s):
+                    payloads.append(s[rnd][0])
+                    size_rows.append(s[rnd][1])
+                else:  # executor had fewer spill rounds: empty contribution
+                    payloads.append(np.zeros((send_rows, lane), dtype=np.int32))
+                    size_rows.append(np.zeros(n, dtype=np.int32))
+            if all(isinstance(p, jax.Array) for p in payloads):
+                # Shards were sealed straight onto their executors' devices —
+                # assemble the global array without any host round-trip.
+                data = jax.make_array_from_single_device_arrays(
+                    (n * send_rows, lane), data_sharding, payloads
+                )
+            else:
+                data = jax.device_put(
+                    np.concatenate([np.asarray(p) for p in payloads]), data_sharding
+                )
+            size_mat = jax.device_put(
+                np.stack(size_rows).astype(np.int32), NamedSharding(self.mesh, P(ax, None))
+            )
+            recv, recv_sizes = fn(data, size_mat)
+            # One D2H per executor shard; fetches then slice host memory.
+            shard_by_device = {s.device: s.data for s in recv.addressable_shards}
+            meta.recv_shards.append(
+                [np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)]
+            )
+            meta.recv_sizes.append(np.asarray(recv_sizes))
         meta.exchanged = True
 
     # -- post-exchange block lookup ---------------------------------------
@@ -245,6 +254,7 @@ class TpuShuffleCluster:
         if info is None:
             raise TransportError(f"map {map_id} never committed")
         abs_offset, length = info.partitions[reduce_id]
+        rnd = info.round_of(reduce_id)
         if length == 0:
             return np.empty(0, dtype=np.uint8), 0
 
@@ -256,8 +266,8 @@ class TpuShuffleCluster:
                 f"block ({shuffle_id},{map_id},{reduce_id}) offset {abs_offset} not in "
                 f"consumer {consumer}'s region"
             )
-        chunk_start = int(meta.recv_sizes[consumer, :sender].sum()) * self.row_bytes
-        shard = meta.recv_shards[consumer]
+        chunk_start = int(meta.recv_sizes[rnd][consumer, :sender].sum()) * self.row_bytes
+        shard = meta.recv_shards[rnd][consumer]
         start = chunk_start + region_rel
         return shard[start : start + length], length
 

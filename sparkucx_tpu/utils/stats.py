@@ -1,0 +1,92 @@
+"""Transport-level stats aggregation.
+
+The reference exposes per-op stats only (``UcxStats``,
+UcxShuffleTransport.scala:36-53) and relies on Spark's shuffle metrics for
+aggregates.  With no Spark UI underneath, this module provides the aggregate
+view: a ``StatsAggregator`` transports feed each completed operation into, with
+latency percentiles and byte totals — what the benchmark prints and what an
+operator would scrape.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from sparkucx_tpu.core.operation import OperationStats
+
+
+@dataclass
+class StatsSummary:
+    ops: int = 0
+    bytes: int = 0
+    total_ns: int = 0
+    min_ns: Optional[int] = None
+    max_ns: Optional[int] = None
+    p50_ns: Optional[int] = None
+    p99_ns: Optional[int] = None
+
+    @property
+    def mean_ns(self) -> float:
+        return self.total_ns / self.ops if self.ops else 0.0
+
+    @property
+    def throughput_gbps(self) -> float:
+        return self.bytes / self.total_ns if self.total_ns else 0.0  # bytes/ns == GB/s
+
+
+class StatsAggregator:
+    """Thread-safe sink for completed OperationStats, bucketed by op kind."""
+
+    _RESERVOIR = 4096
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ops: Dict[str, int] = {}
+        self._bytes: Dict[str, int] = {}
+        self._total_ns: Dict[str, int] = {}
+        self._samples: Dict[str, List[int]] = {}
+
+    def record(self, kind: str, stats: OperationStats) -> None:
+        elapsed = stats.elapsed_ns()
+        with self._lock:
+            self._ops[kind] = self._ops.get(kind, 0) + 1
+            self._bytes[kind] = self._bytes.get(kind, 0) + stats.recv_size
+            self._total_ns[kind] = self._total_ns.get(kind, 0) + elapsed
+            samples = self._samples.setdefault(kind, [])
+            if len(samples) < self._RESERVOIR:
+                samples.append(elapsed)
+            else:  # cheap deterministic reservoir: overwrite round-robin
+                samples[self._ops[kind] % self._RESERVOIR] = elapsed
+
+    def summary(self, kind: str) -> StatsSummary:
+        with self._lock:
+            ops = self._ops.get(kind, 0)
+            if not ops:
+                return StatsSummary()
+            samples = sorted(self._samples.get(kind, []))
+            return StatsSummary(
+                ops=ops,
+                bytes=self._bytes[kind],
+                total_ns=self._total_ns[kind],
+                min_ns=samples[0] if samples else None,
+                max_ns=samples[-1] if samples else None,
+                p50_ns=samples[len(samples) // 2] if samples else None,
+                p99_ns=samples[min(len(samples) - 1, int(len(samples) * 0.99))] if samples else None,
+            )
+
+    def kinds(self) -> List[str]:
+        with self._lock:
+            return sorted(self._ops)
+
+    def report(self) -> str:
+        lines = []
+        for kind in self.kinds():
+            s = self.summary(kind)
+            lines.append(
+                f"{kind}: ops={s.ops} bytes={s.bytes} mean={s.mean_ns/1e3:.1f}us "
+                f"p50={0 if s.p50_ns is None else s.p50_ns/1e3:.1f}us "
+                f"p99={0 if s.p99_ns is None else s.p99_ns/1e3:.1f}us"
+            )
+        return "\n".join(lines)

@@ -1,0 +1,187 @@
+"""Tests for aux subsystems: task-retry commit semantics, endpoint failure
+handling, logging, and stats aggregation (SURVEY.md section 5 parity)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import BytesBlock, MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStats, OperationStatus
+from sparkucx_tpu.store.hbm_store import HbmBlockStore
+from sparkucx_tpu.transport.peer import PeerTransport
+from sparkucx_tpu.utils.stats import StatsAggregator
+
+
+def _buf(n):
+    return MemoryBlock(np.zeros(n, dtype=np.uint8), size=n)
+
+
+class TestTaskRetryCommit:
+    """First-commit-wins (IndexShuffleBlockResolver.scala:161-217 semantics)."""
+
+    def test_retry_after_commit_is_discarded(self):
+        store = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=1 << 18))
+        store.create_shuffle(0, 1, 2)
+        w1 = store.map_writer(0, 0)
+        w1.write_partition(0, b"first-attempt")
+        info1 = w1.commit()
+
+        # speculative/retried task re-runs the same map
+        w2 = store.map_writer(0, 0)
+        assert w2.is_retry_discard
+        w2.write_partition(0, b"second-attempt-different")
+        info2 = w2.commit()
+
+        assert info2 == info1  # retry returns the original table
+        assert store.read_block(0, 0, 0) == b"first-attempt"
+        # no extra space consumed by the discarded attempt
+        assert store.stats(0)["bytes_staged"] == len(b"first-attempt")
+
+    def test_uncommitted_rewrite_not_discarded(self):
+        # A writer that never committed doesn't poison the map: a second writer
+        # (e.g. after task crash before commit) writes normally.
+        store = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=1 << 18))
+        store.create_shuffle(0, 1, 1)
+        w1 = store.map_writer(0, 0)
+        w1.write_partition(0, b"crashed")
+        # no commit — task died
+        w2 = store.map_writer(0, 0)
+        assert not w2.is_retry_discard
+        w2.write_partition(0, b"retried")
+        w2.commit()
+        assert store.read_block(0, 0, 0) == b"retried"
+
+
+class TestEndpointFailure:
+    def test_dead_server_fails_inflight_requests(self):
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 18)
+        a = PeerTransport(conf, executor_id=1)
+        b = PeerTransport(conf, executor_id=2)
+        addr_b = b.init()
+        a.init()
+        a.add_executor(2, addr_b)
+        b.register(ShuffleBlockId(0, 0, 0), BytesBlock(b"x"))
+        # establish the connection, then kill the server before fetch completes
+        a.pre_connect()
+        b.close()
+        time.sleep(0.1)
+        [req] = a.fetch_blocks_by_block_ids(2, [ShuffleBlockId(0, 0, 0)], [_buf(8)], [None])
+        deadline = time.monotonic() + 5
+        while not req.completed() and time.monotonic() < deadline:
+            a.progress()
+            time.sleep(0.01)
+        res = req.wait(1)
+        assert res.status == OperationStatus.FAILURE
+        a.close()
+
+    def test_evict_fails_sibling_inflight_batches(self):
+        # A send failure evicting the connection must also fail batches already
+        # in flight on it — not leave them hanging (code-review regression).
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 18, max_blocks_per_request=1)
+        a = PeerTransport(conf, executor_id=1)
+        b = PeerTransport(conf, executor_id=2)
+        addr_b = b.init()
+        a.init()
+        a.add_executor(2, addr_b)
+        a.pre_connect()
+        conn = a._connection(2)
+        # plant a fake in-flight batch riding this connection
+        from sparkucx_tpu.core.operation import Request
+
+        req = Request(OperationStats())
+        with a._tag_lock:
+            a._inflight[999] = ([req], [_buf(8)], [None], conn)
+        a._evict(2)
+        assert req.completed()
+        assert req.wait(1).status == OperationStatus.FAILURE
+        a.close()
+        b.close()
+
+    def test_send_to_never_started_server_fails_cleanly(self):
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 18)
+        a = PeerTransport(conf, executor_id=1)
+        a.init()
+        a.add_executor(9, b"127.0.0.1:1")  # nothing listens on port 1
+        [req] = a.fetch_blocks_by_block_ids(9, [ShuffleBlockId(0, 0, 0)], [_buf(8)], [None])
+        assert req.wait(2).status == OperationStatus.FAILURE
+        a.close()
+
+
+class TestConcurrentWriters:
+    def test_parallel_maps_one_region(self):
+        # Many map tasks streaming into the same peer region concurrently: the
+        # close-time atomic allocation must keep every block intact.
+        import threading
+
+        store = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=1 << 22))
+        store.create_shuffle(0, 16, 1)
+        payloads = {m: bytes([m + 1]) * (500 + 37 * m) for m in range(16)}
+        errors = []
+
+        def run(m):
+            try:
+                w = store.map_writer(0, m)
+                w.open_partition(0)
+                data = payloads[m]
+                for i in range(0, len(data), 100):  # streamed in small chunks
+                    w.write(data[i : i + 100])
+                w.close_partition()
+                w.commit()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(m,)) for m in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        for m in range(16):
+            assert store.read_block(0, m, 0) == payloads[m]
+
+
+class TestStatsAggregator:
+    def test_record_and_summary(self):
+        agg = StatsAggregator()
+        for size in (100, 200, 300):
+            s = OperationStats()
+            s.mark_done(recv_size=size)
+            agg.record("fetch", s)
+        summary = agg.summary("fetch")
+        assert summary.ops == 3
+        assert summary.bytes == 600
+        assert summary.p50_ns is not None
+        assert "fetch" in agg.report()
+
+    def test_empty_kind(self):
+        agg = StatsAggregator()
+        assert agg.summary("nope").ops == 0
+
+    def test_peer_transport_records_fetch_stats(self):
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 18)
+        a = PeerTransport(conf, executor_id=1)
+        b = PeerTransport(conf, executor_id=2)
+        a.init()
+        addr_b = b.init()
+        a.add_executor(2, addr_b)
+        b.register(ShuffleBlockId(0, 0, 0), BytesBlock(b"stats-me"))
+        [req] = a.fetch_blocks_by_block_ids(2, [ShuffleBlockId(0, 0, 0)], [_buf(64)], [None])
+        deadline = time.monotonic() + 5
+        while not req.completed() and time.monotonic() < deadline:
+            a.progress()
+            time.sleep(0.001)
+        assert req.wait(1).status == OperationStatus.SUCCESS
+        assert a.stats_agg.summary("fetch").ops == 1
+        assert a.stats_agg.summary("fetch").bytes == 8
+        a.close()
+        b.close()
+
+
+class TestLogging:
+    def test_get_logger_namespaced(self):
+        from sparkucx_tpu.utils.logging import get_logger
+
+        log = get_logger("test.module")
+        assert log.name == "sparkucx_tpu.test.module"

@@ -69,13 +69,32 @@ class TestWriteReadback:
         with pytest.raises(TransportError, match="still open"):
             w.open_partition(1)
 
-    def test_region_overflow(self):
+    def test_partition_exceeding_region_rejected(self):
         s = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=4096, block_alignment=ALIGN))
         s.create_shuffle(0, 1, 2, peer_ranges=default_peer_ranges(2, 2))
         w = s.map_writer(0, 0)
         w.open_partition(0)
-        with pytest.raises(TransportError, match="region overflow"):
+        with pytest.raises(TransportError, match="exceeds a whole region"):
             w.write(b"x" * 4096)
+
+    def test_region_overflow_rolls_over(self):
+        # Overflow across partitions spills into a new staging round instead of
+        # erroring (multi-round exchange).
+        s = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=4096, block_alignment=ALIGN))
+        s.create_shuffle(1, 2, 2, peer_ranges=default_peer_ranges(2, 2))
+        region = s._state(1).region_size
+        wa = s.map_writer(1, 0)
+        wa.write_partition(0, b"a" * region)
+        wa.commit()
+        wb = s.map_writer(1, 1)
+        wb.write_partition(0, b"c" * 100)  # peer-0 region full -> round 1
+        wb.commit()
+        assert s.num_rounds(1) == 2
+        assert s.read_block(1, 0, 0) == b"a" * region
+        assert s.read_block(1, 1, 0) == b"c" * 100
+        st = s._state(1)
+        assert st.blocks[(0, 0)].round == 0
+        assert st.blocks[(1, 0)].round == 1
 
     def test_empty_partition(self, store):
         store.create_shuffle(4, 1, 2)
@@ -158,7 +177,7 @@ class TestCommitAndSeal:
         w = store.map_writer(0, 0)
         w.write_partition(0, b"A" * 100)
         w.write_partition(2, b"B" * 300)
-        payload, sizes = store.seal(0)
+        [(payload, sizes)] = store.seal(0)  # single round
         st = store._state(0)
         assert payload.dtype == np.int32
         assert payload.shape[1] == ALIGN // 4  # one row per alignment unit

@@ -125,6 +125,43 @@ class TestEndToEndShuffle:
         assert req.wait(1).status == OperationStatus.FAILURE
 
 
+class TestMultiRound:
+    def test_spill_shuffle_end_to_end(self, rng):
+        # Staging deliberately too small for one round: data spills across
+        # multiple collective rounds and every block still arrives intact.
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=N_EXEC * 4096,  # 4 KiB per peer region
+            block_alignment=128,
+            num_executors=N_EXEC,
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        M, R = 3 * N_EXEC, 8  # 3 maps/executor x 2 KiB padded blocks > 4 KiB regions
+        meta = cluster.create_shuffle(0, M, R)
+        oracle = {}
+        for m in range(M):
+            t = cluster.transport(meta.map_owner[m])
+            w = t.store.map_writer(0, m)
+            for r in range(R):
+                payload = rng.integers(0, 256, size=2000, dtype=np.uint8).tobytes()
+                oracle[(m, r)] = payload
+                w.write_partition(r, payload)
+            t.commit_block(w.commit().pack())
+        rounds = max(t.store.num_rounds(0) for t in cluster.transports)
+        assert rounds > 1, "test should actually spill"
+        cluster.run_exchange(0)
+        for r in range(R):
+            consumer = meta.owner_of_reduce(r)
+            t = cluster.transport(consumer)
+            bufs = [_buf(4096) for _ in range(M)]
+            reqs = t.fetch_blocks_by_block_ids(
+                consumer, [ShuffleBlockId(0, m, r) for m in range(M)], bufs, [None] * M
+            )
+            for m in range(M):
+                res = reqs[m].wait(5)
+                assert res.status == OperationStatus.SUCCESS, str(res.error)
+                assert bufs[m].host_view()[: bufs[m].size].tobytes() == oracle[(m, r)]
+
+
 class TestPullFallback:
     def test_fetch_block_from_peer_store(self, cluster, rng):
         # The straggler path: read a peer's staged block directly, pre-exchange.
