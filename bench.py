@@ -36,9 +36,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-SEND_ROWS = int(os.environ.get("BENCH_SEND_ROWS", str(256 * 1024)))  # x512B = 128 MiB staged
+SEND_ROWS = int(os.environ.get("BENCH_SEND_ROWS", str(1024 * 1024)))  # x512B = 512 MiB staged
 FILL = float(os.environ.get("BENCH_FILL", "0.9"))
-CHAIN = int(os.environ.get("BENCH_CHAIN", "8"))
+CHAIN = int(os.environ.get("BENCH_CHAIN", "64"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 TCP_BYTES = int(os.environ.get("BENCH_TCP_BYTES", str(256 << 20)))
 

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""GroupByTest-style integration driver — the ``buildlib/test.sh`` workload analogue.
+
+The reference's integration gate runs stock Spark examples (GroupByTest, SparkTC)
+on a real 2-executor standalone cluster (test.sh:163-179).  This driver runs the
+same shape against the real process topology of this framework:
+
+1. start the shuffle daemon (the TPU runtime process),
+2. spawn EXECUTORS separate *mapper processes*, each writing its map tasks'
+   partitioned (key, value) records over the daemon wire protocol,
+3. run the collective exchange,
+4. spawn separate *reducer processes* that fetch, aggregate, and report per-key
+   sums,
+5. verify the union of reducer outputs against a single-process oracle.
+
+Exit code 0 = pass.  Knobs via env (test.sh style): EXECUTORS, MAPPERS,
+REDUCERS, PAIRS_PER_MAP.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXECUTORS = int(os.environ.get("EXECUTORS", "2"))
+MAPPERS = int(os.environ.get("MAPPERS", "4"))
+REDUCERS = int(os.environ.get("REDUCERS", "8"))
+PAIRS = int(os.environ.get("PAIRS_PER_MAP", "5000"))
+SHUFFLE_ID = 0
+
+MAPPER_SCRIPT = """
+import os, pickle, sys
+sys.path.insert(0, {root!r})
+from sparkucx_tpu.shuffle.daemon import DaemonClient
+from sparkucx_tpu.shuffle.reader import serialize_records
+import numpy as np
+
+host, port, map_ids = sys.argv[1], int(sys.argv[2]), [int(x) for x in sys.argv[3].split(",")]
+R, PAIRS = int(sys.argv[4]), int(sys.argv[5])
+client = DaemonClient((host, port))
+for m in map_ids:
+    rng = np.random.default_rng(1000 + m)  # deterministic per map (oracle twin)
+    records = [(int(rng.integers(0, 100)), 1) for _ in range(PAIRS)]
+    by_part = {{}}
+    for k, v in records:
+        by_part.setdefault(k % R, []).append((k, v))
+    w = client.open_map_writer({sid}, m)
+    for r in sorted(by_part):
+        client.write_partition(w, r, serialize_records(by_part[r]))
+    client.commit_map(w)
+client.close()
+print("mapper done", map_ids)
+"""
+
+REDUCER_SCRIPT = """
+import json, os, pickle, sys
+sys.path.insert(0, {root!r})
+from sparkucx_tpu.core.block import ShuffleBlockId
+from sparkucx_tpu.shuffle.daemon import DaemonClient
+from sparkucx_tpu.shuffle.reader import default_deserializer
+
+host, port = sys.argv[1], int(sys.argv[2])
+partitions = [int(x) for x in sys.argv[3].split(",")]
+M = int(sys.argv[4])
+client = DaemonClient((host, port))
+counts = {{}}
+for r in partitions:
+    blocks = client.fetch_blocks([ShuffleBlockId({sid}, m, r) for m in range(M)])
+    for blk in blocks:
+        if not blk:
+            continue
+        for k, v in default_deserializer(blk):
+            counts[k] = counts.get(k, 0) + v
+client.close()
+print("REDUCER_RESULT " + json.dumps(counts))
+"""
+
+
+def oracle():
+    import numpy as np
+
+    counts = {}
+    for m in range(MAPPERS):
+        rng = np.random.default_rng(1000 + m)
+        for _ in range(PAIRS):
+            k = int(rng.integers(0, 100))
+            counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def main() -> int:
+    env = dict(os.environ)
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "sparkucx_tpu.shuffle.daemon", "--port", "0",
+         "--executors", str(EXECUTORS)],
+        stdout=subprocess.PIPE, text=True, cwd=ROOT, env=env,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        host = port = None
+        while time.monotonic() < deadline:
+            line = daemon.stdout.readline().strip()
+            if "shuffle daemon on " in line:
+                host, port = line.rsplit(" ", 1)[-1].split(":")
+                break
+        if host is None:
+            print("[integration] FAIL: daemon did not report its address")
+            return 1
+        print(f"[integration] daemon on {host}:{port}")
+
+        from sparkucx_tpu.shuffle.daemon import DaemonClient
+
+        ctl = DaemonClient((host, int(port)))
+        ctl.create_shuffle(SHUFFLE_ID, MAPPERS, REDUCERS)
+
+        # mapper processes (maps split round-robin over executor processes)
+        mappers = []
+        for e in range(EXECUTORS):
+            mine = [str(m) for m in range(MAPPERS) if m % EXECUTORS == e]
+            if not mine:
+                continue
+            script = MAPPER_SCRIPT.format(root=ROOT, sid=SHUFFLE_ID)
+            mappers.append(subprocess.Popen(
+                [sys.executable, "-c", script, host, port, ",".join(mine),
+                 str(REDUCERS), str(PAIRS)],
+                cwd=ROOT, env=env,
+            ))
+        for p in mappers:
+            if p.wait(timeout=300) != 0:
+                print("[integration] FAIL: mapper exited nonzero")
+                return 1
+
+        ctl.run_exchange(SHUFFLE_ID)
+        print("[integration] exchange complete")
+
+        # reducer processes (partitions split contiguously like peer ranges)
+        per = -(-REDUCERS // EXECUTORS)
+        reducers = []
+        for e in range(EXECUTORS):
+            mine = [str(r) for r in range(e * per, min((e + 1) * per, REDUCERS))]
+            if not mine:
+                continue
+            script = REDUCER_SCRIPT.format(root=ROOT, sid=SHUFFLE_ID)
+            reducers.append(subprocess.Popen(
+                [sys.executable, "-c", script, host, port, ",".join(mine), str(MAPPERS)],
+                stdout=subprocess.PIPE, text=True, cwd=ROOT, env=env,
+            ))
+        got = {}
+        for p in reducers:
+            out, _ = p.communicate(timeout=300)
+            if p.returncode != 0:
+                print("[integration] FAIL: reducer exited nonzero")
+                return 1
+            for line in out.splitlines():
+                if line.startswith("REDUCER_RESULT "):
+                    for k, v in json.loads(line[len("REDUCER_RESULT "):]).items():
+                        got[int(k)] = got.get(int(k), 0) + v
+
+        expected = oracle()
+        if got != expected:
+            missing = {k: v for k, v in expected.items() if got.get(k) != v}
+            print(f"[integration] FAIL: result mismatch ({len(missing)} keys differ)")
+            return 1
+        total = sum(got.values())
+        print(f"[integration] PASS: {MAPPERS} maps x {PAIRS} pairs -> "
+              f"{len(got)} keys, {total} records, {EXECUTORS} executor processes")
+        ctl.remove_shuffle(SHUFFLE_ID)
+        ctl.shutdown()
+        return 0
+    finally:
+        daemon.terminate()
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Integration harness — the buildlib/test.sh analogue.
+#
+# The reference boots a standalone Spark cluster and runs GroupByTest twice
+# (small + big) plus SparkTC as the gate (test.sh:163-196).  Here the same
+# gate shape runs against this framework's real process topology: a shuffle
+# daemon + separate mapper/reducer processes over the wire protocol.
+#
+# Env knobs (test.sh style): EXECUTORS, MAPPERS, REDUCERS, PAIRS_PER_MAP.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# Force the portable CPU mesh regardless of any backend the ambient env pins
+# (set SPARKUCX_INTEG_PLATFORM to run against real hardware).
+export JAX_PLATFORMS="${SPARKUCX_INTEG_PLATFORM:-cpu}"
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+run_groupby_test() {  # test.sh:163-167 (GroupByTest 100 100)
+  EXECUTORS=2 MAPPERS=4 REDUCERS=8 PAIRS_PER_MAP=5000 \
+    python scripts/integration_groupby.py
+}
+
+run_big_test() {      # test.sh:169-173 (GroupByTest 200 5000 ...)
+  EXECUTORS=4 MAPPERS=16 REDUCERS=32 PAIRS_PER_MAP=20000 \
+    python scripts/integration_groupby.py
+}
+
+echo "== groupby test =="
+run_groupby_test
+echo "== big test =="
+run_big_test
+echo "ALL INTEGRATION TESTS PASSED"

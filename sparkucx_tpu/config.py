@@ -95,8 +95,6 @@ class TpuShuffleConf:
     # TPU mesh (L2)
     mesh_axis_name: str = "ex"
     num_executors: int = 1
-    exchange_dtype: str = "uint8"
-    use_pallas_exchange: bool = False
 
     # instrumentation
     collect_stats: bool = True
@@ -151,7 +149,6 @@ class TpuShuffleConf:
             ("shmNamespace", "shm_namespace", str),
             ("numExecutors", "num_executors", int),
             ("meshAxisName", "mesh_axis_name", str),
-            ("usePallasExchange", "use_pallas_exchange", lambda v: str(v).lower() == "true"),
         ]:
             v = get(name)
             if v is not None:

@@ -43,6 +43,20 @@ class TopologyInfo:
         return self.process_count > 1
 
 
+def apply_platform_env() -> None:
+    """Make the ``JAX_PLATFORMS`` env var effective even when a site hook pinned
+    ``jax_platforms`` via ``jax.config`` at interpreter start (observed with
+    vendor PJRT plugins: the hook's config.update overrides the env var).  Call
+    before first backend use in entry-point processes (daemon, CLIs)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    if jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)
+
+
 def discover_topology() -> TopologyInfo:
     import jax
 

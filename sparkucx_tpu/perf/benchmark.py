@@ -128,6 +128,9 @@ def run_client(args) -> None:
 
 
 def run_superstep(args) -> None:
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 

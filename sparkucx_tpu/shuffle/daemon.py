@@ -312,6 +312,9 @@ class DaemonClient:
 def main(argv=None) -> None:
     import argparse
 
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
     p = argparse.ArgumentParser(prog="sparkucx-tpu-daemon")
     p.add_argument("--port", type=int, default=1338)  # the reference's DPU port
     p.add_argument("--host", default="127.0.0.1")

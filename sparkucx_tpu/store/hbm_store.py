@@ -251,6 +251,9 @@ class HbmBlockStore:
         self.device = device
         self.executor_id = executor_id
         self._shuffles: Dict[int, _ShuffleState] = {}
+        # Commits that raced ahead of create_shuffle (a peer's MapperInfo can
+        # arrive before this process registers the shuffle); applied at creation.
+        self._pending_infos: Dict[int, List[MapperInfo]] = {}
         self._lock = threading.RLock()
 
     def _shm_staging(self, shuffle_id: int, nbytes: int):
@@ -297,6 +300,9 @@ class HbmBlockStore:
                 staging=staging,
                 staging_closer=closer,
             )
+            pending = self._pending_infos.pop(shuffle_id, [])
+        for info in pending:
+            self.apply_mapper_info(info)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         """unregisterShuffle analogue (UcxShuffleTransport.scala:249-259)."""
@@ -343,7 +349,12 @@ class HbmBlockStore:
 
     def apply_mapper_info(self, info: MapperInfo) -> None:
         """Install commit metadata received from a peer process (AM id 2 inbound —
-        what the DPU daemon does with MapperInfo)."""
+        what the DPU daemon does with MapperInfo).  Commits for a shuffle this
+        process hasn't created yet are queued and applied at creation."""
+        with self._lock:
+            if info.shuffle_id not in self._shuffles:
+                self._pending_infos.setdefault(info.shuffle_id, []).append(info)
+                return
         st = self._state(info.shuffle_id)
         with self._lock:
             for r, (off, ln) in enumerate(info.partitions):

@@ -301,9 +301,15 @@ class PeerTransport(ShuffleTransport):
         self._registry: Dict[BlockId, Block] = {}
         self._registry_lock = threading.Lock()
         self.server: Optional[BlockServer] = None
-        self._conns: Dict[ExecutorId, _PeerConnection] = {}
+        # Connection cache keyed by (executor, slot): callers map onto
+        # num_client_workers parallel connections per peer by thread identity —
+        # the reference's thread->worker routing ``threadId % numWorkers``
+        # (UcxShuffleTransport.scala:277-279, UcxShuffleConf.scala:80-86).
+        self._conns: Dict[Tuple[ExecutorId, int], _PeerConnection] = {}
         self._conn_addrs: Dict[ExecutorId, Tuple[str, int]] = {}
         self._conn_lock = threading.Lock()
+        self._slot_local = threading.local()
+        self._slot_rr = 0
         self._next_tag = 0
         self._tag_lock = threading.Lock()
         self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]], Optional[_PeerConnection]]] = {}
@@ -345,20 +351,33 @@ class PeerTransport(ShuffleTransport):
     def remove_executor(self, executor_id: ExecutorId) -> None:
         with self._conn_lock:
             self._conn_addrs.pop(executor_id, None)
-            conn = self._conns.pop(executor_id, None)
-        if conn is not None:
+            doomed = [k for k in self._conns if k[0] == executor_id]
+            conns = [self._conns.pop(k) for k in doomed]
+        for conn in conns:
             conn.close()
+
+    def _slot(self) -> int:
+        # Round-robin threads onto worker slots via a thread-local (raw thread
+        # idents are pointer-aligned, so ident % n would collapse onto slot 0).
+        slot = getattr(self._slot_local, "slot", None)
+        if slot is None:
+            with self._tag_lock:
+                slot = self._slot_rr % max(1, self.conf.num_client_workers)
+                self._slot_rr += 1
+            self._slot_local.slot = slot
+        return slot
 
     def pre_connect(self) -> None:
         """Eager connection establishment (UcxExecutorRpcEndpoint.scala:19-39)."""
         with self._conn_lock:
-            missing = [e for e in self._conn_addrs if e not in self._conns]
+            missing = [e for e in self._conn_addrs if (e, self._slot()) not in self._conns]
         for eid in missing:
             self._connection(eid)
 
     def _connection(self, executor_id: ExecutorId) -> _PeerConnection:
+        key = (executor_id, self._slot())
         with self._conn_lock:
-            conn = self._conns.get(executor_id)
+            conn = self._conns.get(key)
             if conn is not None and conn.alive:
                 return conn
             addr = self._conn_addrs.get(executor_id)
@@ -366,7 +385,7 @@ class PeerTransport(ShuffleTransport):
                 raise TransportError(f"unknown executor {executor_id}")
         conn = _PeerConnection(addr)
         with self._conn_lock:
-            self._conns[executor_id] = conn
+            self._conns[key] = conn
         return conn
 
     # -- server side -------------------------------------------------------
@@ -460,8 +479,9 @@ class PeerTransport(ShuffleTransport):
                     cb(result)
 
     def _evict(self, executor_id: ExecutorId) -> None:
+        key = (executor_id, self._slot())
         with self._conn_lock:
-            conn = self._conns.pop(executor_id, None)
+            conn = self._conns.pop(key, None)
         if conn is not None:
             conn.close()
             # Other batches still riding this connection will never get acks —

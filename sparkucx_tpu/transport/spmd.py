@@ -1,0 +1,249 @@
+"""Multi-controller (SPMD) shuffle executor — the multi-host data plane.
+
+``TpuShuffleCluster`` (transport/tpu.py) drives all executors from one
+controller — right for one TPU VM.  A TPU *pod* is multi-controller: one process
+per host, each owning its local chips, every process executing the same program.
+This module is that deployment: the counterpart of the reference's one
+``UcxShuffleTransport`` per Spark executor wired together by driver RPC
+(CommonUcxShuffleManager.scala:67-99), with
+
+* the JAX coordination service as the driver (``jax.distributed.initialize`` —
+  parallel/mesh.py), after which ``jax.devices()`` shows the global mesh the way
+  ``IntroduceAllExecutors`` shows the executor set,
+* the collective exchange compiled over the **global** mesh and executed by all
+  processes in lockstep (XLA ICI/DCN collectives — the NCCL/MPI analogue),
+* the peer socket plane (transport/peer.py) for what stays point-to-point:
+  MapperInfo commit broadcast (AM id 2) and the per-block pull fallback
+  (AM ids 3/4).
+
+SPMD discipline: every process must call ``run_exchange`` for each shuffle in
+the same order — the same contract as every collective backend (SURVEY.md
+section 7 "multi-controller discipline").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.definitions import MapperInfo
+from sparkucx_tpu.core.operation import TransportError
+from sparkucx_tpu.core.transport import ExecutorId
+from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange
+from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
+from sparkucx_tpu.transport.peer import PeerTransport
+from sparkucx_tpu.utils.logging import get_logger
+
+logger = get_logger("transport.spmd")
+
+
+class SpmdShuffleExecutor:
+    """One process of the multi-controller deployment."""
+
+    def __init__(
+        self,
+        conf: Optional[TpuShuffleConf] = None,
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+    ) -> None:
+        import jax
+        from jax.sharding import Mesh
+
+        from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+        apply_platform_env()
+        if coordinator_address is not None:
+            # Must run before anything touches the XLA backend (including
+            # jax.process_count()); tolerate an already-initialized service.
+            from jax._src import distributed as _dist
+
+            if _dist.global_state.client is None:
+                jax.distributed.initialize(
+                    coordinator_address, num_processes=num_processes, process_id=process_id
+                )
+        self.conf = conf or TpuShuffleConf()
+        self.num_executors = jax.process_count()
+        self.executor_id: ExecutorId = jax.process_index()
+
+        # One mesh slot per process: its first local device (executor<->chip
+        # mapping; multi-device hosts designate a lead chip for the exchange).
+        per_proc: Dict[int, object] = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        self.mesh = Mesh(
+            np.array([per_proc[p] for p in range(self.num_executors)]),
+            (self.conf.mesh_axis_name,),
+        )
+        self.device = per_proc[self.executor_id]
+
+        self.store = HbmBlockStore(self.conf, executor_id=self.executor_id)
+        self.peer = PeerTransport(self.conf, executor_id=self.executor_id, store=self.store)
+        self._mapper_infos: Dict[int, Dict[int, MapperInfo]] = {}
+        self._recv: Dict[int, Tuple[List[np.ndarray], List[np.ndarray]]] = {}
+        self._meta: Dict[int, Tuple[int, int, List[Tuple[int, int]]]] = {}
+        self._exchange_fns: Dict[int, object] = {}
+
+    # -- control plane -----------------------------------------------------
+
+    def init(self) -> bytes:
+        return self.peer.init()
+
+    def add_executor(self, executor_id: ExecutorId, address: bytes) -> None:
+        self.peer.add_executor(executor_id, address)
+
+    def close(self) -> None:
+        self.peer.close()
+
+    # -- shuffle lifecycle -------------------------------------------------
+
+    def create_shuffle(self, shuffle_id: int, num_mappers: int, num_reducers: int) -> None:
+        ranges = default_peer_ranges(num_reducers, self.num_executors)
+        self.store.create_shuffle(shuffle_id, num_mappers, num_reducers, peer_ranges=ranges)
+        self._meta[shuffle_id] = (num_mappers, num_reducers, ranges)
+        self._mapper_infos[shuffle_id] = {}
+
+    def map_owner(self, map_id: int) -> ExecutorId:
+        """Round-robin map-task placement convention (all processes agree)."""
+        return map_id % self.num_executors
+
+    def commit_map(self, writer) -> MapperInfo:
+        """Commit a local map task: record locally + broadcast AM id 2."""
+        info = writer.commit()
+        self._mapper_infos[info.shuffle_id][info.map_id] = info
+        self.peer.commit_block(info.pack())
+        return info
+
+    def _await_commits(self, shuffle_id: int, timeout: float = 60.0) -> None:
+        """Wait until every map's MapperInfo arrived (local or via AM id 2)."""
+        num_mappers, _, _ = self._meta[shuffle_id]
+        infos = self._mapper_infos[shuffle_id]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.store._state(shuffle_id)
+            with self.store._lock:
+                committed = set(st.committed_maps)
+            for m in committed:
+                if m not in infos:
+                    # peer commit landed in the store table; reconstruct info
+                    parts, rounds = [], []
+                    _, num_reducers, _ = self._meta[shuffle_id]
+                    for r in range(num_reducers):
+                        e = st.blocks.get((m, r))
+                        parts.append((e.offset, e.length) if e is not None else (0, 0))
+                        rounds.append(e.round if e is not None else 0)
+                    infos[m] = MapperInfo(
+                        shuffle_id, m, tuple(parts), tuple(rounds) if any(rounds) else None
+                    )
+            if len(infos) >= num_mappers:
+                return
+            time.sleep(0.005)
+        raise TransportError(
+            f"timed out waiting for map commits ({len(infos)}/{num_mappers})"
+        )
+
+    # -- the superstep -----------------------------------------------------
+
+    def run_exchange(self, shuffle_id: int) -> None:
+        """Collective superstep — ALL processes must call this in lockstep."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._await_commits(shuffle_id)
+        rounds = self.store.seal(shuffle_id)
+        n = self.num_executors
+        ax = self.conf.mesh_axis_name
+        send_rows, lane = int(rounds[0][0].shape[0]), int(rounds[0][0].shape[1])
+
+        key = (send_rows, lane)
+        fn = self._exchange_fns.get(key)
+        if fn is None:
+            fn = build_exchange(
+                self.mesh,
+                ExchangeSpec(
+                    num_executors=n, send_rows=send_rows, recv_rows=send_rows,
+                    lane=lane, axis_name=ax,
+                ),
+            )
+            self._exchange_fns[key] = fn
+
+        data_sharding = NamedSharding(self.mesh, P(ax, None))
+        sizes_sharding = NamedSharding(self.mesh, P(ax, None))
+
+        # Agree on the global round count (spill rounds may differ per host):
+        # a one-int all_gather, served by the same mesh the payload uses.
+        my_rounds = np.array([[len(rounds)]], dtype=np.int32)
+        rc_shard = jax.device_put(my_rounds, self.device)
+        rc = jax.make_array_from_single_device_arrays(
+            (n, 1), sizes_sharding, [rc_shard]
+        )
+        num_rounds = int(np.max(jax.jit(lambda x: jnp.max(x), out_shardings=None)(rc)))
+
+        recv_shards, recv_sizes_rows = [], []
+        for rnd in range(num_rounds):
+            if rnd < len(rounds):
+                payload, sizes = rounds[rnd]
+            else:
+                payload = np.zeros((send_rows, lane), dtype=np.int32)
+                sizes = np.zeros(n, dtype=np.int32)
+            local_payload = jax.device_put(np.asarray(payload), self.device)
+            local_sizes = jax.device_put(sizes[None, :].astype(np.int32), self.device)
+            data = jax.make_array_from_single_device_arrays(
+                (n * send_rows, lane), data_sharding, [local_payload]
+            )
+            size_mat = jax.make_array_from_single_device_arrays(
+                (n, n), sizes_sharding, [local_sizes]
+            )
+            recv, rs = fn(data, size_mat)
+            my_recv = next(
+                np.asarray(s.data) for s in recv.addressable_shards if s.device == self.device
+            )
+            my_rs = next(
+                np.asarray(s.data) for s in rs.addressable_shards if s.device == self.device
+            )
+            recv_shards.append(my_recv.reshape(-1).view(np.uint8))
+            recv_sizes_rows.append(my_rs.reshape(-1))
+        self._recv[shuffle_id] = (recv_shards, recv_sizes_rows)
+        logger.info("exchange done: shuffle=%d rounds=%d", shuffle_id, num_rounds)
+
+    # -- post-exchange reads ----------------------------------------------
+
+    def owner_of_reduce(self, shuffle_id: int, reduce_id: int) -> ExecutorId:
+        _, _, ranges = self._meta[shuffle_id]
+        for p, (s, e) in enumerate(ranges):
+            if s <= reduce_id < e:
+                return p
+        raise ValueError(f"reduce {reduce_id} unowned")
+
+    def read_received_block(self, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
+        """Read a block this executor received in the exchange."""
+        if self.owner_of_reduce(shuffle_id, reduce_id) != self.executor_id:
+            raise TransportError(
+                f"reducer {reduce_id} not owned by executor {self.executor_id}"
+            )
+        if shuffle_id not in self._recv:
+            raise TransportError(f"shuffle {shuffle_id} not exchanged")
+        info = self._mapper_infos[shuffle_id].get(map_id)
+        if info is None:
+            raise TransportError(f"map {map_id} never committed")
+        abs_offset, length = info.partitions[reduce_id]
+        if length == 0:
+            return b""
+        rnd = info.round_of(reduce_id)
+        sender = self.map_owner(map_id)
+        region_bytes = self.store._state(shuffle_id).region_size
+        region_rel = abs_offset - self.executor_id * region_bytes
+        shards, sizes_rows = self._recv[shuffle_id]
+        chunk_start = int(sizes_rows[rnd][:sender].sum()) * self.conf.block_alignment
+        start = chunk_start + region_rel
+        return bytes(shards[rnd][start : start + length])
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        self.store.remove_shuffle(shuffle_id)
+        self._recv.pop(shuffle_id, None)
+        self._meta.pop(shuffle_id, None)
+        self._mapper_infos.pop(shuffle_id, None)

@@ -1,0 +1,27 @@
+"""Address/buffer codecs for control-plane payloads.
+
+Counterpart of ``utils/SerializableDirectBuffer.scala`` (88 LoC): the reference
+wraps direct ByteBuffers for Java serialization (:20-48) and codes
+``InetSocketAddress`` as ``{int port, utf8 host}`` (:71-88).  Python needs no
+direct-buffer wrapper (bytes are picklable/sendable as-is); the address codec is
+kept wire-compatible in spirit: little-endian port then utf-8 host.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+_PORT = struct.Struct("<i")
+
+
+def pack_address(host: str, port: int) -> bytes:
+    """SerializationUtils.serializeInetAddress analogue
+    (SerializableDirectBuffer.scala:71-80)."""
+    return _PORT.pack(port) + host.encode("utf-8")
+
+
+def unpack_address(data: bytes) -> Tuple[str, int]:
+    """SerializationUtils.deserializeInetAddress analogue (:82-88)."""
+    (port,) = _PORT.unpack_from(data)
+    return data[_PORT.size :].decode("utf-8"), port

@@ -112,6 +112,36 @@ class TestPeerFetch:
         assert got and got[0].status == OperationStatus.SUCCESS
 
 
+class TestThreadSlots:
+    def test_threads_use_distinct_connections(self):
+        # threadId % numClientWorkers routing (UcxShuffleTransport.scala:277-279)
+        import threading
+
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 18, num_client_workers=4)
+        a = PeerTransport(conf, executor_id=1)
+        b = PeerTransport(conf, executor_id=2)
+        a.init()
+        a.add_executor(2, b.init())
+        b.register(ShuffleBlockId(0, 0, 0), BytesBlock(b"slot"))
+        done = []
+
+        def worker():
+            [req] = a.fetch_blocks_by_block_ids(2, [ShuffleBlockId(0, 0, 0)], [_buf(16)], [None])
+            _drive(a, [req])
+            done.append(req.wait(1).status)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(s == OperationStatus.SUCCESS for s in done)
+        # multiple slots were actually opened for the single peer
+        assert len({k for k in a._conns if k[0] == 2}) >= 2
+        a.close()
+        b.close()
+
+
 class TestControlMessages:
     def test_init_executor_handshake(self, pair):
         a, b = pair

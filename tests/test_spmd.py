@@ -1,0 +1,107 @@
+"""True multi-controller test: two OS processes, each owning CPU devices, run
+the collective exchange in lockstep over gloo — the multi-host deployment shape
+(one process per TPU host) exercised without TPU hardware.
+
+Covers: jax.distributed bootstrap, driver/executor address exchange for the peer
+plane, MapperInfo commit broadcast (AM id 2), the global-mesh collective from
+per-process shards, and post-exchange reads vs a deterministic oracle.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = textwrap.dedent(
+    """
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, {root!r})
+    import numpy as np
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.parallel.bootstrap import ExecutorEndpoint
+    from sparkucx_tpu.transport.spmd import SpmdShuffleExecutor
+
+    pid = int(sys.argv[1]); coord = sys.argv[2]; driver_host, driver_port = sys.argv[3].split(":")
+    conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20)
+    ex = SpmdShuffleExecutor(conf, coordinator_address=coord, num_processes=2, process_id=pid)
+    assert ex.num_executors == 2, ex.num_executors
+    addr = ex.init()
+    ep = ExecutorEndpoint((driver_host, int(driver_port)), ex.executor_id, ex.peer)
+    ep.register(addr)
+    deadline = time.monotonic() + 30
+    other = 1 - pid
+    while other not in ep.known and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert other in ep.known, "peer never introduced"
+
+    M, R = 4, 4
+    ex.create_shuffle(0, M, R)
+    def payload(m, r):
+        rng = np.random.default_rng(100 * m + r)
+        return rng.integers(0, 256, size=int(rng.integers(1, 1500)), dtype=np.uint8).tobytes()
+
+    for m in range(M):
+        if ex.map_owner(m) != ex.executor_id:
+            continue
+        w = ex.store.map_writer(0, m)
+        for r in range(R):
+            w.write_partition(r, payload(m, r))
+        ex.commit_map(w)
+
+    ex.run_exchange(0)
+
+    checked = 0
+    for r in range(R):
+        if ex.owner_of_reduce(0, r) != ex.executor_id:
+            continue
+        for m in range(M):
+            got = ex.read_received_block(0, m, r)
+            assert got == payload(m, r), f"mismatch at map={{m}} reduce={{r}}"
+            checked += 1
+    assert checked > 0
+    print(f"CHILD_PASS pid={{pid}} checked={{checked}}", flush=True)
+    ex.close(); ep.close()
+    """
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_spmd_exchange():
+    from sparkucx_tpu.parallel.bootstrap import DriverEndpoint
+
+    driver = DriverEndpoint()
+    coord = f"127.0.0.1:{_free_port()}"
+    driver_addr = f"{driver.address[0]}:{driver.address[1]}"
+    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    script = CHILD.format(root=ROOT)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(pid), coord, driver_addr],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=ROOT, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"child {pid} failed:\n{out[-3000:]}"
+            assert f"CHILD_PASS pid={pid}" in out, out[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        driver.close()
