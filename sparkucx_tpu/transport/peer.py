@@ -268,6 +268,10 @@ class _PeerConnection:
         except (OSError, ValueError):
             pass
         self.alive = False
+        try:  # release the fd as soon as the peer is gone
+            self.sock.close()
+        except OSError:
+            pass
 
     def send(self, frame: bytes) -> None:
         with self.lock:
@@ -310,6 +314,7 @@ class PeerTransport(ShuffleTransport):
         self._conn_lock = threading.Lock()
         self._slot_local = threading.local()
         self._slot_rr = 0
+        self._connecting: Dict[Tuple[ExecutorId, int], threading.Event] = {}
         self._next_tag = 0
         self._tag_lock = threading.Lock()
         self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]], Optional[_PeerConnection]]] = {}
@@ -375,17 +380,41 @@ class PeerTransport(ShuffleTransport):
             self._connection(eid)
 
     def _connection(self, executor_id: ExecutorId) -> _PeerConnection:
+        # Two racing threads must not both build a connection for one key (the
+        # loser's socket would be orphaned from the cache and progress() would
+        # never drain its acks) — but the blocking TCP connect must NOT happen
+        # under the global lock, or one unreachable peer stalls every healthy
+        # fetch for the connect timeout.  A per-key pending event gates racers
+        # while the winner connects outside the lock.
         key = (executor_id, self._slot())
-        with self._conn_lock:
-            conn = self._conns.get(key)
-            if conn is not None and conn.alive:
-                return conn
-            addr = self._conn_addrs.get(executor_id)
-            if addr is None:
-                raise TransportError(f"unknown executor {executor_id}")
-        conn = _PeerConnection(addr)
+        while True:
+            with self._conn_lock:
+                conn = self._conns.get(key)
+                if conn is not None and conn.alive:
+                    return conn
+                pending = self._connecting.get(key)
+                if pending is None:
+                    addr = self._conn_addrs.get(executor_id)
+                    if addr is None:
+                        raise TransportError(f"unknown executor {executor_id}")
+                    if conn is not None:  # dead cached conn: release its fd
+                        del self._conns[key]
+                        conn.close()
+                    pending = threading.Event()
+                    self._connecting[key] = pending
+                    break
+            pending.wait(timeout=60)
+        try:
+            conn = _PeerConnection(addr)
+        except OSError:
+            with self._conn_lock:
+                self._connecting.pop(key, None)
+            pending.set()
+            raise
         with self._conn_lock:
             self._conns[key] = conn
+            self._connecting.pop(key, None)
+        pending.set()
         return conn
 
     # -- server side -------------------------------------------------------

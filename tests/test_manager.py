@@ -103,6 +103,47 @@ class TestGroupByFlow:
         assert m.remote_blocks_fetched >= 1
 
 
+class TestTeraSortFlow:
+    def test_terasort_style_global_sort(self, manager, rng):
+        """TeraSort shape (BASELINE.md config: 'TeraSort 10GB'): range-partition
+        random keys so partition order == global order, sort within partitions,
+        verify the concatenation is globally sorted and complete."""
+        M, R, SID = 4, 8, 30
+        manager.register_shuffle(SID, M, R)
+        all_keys = []
+        bounds = [int(2**32 * (i + 1) / R) for i in range(R - 1)]  # range partitioner
+
+        def partition_of(key):
+            import bisect
+
+            return bisect.bisect_right(bounds, key)
+
+        for m in range(M):
+            keys = [int(k) for k in rng.integers(0, 2**32, size=500)]
+            all_keys.extend(keys)
+            writer = manager.get_writer(SID, m)
+            by_part = {}
+            for k in keys:
+                by_part.setdefault(partition_of(k), []).append((k, f"row-{k}"))
+            for r in sorted(by_part):
+                pw = writer.get_partition_writer(r)
+                with pw.open_stream() as stream:
+                    stream.write(serialize_records(by_part[r]))
+            writer.commit_all_partitions()
+        manager.run_exchange(SID)
+
+        merged = []
+        for r in range(R):
+            reader = manager.get_reader(SID, r, r + 1, key_ordering=True)
+            part = [k for k, _ in reader.read()]
+            assert part == sorted(part)  # sorted within partition
+            if merged and part:
+                assert merged[-1] <= part[0]  # range partitioning: global order
+            merged.extend(part)
+        assert merged == sorted(all_keys)  # complete and globally sorted
+        manager.unregister_shuffle(SID)
+
+
 class TestWriterProtocol:
     def test_partition_order_enforced(self, manager):
         manager.register_shuffle(10, 1, 4)
