@@ -143,9 +143,11 @@ class BlockServer:
         )
         self._accepted: list = []
         self._accepted_lock = threading.Lock()
+        # numListenerThreads accept loops on one listen socket
+        # (UcxShuffleConf.scala:73-78; the kernel load-balances accepts).
         self._threads = [
             threading.Thread(target=self._accept_loop, daemon=True)
-            for _ in range(1)
+            for _ in range(max(1, self.conf.num_listener_threads))
         ]
         for t in self._threads:
             t.start()
