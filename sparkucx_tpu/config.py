@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Mapping, Optional, Tuple
+from typing import Dict, Mapping, Tuple
 
 _SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kmgt]?i?b?)\s*$", re.IGNORECASE)
 _UNITS = {
@@ -55,8 +55,8 @@ class TpuShuffleConf:
                                      :80-86)
     max_blocks_per_request           ...maxBlocksPerRequest = 50 (:88-93)
     block_alignment                  NVKV 512-byte write alignment
-                                     (NvkvHandler.scala:244-256); default 128 to
-                                     match the TPU lane width
+                                     (NvkvHandler.scala:244-256); 512 = one
+                                     exchange row of 128 int32 lanes
     staging_capacity_per_executor    NVKV device-space carve-up / 30 MB read buf
                                      (NvkvHandler.scala:26-29,
                                      NvkvShuffleMapOutputWriter.scala:94-103)

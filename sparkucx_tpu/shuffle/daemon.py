@@ -39,12 +39,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf
-from sparkucx_tpu.core.definitions import (
-    FRAME_HEADER_SIZE,
-    AmId,
-    pack_frame,
-    unpack_frame_header,
-)
+from sparkucx_tpu.core.definitions import FRAME_HEADER_SIZE, AmId, pack_frame
 from sparkucx_tpu.shuffle.manager import TpuShuffleManager
 from sparkucx_tpu.transport.peer import _recv_exact, _recv_frame, pack_batch_fetch_req, unpack_batch_fetch_req
 import struct

@@ -21,10 +21,10 @@ Responsibilities:
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Set
+from typing import Set
 
 from sparkucx_tpu.config import TpuShuffleConf
-from sparkucx_tpu.core.block import Block, BytesBlock, ShuffleBlockId
+from sparkucx_tpu.core.block import Block, ShuffleBlockId
 from sparkucx_tpu.core.operation import TransportError
 from sparkucx_tpu.core.transport import ShuffleTransport
 from sparkucx_tpu.store.hbm_store import HbmBlockStore

@@ -14,7 +14,7 @@ partition-lengths array Spark's scheduler expects (``MapOutputCommitMessage``).
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 

@@ -11,7 +11,7 @@ operator would scrape.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from sparkucx_tpu.core.operation import OperationStats
