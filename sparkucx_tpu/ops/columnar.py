@@ -59,19 +59,30 @@ class ColumnarSpec:
         return replace(self, impl="ragged" if platform == "tpu" else "dense")
 
 
-def _sort_and_sizes(spec: ColumnarSpec, rows: jnp.ndarray, owners: jnp.ndarray):
-    """Sort rows by destination executor; gather the global size matrix."""
-    ax = spec.axis_name
-    n = spec.num_executors
-    me = jax.lax.axis_index(ax)
-    order = jnp.argsort(owners, stable=True)  # padding (owner == n) sorts last
-    sorted_rows = rows[order]
-    sorted_owners = owners[order]
-    counts = jnp.bincount(owners, length=n + 1)[:n].astype(jnp.int32)  # rows i -> j
-    sizes = jax.lax.all_gather(counts[None, :], ax, tiled=True)  # (n, n)
+def size_matrix_from_owners(axis_name: str, num_executors: int, owners: jnp.ndarray):
+    """Gather the global (n, n) size matrix from each shard's owner vector and
+    derive this shard's send/recv sizes and landing offsets — the collective
+    MapperInfo analogue shared by the columnar shuffle and the distributed sort.
+
+    Rows with ``owner == num_executors`` are padding and counted nowhere."""
+    n = num_executors
+    me = jax.lax.axis_index(axis_name)
+    counts = jnp.bincount(owners, length=n + 1)[:n].astype(jnp.int32)  # rows me -> j
+    sizes = jax.lax.all_gather(counts[None, :], axis_name, tiled=True)  # (n, n)
     send_sizes = sizes[me]
     recv_sizes = sizes[:, me]
     output_offsets = exclusive_cumsum(sizes, axis=0)[me]
+    return sizes, send_sizes, recv_sizes, output_offsets
+
+
+def _sort_and_sizes(spec: ColumnarSpec, rows: jnp.ndarray, owners: jnp.ndarray):
+    """Sort rows by destination executor; gather the global size matrix."""
+    order = jnp.argsort(owners, stable=True)  # padding (owner == n) sorts last
+    sorted_rows = rows[order]
+    sorted_owners = owners[order]
+    _, send_sizes, recv_sizes, output_offsets = size_matrix_from_owners(
+        spec.axis_name, spec.num_executors, owners
+    )
     return sorted_rows, sorted_owners, send_sizes, recv_sizes, output_offsets
 
 

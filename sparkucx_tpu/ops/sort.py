@@ -1,0 +1,218 @@
+"""Distributed sample sort — the device-resident TeraSort core.
+
+BASELINE.md lists TeraSort as a headline workload ("TeraSort 10GB", north star
+"shuffle-read GB/s ... TeraSort-100GB").  In Spark, TeraSort is `sortByKey`:
+a range-partitioning shuffle (sampled splitters decide which reducer owns each
+key range) followed by a per-partition sort.  The reference accelerates only the
+shuffle *transport* of that job (UCX block fetch); here the ENTIRE job runs on
+device — sampling, range partitioning, the all-to-all, and the final sort are
+one jitted SPMD program over the executor mesh:
+
+    local sort -> sample splitters (all_gather) -> range-partition owners ->
+    ragged all_to_all (reuses ops/columnar machinery) -> local sort of received
+
+After the step, executor j holds the j-th global key range, sorted; the
+concatenation of shards in mesh order is the fully sorted dataset.  This is the
+TPU-native answer to the job the reference's GroupByTest/TeraSort harness runs
+over Spark + UCX (buildlib/test.sh:163-179, BASELINE.json configs[1]).
+
+Rows are (key, payload-lane...) with 32-bit lanes; a 100-byte TeraSort row is
+one uint32 key lane + 24 payload lanes.  Keys travel with their payload through
+one exchange (bitcast into the payload dtype) so the permutation is applied
+exactly once.
+
+Skew: splitters come from `samples_per_shard` evenly spaced local samples, so a
+range can exceed `recv_capacity` only under adversarial key skew; the returned
+per-shard receive totals let the caller detect overflow (`counts >
+recv_capacity`) and re-run with more headroom — the host-side analogue of the
+multi-round spill path in transport/tpu.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.ops.columnar import (
+    ColumnarSpec,
+    _columnar_shard_dense,
+    _columnar_shard_ragged,
+    size_matrix_from_owners,
+)
+
+KEY_MAX = np.uint32(0xFFFFFFFF)  # padding sentinel; sorts last
+
+
+@dataclass(frozen=True)
+class SortSpec:
+    """Static description of one compiled distributed sort.
+
+    ``capacity``: per-executor input rows (pad short shards; padding keys must
+    be ``KEY_MAX`` and are excluded via ``num_valid``).
+    ``recv_capacity``: per-executor output rows — headroom over the balanced
+    ``total/n`` guards against sampling error (1.5-2x is ample for uniform
+    keys, e.g. TeraSort's).
+    ``width``: payload lanes of ``dtype`` per row (>= 0); keys are uint32.
+    """
+
+    num_executors: int
+    capacity: int
+    recv_capacity: int
+    width: int = 24  # 96-byte payload -> 100-byte rows like TeraSort
+    dtype: np.dtype = np.dtype(np.int32)
+    samples_per_shard: int = 64
+    axis_name: str = "ex"
+    impl: str = "auto"
+
+    def resolve_impl(self, platform: Optional[str] = None) -> "SortSpec":
+        if self.impl != "auto":
+            return self
+        if platform is None:
+            platform = jax.devices()[0].platform
+        return replace(self, impl="ragged" if platform == "tpu" else "dense")
+
+    def validate(self) -> None:
+        if self.impl not in ("ragged", "dense"):
+            raise ValueError(f"unknown impl {self.impl!r}")
+        if np.dtype(self.dtype).itemsize != 4:
+            raise ValueError("payload dtype must be 32-bit (keys bitcast through it)")
+        if self.samples_per_shard < self.num_executors:
+            raise ValueError("samples_per_shard must be >= num_executors")
+
+
+def _global_splitters(spec: SortSpec, sorted_keys: jnp.ndarray, num_valid: jnp.ndarray):
+    """Sample each shard's sorted prefix, gather, and pick n-1 range boundaries.
+
+    This is the on-device analogue of Spark's RangePartitioner sketch: sizes are
+    published before data moves, like the MapperInfo commit the reference sends
+    ahead of block serving (NvkvShuffleMapOutputWriter.scala:116-148)."""
+    n = spec.num_executors
+    s = spec.samples_per_shard
+    # Each shard's sample weight is proportional to its fill (num_valid /
+    # capacity), so a near-empty shard doesn't drag the splitters toward its few
+    # keys: it uses `used` of its s sample slots, the rest are KEY_MAX sentinels
+    # that sort to the top and (given any non-degenerate fill) are never cut.
+    # float32 ratio: ~1e-7 relative error is irrelevant for sampling weights and
+    # avoids s*num_valid int32 overflow on huge shards.
+    nv = num_valid.astype(jnp.int32)
+    used = jnp.minimum(
+        s, (nv.astype(jnp.float32) / spec.capacity * s).astype(jnp.int32) + (nv > 0)
+    )
+    # Evenly spaced positions over the valid prefix: (i*nv)//used, decomposed so
+    # the product can't overflow int32 for i < used (i*(nv//used) <= nv).
+    i = jnp.arange(s, dtype=jnp.int32)
+    u = jnp.maximum(used, 1)
+    pos = i * (nv // u) + (i * (nv % u)) // u
+    local = jnp.where(i < used, sorted_keys[jnp.clip(pos, 0, spec.capacity - 1)], KEY_MAX)
+    allsamp = jax.lax.all_gather(local, spec.axis_name, tiled=True)  # (n*s,)
+    allsamp = jnp.sort(allsamp)
+    # Cut at sample-quantiles of the *real* samples only (sentinels sorted last).
+    total_used = jax.lax.psum(used, spec.axis_name)
+    k = jnp.arange(1, n, dtype=jnp.int32)
+    cut = k * (total_used // n) + (k * (total_used % n)) // n
+    return allsamp[jnp.clip(cut, 0, n * s - 1)]  # (n-1,) splitters
+
+
+def _sort_body(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, num_valid: jnp.ndarray):
+    n = spec.num_executors
+    nv = num_valid[0]
+
+    # 1. Local sort (padding KEY_MAX rows sort last; re-force in case the
+    #    caller's padding was not sentinel-keyed).
+    idx = jnp.arange(spec.capacity, dtype=jnp.int32)
+    keys = jnp.where(idx < nv, keys, KEY_MAX)
+    order = jnp.argsort(keys)
+    skeys = keys[order]
+    spay = payload[order]
+
+    # 2. Splitters -> per-row destination executor (padding rows -> n, never sent).
+    splitters = _global_splitters(spec, skeys, nv)
+    owners = jnp.searchsorted(splitters, skeys, side="right").astype(jnp.int32)
+    owners = jnp.where(idx < nv, owners, n)
+
+    # 3. One exchange moves key+payload together: key lane bitcast to dtype.
+    rows = jnp.concatenate([jax.lax.bitcast_convert_type(skeys, spec.dtype)[:, None], spay], axis=1)
+    # keys already sorted => owners are non-decreasing: rows are dest-contiguous.
+    sizes, send_sizes, recv_sizes, output_offsets = size_matrix_from_owners(
+        spec.axis_name, n, owners
+    )
+    cspec = ColumnarSpec(
+        num_executors=n,
+        capacity=spec.capacity,
+        recv_capacity=spec.recv_capacity,
+        width=spec.width + 1,
+        dtype=spec.dtype,
+        axis_name=spec.axis_name,
+        impl=spec.impl,
+    )
+    xchg = _columnar_shard_ragged if spec.impl == "ragged" else _columnar_shard_dense
+    recv, recv_sizes = xchg(cspec, rows, send_sizes, recv_sizes, output_offsets)
+
+    # 4. Final local sort of the received range.
+    total = recv_sizes.sum().astype(jnp.int32)
+    rkeys = jax.lax.bitcast_convert_type(recv[:, 0], jnp.uint32)
+    ridx = jnp.arange(spec.recv_capacity, dtype=jnp.int32)
+    rkeys = jnp.where(ridx < total, rkeys, KEY_MAX)
+    rorder = jnp.argsort(rkeys)
+    out_keys = rkeys[rorder]
+    out_pay = recv[:, 1:][rorder]
+    return out_keys, out_pay, total[None]
+
+
+def build_distributed_sort(mesh: Mesh, spec: SortSpec):
+    """Compile the full distributed sort for ``mesh``.
+
+    Returns jitted ``fn(keys, payload, num_valid) -> (keys_out, payload_out, counts)``:
+
+    * ``keys``: (n * capacity,) uint32, sharded over ``axis_name``;
+    * ``payload``: (n * capacity, width) of ``dtype``, row-sharded (same row
+      order as ``keys``);
+    * ``num_valid``: (n,) int32, sharded — valid rows per shard (rest padding);
+    * ``keys_out``: (n * recv_capacity,) uint32 — shard j = j-th global key
+      range, ascending; concatenating valid prefixes in mesh order yields the
+      fully sorted keys.  Padding tail is KEY_MAX.
+    * ``payload_out``: rows permuted identically to ``keys_out``;
+    * ``counts``: (n,) int32 — valid rows per output shard.  Any value >
+      ``recv_capacity`` means splitter skew overflowed the headroom; re-run
+      with a larger ``recv_capacity``.
+    """
+    if spec.num_executors != mesh.devices.size:
+        raise ValueError(f"spec.num_executors={spec.num_executors} != mesh size {mesh.devices.size}")
+    spec = spec.resolve_impl(platform=mesh.devices.reshape(-1)[0].platform)
+    spec.validate()
+    ax = spec.axis_name
+
+    shard = jax.shard_map(
+        functools.partial(_sort_body, spec),
+        mesh=mesh,
+        in_specs=(P(ax), P(ax, None), P(ax)),
+        out_specs=(P(ax), P(ax, None), P(ax)),
+        check_vma=False,
+    )
+    fn = jax.jit(
+        shard,
+        in_shardings=(
+            NamedSharding(mesh, P(ax)),
+            NamedSharding(mesh, P(ax, None)),
+            NamedSharding(mesh, P(ax)),
+        ),
+        out_shardings=(
+            NamedSharding(mesh, P(ax)),
+            NamedSharding(mesh, P(ax, None)),
+            NamedSharding(mesh, P(ax)),
+        ),
+    )
+    fn.spec = spec
+    return fn
+
+
+def oracle_sort(keys: np.ndarray, payload: np.ndarray):
+    """CPU reference: globally sorted (keys, payload) for oracle checks."""
+    order = np.argsort(keys, kind="stable")
+    return keys[order], payload[order]

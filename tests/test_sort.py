@@ -1,0 +1,186 @@
+"""Tests for the distributed sample sort (device-resident TeraSort core)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.ops.exchange import make_mesh
+from sparkucx_tpu.ops.sort import KEY_MAX, SortSpec, build_distributed_sort, oracle_sort
+
+N = 8
+CAP = 256
+W = 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N)
+
+
+@pytest.fixture(scope="module")
+def fn(mesh):
+    spec = SortSpec(
+        num_executors=N,
+        capacity=CAP,
+        recv_capacity=2 * CAP,
+        width=W,
+        samples_per_shard=64,
+        impl="dense",
+    )
+    return build_distributed_sort(mesh, spec)
+
+
+def _place(mesh, keys, payload, nvalid):
+    return (
+        jax.device_put(keys, NamedSharding(mesh, P("ex"))),
+        jax.device_put(payload, NamedSharding(mesh, P("ex", None))),
+        jax.device_put(nvalid, NamedSharding(mesh, P("ex"))),
+    )
+
+
+def _collect(fn, mesh, keys, payload, nvalid):
+    ko, po, cnt = fn(*_place(mesh, keys, payload, nvalid))
+    ko = np.asarray(ko).reshape(N, -1)
+    po = np.asarray(po).reshape(N, ko.shape[1], -1)
+    cnt = np.asarray(cnt)
+    got_k = np.concatenate([ko[j, : cnt[j]] for j in range(N)])
+    got_p = np.concatenate([po[j, : cnt[j]] for j in range(N)])
+    return got_k, got_p, cnt
+
+
+class TestDistributedSort:
+    def test_full_shards_unique_keys(self, fn, mesh, rng):
+        keys = rng.permutation(N * CAP).astype(np.uint32)
+        payload = keys[:, None].astype(np.int32) * np.arange(1, W + 1, dtype=np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        got_k, got_p, cnt = _collect(fn, mesh, keys, payload, nvalid)
+        want_k, want_p = oracle_sort(keys, payload)
+        assert cnt.sum() == N * CAP
+        np.testing.assert_array_equal(got_k, want_k)
+        np.testing.assert_array_equal(got_p, want_p)
+
+    def test_ragged_shards_with_padding(self, fn, mesh, rng):
+        nvalid = rng.integers(0, CAP + 1, size=N).astype(np.int32)
+        nvalid[3] = 0  # empty shard
+        keys = np.full(N * CAP, KEY_MAX, dtype=np.uint32)
+        payload = np.zeros((N * CAP, W), np.int32)
+        real = []
+        for j in range(N):
+            ks = rng.integers(0, 2**32 - 1, size=nvalid[j], dtype=np.uint64).astype(np.uint32)
+            keys[j * CAP : j * CAP + nvalid[j]] = ks
+            payload[j * CAP : j * CAP + nvalid[j], 0] = np.arange(nvalid[j])
+            real.append(ks)
+        got_k, _, cnt = _collect(fn, mesh, keys, payload, nvalid)
+        want = np.sort(np.concatenate(real))
+        assert cnt.sum() == nvalid.sum()
+        np.testing.assert_array_equal(got_k, want)
+
+    def test_duplicate_keys_multiset_preserved(self, fn, mesh, rng):
+        keys = rng.integers(0, 7, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        payload = rng.integers(0, 2**31 - 1, size=(N * CAP, W), dtype=np.int64).astype(np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        got_k, got_p, cnt = _collect(fn, mesh, keys, payload, nvalid)
+        assert cnt.sum() == N * CAP
+        np.testing.assert_array_equal(got_k, np.sort(keys))
+        # payload rows survive as a multiset, attached to the right key
+        want_rows = sorted(map(tuple, np.concatenate([keys[:, None].astype(np.int64), payload], axis=1)))
+        got_rows = sorted(map(tuple, np.concatenate([got_k[:, None].astype(np.int64), got_p], axis=1)))
+        assert got_rows == want_rows
+
+    def test_shards_are_contiguous_ranges(self, fn, mesh, rng):
+        keys = rng.integers(0, 2**32 - 1, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        payload = np.zeros((N * CAP, W), np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        ko, _, cnt = fn(*_place(mesh, keys, payload, nvalid))
+        ko = np.asarray(ko).reshape(N, -1)
+        cnt = np.asarray(cnt)
+        hi = np.uint64(0)
+        for j in range(N):
+            shard = ko[j, : cnt[j]]
+            if len(shard) == 0:
+                continue
+            assert np.all(np.diff(shard.astype(np.int64)) >= 0)  # sorted within shard
+            assert np.uint64(shard[0]) >= hi  # ranges ascend across shards
+            hi = np.uint64(shard[-1])
+
+    def test_skewed_keys_balanced_by_sampling(self, fn, mesh, rng):
+        # all keys in a narrow band: splitters adapt, nothing overflows 2x headroom
+        keys = rng.integers(1000, 1100, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        payload = np.zeros((N * CAP, W), np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        got_k, _, cnt = _collect(fn, mesh, keys, payload, nvalid)
+        assert np.all(cnt <= 2 * CAP)
+        np.testing.assert_array_equal(got_k, np.sort(keys))
+
+    def test_valid_rows_with_sentinel_key(self, fn, mesh, rng):
+        # Valid rows whose key equals KEY_MAX must survive: they are
+        # distinguished from padding only by stable sort + prefix layout.
+        keys = rng.integers(0, 1000, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        sent = rng.choice(N * CAP, size=17, replace=False)
+        keys[sent] = KEY_MAX
+        payload = np.arange(N * CAP, dtype=np.int32)[:, None] * np.ones(W, np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        got_k, got_p, cnt = _collect(fn, mesh, keys, payload, nvalid)
+        assert cnt.sum() == N * CAP
+        np.testing.assert_array_equal(got_k, np.sort(keys))
+        # every sentinel-keyed payload row made it through
+        assert sorted(got_p[got_k == KEY_MAX][:, 0]) == sorted(np.arange(N * CAP)[sent])
+
+    def test_imbalanced_shards_stay_balanced(self, mesh, rng):
+        # One full shard of uniform keys + 7 near-empty shards pinned at key 0:
+        # fill-weighted sampling must keep the big shard's rows spread out
+        # instead of letting the tiny shards' keys dominate the splitters.
+        spec = SortSpec(
+            num_executors=N, capacity=CAP, recv_capacity=CAP, width=1,
+            samples_per_shard=64, impl="dense",
+        )
+        f = build_distributed_sort(make_mesh(N), spec)
+        keys = np.full(N * CAP, KEY_MAX, dtype=np.uint32)
+        nvalid = np.zeros(N, np.int32)
+        nvalid[0] = CAP
+        keys[:CAP] = rng.integers(0, 2**32 - 1, size=CAP, dtype=np.uint64).astype(np.uint32)
+        for j in range(1, N):
+            nvalid[j] = 1
+            keys[j * CAP] = 0
+        payload = np.zeros((N * CAP, 1), np.int32)
+        ko, _, cnt = f(*_place(make_mesh(N), keys, payload, nvalid))
+        cnt = np.asarray(cnt)
+        assert cnt.sum() == nvalid.sum()
+        # receive stays within the (deliberately tight) 1x capacity everywhere
+        assert np.all(cnt <= CAP), cnt
+        got = np.concatenate(
+            [np.asarray(ko).reshape(N, -1)[j, : cnt[j]] for j in range(N)]
+        )
+        valid_keys = np.concatenate([keys[j * CAP : j * CAP + nvalid[j]] for j in range(N)])
+        np.testing.assert_array_equal(got, np.sort(valid_keys))
+
+    def test_single_executor_mesh(self):
+        mesh1 = make_mesh(1)
+        spec = SortSpec(num_executors=1, capacity=64, recv_capacity=64, width=1, impl="dense")
+        f = build_distributed_sort(mesh1, spec)
+        rng = np.random.default_rng(0)
+        keys = rng.permutation(64).astype(np.uint32)
+        ko, po, cnt = f(
+            jax.device_put(keys, NamedSharding(mesh1, P("ex"))),
+            jax.device_put(keys[:, None].astype(np.int32), NamedSharding(mesh1, P("ex", None))),
+            jax.device_put(np.array([64], np.int32), NamedSharding(mesh1, P("ex"))),
+        )
+        np.testing.assert_array_equal(np.asarray(ko), np.arange(64, dtype=np.uint32))
+        np.testing.assert_array_equal(np.asarray(po)[:, 0], np.arange(64, dtype=np.int32))
+        assert int(np.asarray(cnt)[0]) == 64
+
+    def test_spec_validation(self, mesh):
+        with pytest.raises(ValueError, match="mesh size"):
+            build_distributed_sort(mesh, SortSpec(num_executors=4, capacity=8, recv_capacity=8))
+        with pytest.raises(ValueError, match="32-bit"):
+            SortSpec(
+                num_executors=N, capacity=8, recv_capacity=8,
+                dtype=np.dtype(np.float64), impl="dense",
+            ).validate()
+        with pytest.raises(ValueError, match="samples_per_shard"):
+            SortSpec(
+                num_executors=N, capacity=8, recv_capacity=8,
+                samples_per_shard=2, impl="dense",
+            ).validate()
