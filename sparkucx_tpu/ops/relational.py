@@ -1,0 +1,452 @@
+"""Device-resident relational operators — grouped aggregation and hash join.
+
+BASELINE.md's remaining workload configs are Spark SQL jobs: "TPC-H q5/q18
+SF=10" and "TPC-DS SF=100".  Their physical plans are a small vocabulary:
+hash-partition exchange + local aggregation (HashAggregateExec around a
+ShuffleExchange) and hash-partition exchange of both sides + local join
+(ShuffledHashJoinExec / SortMergeJoinExec).  The reference accelerates only the
+exchange *transport* of those plans (the UCX block fetch under Spark SQL's
+shuffle); here the whole operator runs on device, the way ops/sort.py runs all
+of TeraSort on device:
+
+    hash(key) -> owner  ->  columnar ragged all_to_all (ops/columnar.py)  ->
+    local segment-reduce (GROUP BY) or sort-merge expansion (JOIN)
+
+Everything is static-shaped (capacities are compile-time constants, row counts
+are runtime data), so one compiled operator serves every batch of every query —
+the XLA-friendly design SURVEY.md section 0 calls for, no data-dependent shapes.
+
+Keys are uint32 and travel bitcast through the payload dtype lane exactly as in
+ops/sort.py; rows whose index is past ``num_valid`` are padding and never
+participate.  Both operators return actual totals so callers detect capacity
+overflow and re-run with headroom — the same contract as SortSpec.recv_capacity
+(ops/sort.py) and the multi-round spill path (transport/tpu.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.ops.columnar import ColumnarSpec, _columnar_body
+from sparkucx_tpu.ops.exchange import exclusive_cumsum
+
+#: Padding sort key (sorts last) — ops/sort.py's sentinel, same discipline:
+#: valid rows may legitimately carry this key; because received rows are a
+#: tight valid prefix, a *stable* sort keeps valid sentinel-keyed rows ahead of
+#: padding within the tie, and validity masks do the rest (x64 stays off; no
+#: int64 composite keys anywhere).
+from sparkucx_tpu.ops.sort import KEY_MAX  # noqa: E402  (re-export)
+
+#: Multiplicative hash constant (Knuth); uint32 wraparound is the mixing step.
+_HASH_MULT = np.uint32(2654435761)
+
+VALID_AGGS = ("sum", "min", "max")
+
+
+def hash_owners(keys: jnp.ndarray, num_executors: int, valid: jnp.ndarray) -> jnp.ndarray:
+    """Destination executor per row: multiplicative hash of the uint32 key,
+    mod n.  This is Spark SQL's HashPartitioning, computed on device.  Padding
+    rows map to ``num_executors`` (the columnar shuffle's never-sent owner)."""
+    mixed = (keys.astype(jnp.uint32) * _HASH_MULT) >> 16
+    owner = (mixed % jnp.uint32(num_executors)).astype(jnp.int32)
+    return jnp.where(valid, owner, num_executors)
+
+
+def _padded_keys(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Force padding rows to the KEY_MAX sentinel so they sort last."""
+    return jnp.where(valid, keys.astype(jnp.uint32), KEY_MAX)
+
+
+def _exchange_keyed_rows(spec: ColumnarSpec, keys, values, valid):
+    """Hash-partition (key | values) rows through one columnar exchange.
+
+    Returns (recv_keys uint32, recv_values, recv_valid, recv_total) with the
+    received rows tight-packed; every row of a given key lands on exactly one
+    executor.  ``recv_total`` is the TRUE row count routed to this shard — a
+    value > ``recv_capacity`` means the buffer truncated (overflow the caller
+    must surface, same contract as SortSpec.recv_capacity)."""
+    rows = jnp.concatenate(
+        [jax.lax.bitcast_convert_type(keys.astype(jnp.uint32), spec.dtype)[:, None], values],
+        axis=1,
+    )
+    owners = hash_owners(keys, spec.num_executors, valid)
+    recv, recv_sizes = _columnar_body(spec, rows, owners)
+    total = recv_sizes.sum().astype(jnp.int32)
+    ridx = jnp.arange(spec.recv_capacity, dtype=jnp.int32)
+    recv_valid = ridx < total
+    recv_keys = jax.lax.bitcast_convert_type(recv[:, 0], jnp.uint32)
+    return recv_keys, recv[:, 1:], recv_valid, total
+
+
+# ----------------------------------------------------------------------------
+# Grouped aggregation (GROUP BY)
+# ----------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AggregateSpec:
+    """Static description of one compiled grouped aggregation.
+
+    ``capacity``: per-executor input rows; ``recv_capacity``: per-executor rows
+    after the hash exchange (>= worst-case skew of hash(key) % n — with K
+    distinct keys expect ~total/n, so leave headroom like SortSpec does);
+    ``aggs``: one of 'sum'|'min'|'max' per value column.  A per-group COUNT is
+    always produced (it is also COUNT(*) when there are no value columns)."""
+
+    num_executors: int
+    capacity: int
+    recv_capacity: int
+    aggs: Tuple[str, ...]
+    dtype: np.dtype = np.dtype(np.int32)
+    axis_name: str = "ex"
+    impl: str = "auto"
+
+    @property
+    def width(self) -> int:
+        return len(self.aggs)
+
+    def resolve_impl(self, platform: Optional[str] = None) -> "AggregateSpec":
+        if self.impl != "auto":
+            return self
+        if platform is None:
+            platform = jax.devices()[0].platform
+        return replace(self, impl="ragged" if platform == "tpu" else "dense")
+
+    def validate(self) -> None:
+        if self.impl not in ("ragged", "dense"):
+            raise ValueError(f"unknown impl {self.impl!r}")
+        if np.dtype(self.dtype).itemsize != 4:
+            raise ValueError("value dtype must be 32-bit (keys bitcast through it)")
+        for a in self.aggs:
+            if a not in VALID_AGGS:
+                raise ValueError(f"unknown aggregation {a!r} (valid: {VALID_AGGS})")
+
+
+def _agg_identity(agg: str, dtype) -> jnp.ndarray:
+    if agg == "sum":
+        return jnp.zeros((), dtype)
+    info = jnp.finfo(dtype) if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype)
+    return jnp.array(info.max if agg == "min" else info.min, dtype)
+
+
+def _aggregate_body(spec: AggregateSpec, keys, values, num_valid):
+    cap = spec.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid[0]
+
+    cspec = ColumnarSpec(
+        num_executors=spec.num_executors,
+        capacity=cap,
+        recv_capacity=spec.recv_capacity,
+        width=spec.width + 1,
+        dtype=spec.dtype,
+        axis_name=spec.axis_name,
+        impl=spec.impl,
+    )
+    rkeys, rvals, rvalid, rtotal = _exchange_keyed_rows(cspec, keys, values, valid)
+
+    # Local GROUP BY: stable sort with padding forced to KEY_MAX (valid
+    # sentinel-keyed rows stay ahead of padding within the tie), segment-reduce.
+    order = jnp.argsort(_padded_keys(rkeys, rvalid), stable=True)
+    skeys = rkeys[order]
+    svals = rvals[order]
+    svalid = rvalid[order]
+    prev_differs = jnp.concatenate(
+        [jnp.ones(1, bool), skeys[1:] != skeys[:-1]]
+    )
+    is_start = prev_differs & svalid
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    # Padding rows scatter out of range and are dropped.
+    seg = jnp.where(svalid, seg, spec.recv_capacity)
+    num_groups = is_start.sum().astype(jnp.int32)
+
+    group_keys = (
+        jnp.zeros(spec.recv_capacity, jnp.uint32).at[seg].set(skeys, mode="drop")
+    )
+    group_count = (
+        jnp.zeros(spec.recv_capacity, jnp.int32)
+        .at[seg]
+        .add(svalid.astype(jnp.int32), mode="drop")
+    )
+    cols = []
+    for c, agg in enumerate(spec.aggs):
+        ident = _agg_identity(agg, svals.dtype)
+        col = jnp.where(svalid, svals[:, c], ident)
+        acc = jnp.full(spec.recv_capacity, ident)
+        if agg == "sum":
+            acc = acc.at[seg].add(col, mode="drop")
+        elif agg == "min":
+            acc = acc.at[seg].min(col, mode="drop")
+        else:
+            acc = acc.at[seg].max(col, mode="drop")
+        cols.append(acc)
+    group_vals = (
+        jnp.stack(cols, axis=1)
+        if cols
+        else jnp.zeros((spec.recv_capacity, 0), svals.dtype)
+    )
+    return group_keys, group_vals, group_count, num_groups[None], rtotal[None]
+
+
+def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
+    """Compile the distributed GROUP BY for ``mesh``.
+
+    Returns jitted ``fn(keys, values, num_valid) ->
+    (group_keys, group_values, group_counts, num_groups, recv_totals)``:
+
+    * ``keys``: (n * capacity,) uint32, sharded over ``axis_name``;
+    * ``values``: (n * capacity, len(aggs)) of ``dtype``, row-sharded;
+    * ``num_valid``: (n,) int32 sharded — valid rows per shard;
+    * ``group_keys``: (n * recv_capacity,) uint32 — shard j's first
+      ``num_groups[j]`` entries are its distinct keys (each key appears on
+      exactly one shard, ascending within the shard);
+    * ``group_values``: aggregated value per group/column (aligned rows);
+    * ``group_counts``: rows aggregated into each group (COUNT);
+    * ``num_groups``: (n,) int32;
+    * ``recv_totals``: (n,) int32 — TRUE rows hashed to each shard.  Any value
+      > ``recv_capacity`` means that shard's exchange truncated and its groups
+      are incomplete: re-run with headroom, like SortSpec.recv_capacity.
+    """
+    if spec.num_executors != mesh.devices.size:
+        raise ValueError(f"spec.num_executors={spec.num_executors} != mesh size {mesh.devices.size}")
+    spec = spec.resolve_impl(platform=mesh.devices.reshape(-1)[0].platform)
+    spec.validate()
+    ax = spec.axis_name
+
+    shard = jax.shard_map(
+        functools.partial(_aggregate_body, spec),
+        mesh=mesh,
+        in_specs=(P(ax), P(ax, None), P(ax)),
+        out_specs=(P(ax), P(ax, None), P(ax), P(ax), P(ax)),
+        check_vma=False,
+    )
+    fn = jax.jit(
+        shard,
+        in_shardings=(
+            NamedSharding(mesh, P(ax)),
+            NamedSharding(mesh, P(ax, None)),
+            NamedSharding(mesh, P(ax)),
+        ),
+        out_shardings=(
+            NamedSharding(mesh, P(ax)),
+            NamedSharding(mesh, P(ax, None)),
+            NamedSharding(mesh, P(ax)),
+            NamedSharding(mesh, P(ax)),
+            NamedSharding(mesh, P(ax)),
+        ),
+    )
+    fn.spec = spec
+    return fn
+
+
+# ----------------------------------------------------------------------------
+# Hash join (inner equi-join)
+# ----------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class JoinSpec:
+    """Static description of one compiled inner equi-join.
+
+    ``build_*`` is the left/build side, ``probe_*`` the right/probe side.
+    ``out_capacity``: per-executor output rows — bound the many-to-many
+    expansion (for PK-FK joins like TPC-H's, probe_recv_capacity is enough)."""
+
+    num_executors: int
+    build_capacity: int
+    build_recv_capacity: int
+    build_width: int
+    probe_capacity: int
+    probe_recv_capacity: int
+    probe_width: int
+    out_capacity: int
+    dtype: np.dtype = np.dtype(np.int32)
+    axis_name: str = "ex"
+    impl: str = "auto"
+
+    def resolve_impl(self, platform: Optional[str] = None) -> "JoinSpec":
+        if self.impl != "auto":
+            return self
+        if platform is None:
+            platform = jax.devices()[0].platform
+        return replace(self, impl="ragged" if platform == "tpu" else "dense")
+
+    def validate(self) -> None:
+        if self.impl not in ("ragged", "dense"):
+            raise ValueError(f"unknown impl {self.impl!r}")
+        if np.dtype(self.dtype).itemsize != 4:
+            raise ValueError("value dtype must be 32-bit (keys bitcast through it)")
+
+
+def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum):
+    n = spec.num_executors
+
+    def cspec(cap, recv_cap, width):
+        return ColumnarSpec(
+            num_executors=n,
+            capacity=cap,
+            recv_capacity=recv_cap,
+            width=width + 1,
+            dtype=spec.dtype,
+            axis_name=spec.axis_name,
+            impl=spec.impl,
+        )
+
+    bvalid = jnp.arange(spec.build_capacity, dtype=jnp.int32) < bnum[0]
+    pvalid = jnp.arange(spec.probe_capacity, dtype=jnp.int32) < pnum[0]
+
+    # Hash-partition both sides: equal keys co-locate.
+    rbk, rbv, rbvalid, rbtotal = _exchange_keyed_rows(
+        cspec(spec.build_capacity, spec.build_recv_capacity, spec.build_width),
+        bkeys, bvals, bvalid,
+    )
+    rpk, rpv, rpvalid, rptotal = _exchange_keyed_rows(
+        cspec(spec.probe_capacity, spec.probe_recv_capacity, spec.probe_width),
+        pkeys, pvals, pvalid,
+    )
+
+    # Sort the build side; padding rows (forced KEY_MAX, stable) occupy exactly
+    # the tail [btotal, cap), even when valid rows carry the sentinel key.
+    btotal = rbvalid.sum().astype(jnp.int32)
+    border = jnp.argsort(_padded_keys(rbk, rbvalid), stable=True)
+    sbk = _padded_keys(rbk, rbvalid)[border]
+    sbv = rbv[border]
+
+    # Match range per probe row; clamping hi at btotal keeps a KEY_MAX probe
+    # key from matching build padding.  Padding probe rows match nothing.
+    lo = jnp.searchsorted(sbk, rpk, side="left").astype(jnp.int32)
+    hi = jnp.minimum(jnp.searchsorted(sbk, rpk, side="right").astype(jnp.int32), btotal)
+    cnt = jnp.where(rpvalid, jnp.maximum(hi - lo, 0), 0)
+
+    # Expand matches into the static output: output row p belongs to probe row
+    # j = searchsorted(cumsum(cnt), p) at within-range delta p - offs[j].
+    offs = exclusive_cumsum(cnt)
+    cum = jnp.cumsum(cnt)
+    # int32 cumsum wraps at ~2.1e9 matches; a float32 shadow sum (exact enough
+    # for detection) saturates the reported total at int32 max so the caller's
+    # `count > out_capacity` overflow check cannot pass silently.
+    total = jnp.where(
+        jnp.sum(cnt.astype(jnp.float32)) > jnp.float32(2**31 - 1),
+        jnp.int32(np.iinfo(np.int32).max),
+        cum[-1].astype(jnp.int32),
+    )
+    pos = jnp.arange(spec.out_capacity, dtype=jnp.int32)
+    j = jnp.clip(
+        jnp.searchsorted(cum, pos, side="right").astype(jnp.int32),
+        0,
+        spec.probe_recv_capacity - 1,
+    )
+    li = jnp.clip(lo[j] + (pos - offs[j]), 0, spec.build_recv_capacity - 1)
+    ok = pos < total
+    zero = jnp.zeros((), spec.dtype)
+    out_keys = jnp.where(ok, rpk[j], jnp.uint32(0))
+    out_build = jnp.where(ok[:, None], sbv[li], zero)
+    out_probe = jnp.where(ok[:, None], rpv[j], zero)
+    return out_keys, out_build, out_probe, total[None], jnp.stack([rbtotal, rptotal])[None, :]
+
+
+def build_hash_join(mesh: Mesh, spec: JoinSpec):
+    """Compile the distributed inner equi-join for ``mesh``.
+
+    Returns jitted ``fn(build_keys, build_values, build_num, probe_keys,
+    probe_values, probe_num) ->
+    (out_keys, out_build, out_probe, out_counts, recv_totals)``:
+
+    * inputs are sharded like build_grouped_aggregate's (keys uint32, values
+      (rows, width) of ``dtype``, num (n,) int32);
+    * ``out_keys``: (n * out_capacity,) uint32 — join key per output row;
+    * ``out_build`` / ``out_probe``: matched value rows, aligned;
+    * ``out_counts``: (n,) int32 — matches on each shard.  A count >
+      ``out_capacity`` means the emitted prefix was truncated: re-run with a
+      larger ``out_capacity`` (same overflow contract as SortSpec);
+    * ``recv_totals``: (n, 2) int32 — TRUE (build, probe) rows hashed to each
+      shard; a value above the side's recv_capacity means that exchange
+      truncated and matches were lost.
+    """
+    if spec.num_executors != mesh.devices.size:
+        raise ValueError(f"spec.num_executors={spec.num_executors} != mesh size {mesh.devices.size}")
+    spec = spec.resolve_impl(platform=mesh.devices.reshape(-1)[0].platform)
+    spec.validate()
+    ax = spec.axis_name
+
+    shard = jax.shard_map(
+        functools.partial(_join_body, spec),
+        mesh=mesh,
+        in_specs=(P(ax), P(ax, None), P(ax)) * 2,
+        out_specs=(P(ax), P(ax, None), P(ax, None), P(ax), P(ax, None)),
+        check_vma=False,
+    )
+    key_sh = NamedSharding(mesh, P(ax))
+    row_sh = NamedSharding(mesh, P(ax, None))
+    fn = jax.jit(
+        shard,
+        in_shardings=(key_sh, row_sh, key_sh) * 2,
+        out_shardings=(key_sh, row_sh, row_sh, key_sh, row_sh),
+    )
+    fn.spec = spec
+    return fn
+
+
+# ----------------------------------------------------------------------------
+# CPU oracles
+# ----------------------------------------------------------------------------
+
+
+def oracle_aggregate(
+    keys: np.ndarray, values: np.ndarray, aggs: Sequence[str]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """numpy reference: (distinct keys ascending, aggregated columns, counts)."""
+    uniq, inv, counts = np.unique(keys, return_inverse=True, return_counts=True)
+    cols = []
+    for c, agg in enumerate(aggs):
+        if agg == "sum":
+            cols.append(np.bincount(inv, weights=values[:, c].astype(np.float64), minlength=len(uniq)).astype(values.dtype))
+        else:
+            red = np.minimum if agg == "min" else np.maximum
+            ident = (
+                np.finfo(values.dtype).max
+                if np.issubdtype(values.dtype, np.floating)
+                else np.iinfo(values.dtype).max
+            )
+            if agg == "max":
+                ident = -ident if np.issubdtype(values.dtype, np.floating) else np.iinfo(values.dtype).min
+            acc = np.full(len(uniq), ident, values.dtype)
+            red.at(acc, inv, values[:, c])
+            cols.append(acc)
+    out = np.stack(cols, axis=1) if cols else np.zeros((len(uniq), 0), values.dtype)
+    return uniq, out, counts.astype(np.int32)
+
+
+def oracle_join(
+    build_keys: np.ndarray,
+    build_vals: np.ndarray,
+    probe_keys: np.ndarray,
+    probe_vals: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """numpy reference inner join: rows (key, build_row, probe_row), as a
+    sorted multiset of tuples for order-insensitive comparison."""
+    from collections import defaultdict
+
+    by_key = defaultdict(list)
+    for k, row in zip(build_keys, build_vals):
+        by_key[int(k)].append(row)
+    keys, brows, prows = [], [], []
+    for k, prow in zip(probe_keys, probe_vals):
+        for brow in by_key.get(int(k), ()):
+            keys.append(int(k))
+            brows.append(brow)
+            prows.append(prow)
+    if not keys:
+        return (
+            np.zeros(0, np.uint32),
+            np.zeros((0, build_vals.shape[1]), build_vals.dtype),
+            np.zeros((0, probe_vals.shape[1]), probe_vals.dtype),
+        )
+    return np.array(keys, np.uint32), np.stack(brows), np.stack(prows)

@@ -188,13 +188,15 @@ class LoopbackTransport(ShuffleTransport):
             block = peer.registered_block(block_id)
             if block is None:
                 raise TransportError(f"block {block_id} not registered on executor {executor_id}")
-            if block.get_size() > out.host_view().size:
-                raise TransportError(
-                    f"block {block_id} ({block.get_size()} B) exceeds result buffer ({out.host_view().size} B)"
-                )
-            with block.lock:
+            with block.lock:  # size + copy under one lock: mutate() can swap the payload
+                nbytes = block.get_size()
+                if nbytes > out.host_view().size:
+                    raise TransportError(
+                        f"block {block_id} ({nbytes} B) exceeds result buffer ({out.host_view().size} B)"
+                    )
                 block.get_block(out.host_view())
-            req.stats.mark_done(recv_size=block.get_size())
+            out.size = nbytes  # shrink to received length (peer/tpu contract)
+            req.stats.mark_done(recv_size=nbytes)
             result = OperationResult(OperationStatus.SUCCESS, stats=req.stats, data=out)
         except Exception as e:  # any serve failure must complete the request
             req.stats.mark_done()

@@ -1,0 +1,276 @@
+"""Tests for the device-resident relational operators (GROUP BY, hash join)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.ops.exchange import make_mesh
+from sparkucx_tpu.ops.relational import (
+    KEY_MAX,
+    AggregateSpec,
+    JoinSpec,
+    build_grouped_aggregate,
+    build_hash_join,
+    oracle_aggregate,
+    oracle_join,
+)
+
+N = 8
+CAP = 128
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N)
+
+
+def _keys_sh(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P("ex")))
+
+
+def _rows_sh(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P("ex", None)))
+
+
+def _agg_inputs(mesh, keys, values, nvalid):
+    return _keys_sh(mesh, keys), _rows_sh(mesh, values), _keys_sh(mesh, nvalid)
+
+
+def _collect_groups(fn, mesh, keys, values, nvalid):
+    gk, gv, gc, ng, rt = fn(*_agg_inputs(mesh, keys, values, nvalid))
+    assert np.all(np.asarray(rt) <= fn.spec.recv_capacity), "exchange overflowed"
+    gk = np.asarray(gk).reshape(N, -1)
+    gv = np.asarray(gv).reshape(N, gk.shape[1], -1)
+    gc = np.asarray(gc).reshape(N, -1)
+    ng = np.asarray(ng)
+    rows = {}
+    for j in range(N):
+        for g in range(ng[j]):
+            k = int(gk[j, g])
+            assert k not in rows, "key appeared on two shards"
+            rows[k] = (gv[j, g], int(gc[j, g]))
+    return rows, ng
+
+
+class TestGroupedAggregate:
+    @pytest.fixture(scope="class")
+    def fn(self, mesh):
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=4 * CAP,
+            aggs=("sum", "min", "max"), impl="dense",
+        )
+        return build_grouped_aggregate(mesh, spec)
+
+    def test_matches_oracle(self, fn, mesh, rng):
+        keys = rng.integers(0, 50, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        values = rng.integers(-100, 100, size=(N * CAP, 3), dtype=np.int64).astype(np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        rows, ng = _collect_groups(fn, mesh, keys, values, nvalid)
+        want_k, want_v, want_c = oracle_aggregate(keys, values, ("sum", "min", "max"))
+        assert sorted(rows) == list(want_k)
+        for k, v, c in zip(want_k, want_v, want_c):
+            got_v, got_c = rows[int(k)]
+            np.testing.assert_array_equal(got_v, v)
+            assert got_c == c
+
+    def test_padding_rows_excluded(self, fn, mesh, rng):
+        nvalid = rng.integers(0, CAP + 1, size=N).astype(np.int32)
+        nvalid[2] = 0
+        keys = np.zeros(N * CAP, np.uint32)  # padding deliberately key 0
+        values = np.zeros((N * CAP, 3), np.int32)
+        real_k, real_v = [], []
+        for j in range(N):
+            ks = rng.integers(0, 20, size=nvalid[j], dtype=np.uint64).astype(np.uint32)
+            vs = rng.integers(1, 10, size=(nvalid[j], 3), dtype=np.int64).astype(np.int32)
+            keys[j * CAP : j * CAP + nvalid[j]] = ks
+            values[j * CAP : j * CAP + nvalid[j]] = vs
+            real_k.append(ks)
+            real_v.append(vs)
+        rows, _ = _collect_groups(fn, mesh, keys, values, nvalid)
+        want_k, want_v, want_c = oracle_aggregate(
+            np.concatenate(real_k), np.concatenate(real_v), ("sum", "min", "max")
+        )
+        assert sorted(rows) == list(want_k)
+        for k, v, c in zip(want_k, want_v, want_c):
+            got_v, got_c = rows[int(k)]
+            np.testing.assert_array_equal(got_v, v)
+            assert got_c == c
+
+    def test_sentinel_key_is_a_real_group(self, fn, mesh, rng):
+        keys = rng.integers(0, 5, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        keys[rng.choice(N * CAP, size=33, replace=False)] = KEY_MAX
+        values = np.ones((N * CAP, 3), np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        rows, _ = _collect_groups(fn, mesh, keys, values, nvalid)
+        assert rows[int(KEY_MAX)][1] == 33
+
+    def test_count_star_no_value_columns(self, mesh, rng):
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=4 * CAP, aggs=(), impl="dense"
+        )
+        f = build_grouped_aggregate(mesh, spec)
+        keys = rng.integers(0, 10, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        values = np.zeros((N * CAP, 0), np.int32)
+        rows, _ = _collect_groups(f, mesh, keys, values, np.full(N, CAP, np.int32))
+        want = {int(k): c for k, c in zip(*np.unique(keys, return_counts=True))}
+        assert {k: c for k, (_, c) in rows.items()} == want
+
+    def test_float_aggregation(self, mesh, rng):
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=4 * CAP,
+            aggs=("min", "max"), dtype=np.dtype(np.float32), impl="dense",
+        )
+        f = build_grouped_aggregate(mesh, spec)
+        keys = rng.integers(0, 16, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        values = rng.normal(size=(N * CAP, 2)).astype(np.float32)
+        rows, _ = _collect_groups(f, mesh, keys, values, np.full(N, CAP, np.int32))
+        want_k, want_v, _ = oracle_aggregate(keys, values, ("min", "max"))
+        for k, v in zip(want_k, want_v):
+            np.testing.assert_allclose(rows[int(k)][0], v, rtol=1e-6)
+
+    def test_spec_validation(self, mesh):
+        with pytest.raises(ValueError, match="unknown aggregation"):
+            AggregateSpec(
+                num_executors=N, capacity=8, recv_capacity=8, aggs=("avg",), impl="dense"
+            ).validate()
+        with pytest.raises(ValueError, match="mesh size"):
+            build_grouped_aggregate(
+                mesh, AggregateSpec(num_executors=2, capacity=8, recv_capacity=8, aggs=())
+            )
+
+
+def _join_inputs(mesh, bk, bv, bn, pk, pv, pn):
+    return (
+        _keys_sh(mesh, bk), _rows_sh(mesh, bv), _keys_sh(mesh, bn),
+        _keys_sh(mesh, pk), _rows_sh(mesh, pv), _keys_sh(mesh, pn),
+    )
+
+
+def _collect_join(fn, mesh, *args):
+    ok, ob, op, cnt, rt = fn(*_join_inputs(mesh, *args))
+    rt = np.asarray(rt).reshape(N, 2)
+    assert np.all(rt[:, 0] <= fn.spec.build_recv_capacity), "build exchange overflowed"
+    assert np.all(rt[:, 1] <= fn.spec.probe_recv_capacity), "probe exchange overflowed"
+    ok = np.asarray(ok).reshape(N, -1)
+    ob = np.asarray(ob).reshape(N, ok.shape[1], -1)
+    op = np.asarray(op).reshape(N, ok.shape[1], -1)
+    cnt = np.asarray(cnt)
+    rows = []
+    for j in range(N):
+        n = min(int(cnt[j]), ok.shape[1])
+        for i in range(n):
+            rows.append((int(ok[j, i]), tuple(ob[j, i]), tuple(op[j, i])))
+    return rows, cnt
+
+
+def _oracle_rows(bk, bv, pk, pv):
+    k, b, p = oracle_join(bk, bv, pk, pv)
+    return [(int(ki), tuple(bi), tuple(pi)) for ki, bi, pi in zip(k, b, p)]
+
+
+class TestHashJoin:
+    @pytest.fixture(scope="class")
+    def fn(self, mesh):
+        spec = JoinSpec(
+            num_executors=N,
+            build_capacity=CAP, build_recv_capacity=4 * CAP, build_width=2,
+            probe_capacity=CAP, probe_recv_capacity=4 * CAP, probe_width=1,
+            out_capacity=8 * CAP, impl="dense",
+        )
+        return build_hash_join(mesh, spec)
+
+    def test_many_to_many_matches_oracle(self, fn, mesh, rng):
+        bk = rng.integers(0, 40, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        bv = rng.integers(0, 1000, size=(N * CAP, 2), dtype=np.int64).astype(np.int32)
+        pk = rng.integers(0, 40, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        pv = rng.integers(0, 1000, size=(N * CAP, 1), dtype=np.int64).astype(np.int32)
+        # cap expansion: keep matches under out_capacity by sparsifying probe
+        pn = np.full(N, 16, np.int32)
+        bn = np.full(N, CAP, np.int32)
+        rows, cnt = _collect_join(fn, mesh, bk, bv, bn, pk, pv, pn)
+        valid_p = np.concatenate([np.arange(CAP) < pn[j] for j in range(N)])
+        want = _oracle_rows(bk, bv, pk[valid_p], pv[valid_p])
+        assert sorted(rows) == sorted(want)
+        assert cnt.sum() == len(want)
+
+    def test_pk_fk_join(self, fn, mesh, rng):
+        # unique build keys (primary key) -> every probe row matches exactly once
+        bk = rng.permutation(N * CAP).astype(np.uint32)
+        bv = bk[:, None].astype(np.int32) * np.array([1, 7], np.int32)
+        pk = rng.integers(0, N * CAP, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        pv = rng.integers(0, 100, size=(N * CAP, 1), dtype=np.int64).astype(np.int32)
+        bn = np.full(N, CAP, np.int32)
+        pn = np.full(N, CAP, np.int32)
+        rows, cnt = _collect_join(fn, mesh, bk, bv, bn, pk, pv, pn)
+        assert cnt.sum() == N * CAP  # every probe row found its unique build row
+        for k, b, _ in rows:
+            assert b == (k, 7 * k)
+
+    def test_disjoint_keys_empty_result(self, fn, mesh, rng):
+        bk = rng.integers(0, 100, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        pk = rng.integers(1000, 1100, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        z2 = np.zeros((N * CAP, 2), np.int32)
+        z1 = np.zeros((N * CAP, 1), np.int32)
+        full = np.full(N, CAP, np.int32)
+        rows, cnt = _collect_join(fn, mesh, bk, z2, full, pk, z1, full)
+        assert rows == [] and cnt.sum() == 0
+
+    def test_empty_sides(self, fn, mesh, rng):
+        keys = rng.integers(0, 10, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        z2 = np.zeros((N * CAP, 2), np.int32)
+        z1 = np.zeros((N * CAP, 1), np.int32)
+        zero = np.zeros(N, np.int32)
+        full = np.full(N, CAP, np.int32)
+        rows, _ = _collect_join(fn, mesh, keys, z2, zero, keys, z1, full)
+        assert rows == []
+        rows, _ = _collect_join(fn, mesh, keys, z2, full, keys, z1, zero)
+        assert rows == []
+
+    def test_sentinel_probe_key_skips_build_padding(self, fn, mesh):
+        # build side: ONE valid KEY_MAX row + padding; a KEY_MAX probe must
+        # match exactly the valid row, never the KEY_MAX-forced padding tail.
+        bk = np.zeros(N * CAP, np.uint32)
+        bk[0] = KEY_MAX
+        bv = np.zeros((N * CAP, 2), np.int32)
+        bv[0] = (11, 22)
+        bn = np.zeros(N, np.int32)
+        bn[0] = 1
+        pk = np.full(N * CAP, KEY_MAX, np.uint32)
+        pv = np.arange(N * CAP, dtype=np.int32)[:, None]
+        pn = np.ones(N, np.int32)  # one probe row per shard
+        rows, cnt = _collect_join(fn, mesh, bk, bv, bn, pk, pv, pn)
+        assert cnt.sum() == N  # each of the N probe rows matched the single build row
+        assert all(k == int(KEY_MAX) and b == (11, 22) for k, b, _ in rows)
+
+    def test_overflow_reported_not_silent(self, mesh, rng):
+        spec = JoinSpec(
+            num_executors=N,
+            build_capacity=CAP, build_recv_capacity=8 * CAP, build_width=1,
+            probe_capacity=CAP, probe_recv_capacity=8 * CAP, probe_width=1,
+            out_capacity=4, impl="dense",  # deliberately tiny output
+        )
+        f = build_hash_join(mesh, spec)
+        keys = np.zeros(N * CAP, np.uint32)  # all rows share one key -> (N*CAP)^2/shard
+        ones = np.ones((N * CAP, 1), np.int32)
+        full = np.full(N, CAP, np.int32)
+        _, _, _, cnt, rt = f(*_join_inputs(mesh, keys, ones, full, keys, ones, full))
+        cnt = np.asarray(cnt)
+        # the owning shard reports the true total, far beyond out_capacity
+        assert cnt.max() == (N * CAP) ** 2
+
+    def test_exchange_overflow_reported(self, mesh, rng):
+        # every row hashes to ONE shard whose recv buffer is far too small:
+        # recv_totals must report the true routed count, not the truncation.
+        spec = JoinSpec(
+            num_executors=N,
+            build_capacity=CAP, build_recv_capacity=CAP // 4, build_width=1,
+            probe_capacity=CAP, probe_recv_capacity=8 * CAP, probe_width=1,
+            out_capacity=CAP, impl="dense",
+        )
+        f = build_hash_join(mesh, spec)
+        keys = np.full(N * CAP, 5, np.uint32)
+        ones = np.ones((N * CAP, 1), np.int32)
+        full = np.full(N, CAP, np.int32)
+        _, _, _, _, rt = f(*_join_inputs(mesh, keys, ones, full, keys, ones, full))
+        assert np.asarray(rt)[:, 0].max() == N * CAP  # true total, > recv_capacity

@@ -1,0 +1,205 @@
+"""TPC-H-style query pipelines over the device relational operators.
+
+BASELINE.md lists "Spark SQL TPC-H q5/q18" as workload configs.  These tests
+run miniature versions of both physical plans — the same operator DAG at small
+scale — entirely through the device GROUP BY / hash-join primitives, with host
+stage boundaries where Spark would have its own (each stage's output is the
+next stage's shuffle input), verified against a numpy oracle.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.ops.exchange import make_mesh
+from sparkucx_tpu.ops.relational import (
+    AggregateSpec,
+    JoinSpec,
+    build_grouped_aggregate,
+    build_hash_join,
+)
+
+N = 8
+CAP = 128
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N)
+
+
+def _pad_table(keys, values, cap_per_shard):
+    """Scatter rows round-robin over N shards as prefix-valid padded arrays —
+    the stage-boundary materialization (each stage's input layout)."""
+    width = values.shape[1]
+    k = np.zeros(N * cap_per_shard, np.uint32)
+    v = np.zeros((N * cap_per_shard, width), np.int32)
+    nvalid = np.zeros(N, np.int32)
+    for i, (ki, vi) in enumerate(zip(keys, values)):
+        j = i % N
+        assert nvalid[j] < cap_per_shard, "test table too big for capacity"
+        k[j * cap_per_shard + nvalid[j]] = ki
+        v[j * cap_per_shard + nvalid[j]] = vi
+        nvalid[j] += 1
+    return k, v, nvalid
+
+
+def _shard(mesh, k, v, n):
+    return (
+        jax.device_put(k, NamedSharding(mesh, P("ex"))),
+        jax.device_put(v, NamedSharding(mesh, P("ex", None))),
+        jax.device_put(n, NamedSharding(mesh, P("ex"))),
+    )
+
+
+def _groups_to_host(gk, gv, gc, ng, rt, recv_capacity):
+    assert np.all(np.asarray(rt) <= recv_capacity), "exchange overflowed"
+    gk = np.asarray(gk).reshape(N, -1)
+    gv = np.asarray(gv).reshape(N, gk.shape[1], -1)
+    gc = np.asarray(gc).reshape(N, -1)
+    ng = np.asarray(ng)
+    keys = np.concatenate([gk[j, : ng[j]] for j in range(N)])
+    vals = np.concatenate([gv[j, : ng[j]] for j in range(N)])
+    cnts = np.concatenate([gc[j, : ng[j]] for j in range(N)])
+    return keys, vals, cnts
+
+
+def _join_to_host(ok, ob, op, cnt, rt):
+    ok = np.asarray(ok).reshape(N, -1)
+    ob = np.asarray(ob).reshape(N, ok.shape[1], -1)
+    op = np.asarray(op).reshape(N, ok.shape[1], -1)
+    cnt = np.asarray(cnt)
+    assert np.all(cnt <= ok.shape[1]), "join output overflowed out_capacity"
+    keys = np.concatenate([ok[j, : cnt[j]] for j in range(N)])
+    b = np.concatenate([ob[j, : cnt[j]] for j in range(N)])
+    p = np.concatenate([op[j, : cnt[j]] for j in range(N)])
+    return keys, b, p
+
+
+def test_q18_large_volume_orders(mesh, rng):
+    """Q18 shape: GROUP BY lineitem.orderkey HAVING sum(qty) > T, then join
+    the qualifying aggregates with orders."""
+    num_orders = 300
+    lineitems = 4000
+    threshold = 60
+
+    l_orderkey = rng.integers(0, num_orders, size=lineitems, dtype=np.uint64).astype(np.uint32)
+    l_quantity = rng.integers(1, 20, size=(lineitems, 1), dtype=np.int64).astype(np.int32)
+    o_orderkey = np.arange(num_orders, dtype=np.uint32)
+    o_vals = np.stack(
+        [rng.integers(0, 50, num_orders), rng.integers(100, 9000, num_orders)], axis=1
+    ).astype(np.int32)  # (custkey, totalprice)
+
+    # Stage 1 (device): GROUP BY orderkey SUM(quantity)
+    agg = build_grouped_aggregate(
+        mesh,
+        AggregateSpec(
+            num_executors=N, capacity=-(-lineitems // N), recv_capacity=lineitems,
+            aggs=("sum",), impl="dense",
+        ),
+    )
+    out = agg(*_shard(mesh, *_pad_table(l_orderkey, l_quantity, -(-lineitems // N))))
+    keys, sums, _ = _groups_to_host(*out, recv_capacity=agg.spec.recv_capacity)
+
+    # Stage 2 (host stage boundary): HAVING sum > T
+    qual = sums[:, 0] > threshold
+    hk, hv = keys[qual], sums[qual]
+
+    # Stage 3 (device): join qualifying aggregates with orders on orderkey
+    join = build_hash_join(
+        mesh,
+        JoinSpec(
+            num_executors=N,
+            build_capacity=-(-num_orders // N), build_recv_capacity=num_orders, build_width=1,
+            probe_capacity=-(-num_orders // N), probe_recv_capacity=num_orders, probe_width=2,
+            out_capacity=num_orders, impl="dense",
+        ),
+    )
+    bk, bv, bn = _pad_table(hk, hv, -(-num_orders // N))
+    pk, pv, pn = _pad_table(o_orderkey, o_vals, -(-num_orders // N))
+    jk, jb, jp = _join_to_host(*join(*_shard(mesh, bk, bv, bn), *_shard(mesh, pk, pv, pn)))
+
+    # Oracle (pure numpy over the same inputs)
+    want_sums = np.bincount(l_orderkey, weights=l_quantity[:, 0], minlength=num_orders)
+    want_qual = {int(k) for k in np.nonzero(want_sums > threshold)[0]}
+    assert {int(k) for k in hk} == want_qual
+    assert {int(k) for k in jk} == want_qual  # orders has every orderkey exactly once
+    for k, b, p in zip(jk, jb, jp):
+        assert b[0] == want_sums[int(k)]
+        np.testing.assert_array_equal(p, o_vals[int(k)])
+
+
+def test_q5_multi_join_then_group(mesh, rng):
+    """Q5 shape: customer ⋈ orders on custkey, re-key to orderkey, ⋈ lineitem,
+    then GROUP BY nationkey SUM(revenue)."""
+    num_cust, num_orders, lineitems, num_nations = 120, 250, 2500, 12
+
+    c_custkey = np.arange(num_cust, dtype=np.uint32)
+    c_nation = rng.integers(0, num_nations, size=(num_cust, 1), dtype=np.int64).astype(np.int32)
+    o_custkey = rng.integers(0, num_cust, size=num_orders, dtype=np.uint64).astype(np.uint32)
+    o_orderkey = np.arange(num_orders, dtype=np.int32)[:, None]
+    l_orderkey = rng.integers(0, num_orders, size=lineitems, dtype=np.uint64).astype(np.uint32)
+    l_revenue = rng.integers(1, 500, size=(lineitems, 1), dtype=np.int64).astype(np.int32)
+
+    # Stage 1 (device): customer ⋈ orders on custkey -> (custkey, nation, orderkey)
+    join1 = build_hash_join(
+        mesh,
+        JoinSpec(
+            num_executors=N,
+            build_capacity=-(-num_cust // N), build_recv_capacity=num_cust, build_width=1,
+            probe_capacity=-(-num_orders // N), probe_recv_capacity=num_orders, probe_width=1,
+            out_capacity=num_orders, impl="dense",
+        ),
+    )
+    _, nation_col, orderkey_col = _join_to_host(
+        *join1(
+            *_shard(mesh, *_pad_table(c_custkey, c_nation, -(-num_cust // N))),
+            *_shard(mesh, *_pad_table(o_custkey, o_orderkey, -(-num_orders // N))),
+        )
+    )
+
+    # Stage 2 (host boundary): re-key by orderkey, carry nation
+    stage2_keys = orderkey_col[:, 0].astype(np.uint32)
+    stage2_vals = nation_col.astype(np.int32)
+
+    # Stage 3 (device): ⋈ lineitem on orderkey -> (orderkey, nation, revenue)
+    join2 = build_hash_join(
+        mesh,
+        JoinSpec(
+            num_executors=N,
+            build_capacity=-(-num_orders // N), build_recv_capacity=num_orders, build_width=1,
+            probe_capacity=-(-lineitems // N), probe_recv_capacity=lineitems, probe_width=1,
+            out_capacity=lineitems, impl="dense",
+        ),
+    )
+    _, nation2, revenue2 = _join_to_host(
+        *join2(
+            *_shard(mesh, *_pad_table(stage2_keys, stage2_vals, -(-num_orders // N))),
+            *_shard(mesh, *_pad_table(l_orderkey, l_revenue, -(-lineitems // N))),
+        )
+    )
+
+    # Stage 4 (device): GROUP BY nation SUM(revenue)
+    agg = build_grouped_aggregate(
+        mesh,
+        AggregateSpec(
+            num_executors=N, capacity=-(-lineitems // N), recv_capacity=lineitems,
+            aggs=("sum",), impl="dense",
+        ),
+    )
+    out = agg(
+        *_shard(
+            mesh, *_pad_table(nation2[:, 0].astype(np.uint32), revenue2, -(-lineitems // N))
+        )
+    )
+    keys, sums, _ = _groups_to_host(*out, recv_capacity=agg.spec.recv_capacity)
+    got = {int(k): int(s) for k, s in zip(keys, sums[:, 0])}
+
+    # Oracle: pure numpy joins
+    nation_of_order = c_nation[o_custkey, 0]          # orders ⋈ customer
+    nation_of_line = nation_of_order[l_orderkey]      # lineitem ⋈ orders
+    want = {}
+    for nk, rev in zip(nation_of_line, l_revenue[:, 0]):
+        want[int(nk)] = want.get(int(nk), 0) + int(rev)
+    assert got == want
