@@ -96,6 +96,16 @@ class TpuShuffleConf:
     mesh_axis_name: str = "ex"
     num_executors: int = 1
 
+    #: Keep each executor's received exchange shard resident in HBM after the
+    #: superstep, enabling device-side block fetch (ops/pallas_kernels.py) —
+    #: the serving analogue of the reference's registered bounce buffers that
+    #: never leave the NIC-visible pool (MemoryPool.scala).  Costs one extra
+    #: device-resident copy of the received bytes per round.
+    keep_device_recv: bool = True
+    #: Ragged block-gather lowering: 'auto' (pipelined DMA kernel on TPU, XLA
+    #: gather elsewhere) | 'dma' | 'tiled' | 'xla'.
+    gather_impl: str = "auto"
+
     # instrumentation
     collect_stats: bool = True
 
@@ -149,6 +159,8 @@ class TpuShuffleConf:
             ("shmNamespace", "shm_namespace", str),
             ("numExecutors", "num_executors", int),
             ("meshAxisName", "mesh_axis_name", str),
+            ("keepDeviceRecv", "keep_device_recv", lambda v: str(v).lower() == "true"),
+            ("gatherImpl", "gather_impl", str),
         ]:
             v = get(name)
             if v is not None:
@@ -170,6 +182,8 @@ class TpuShuffleConf:
             raise ValueError("max_blocks_per_request must be positive")
         if self.num_executors <= 0:
             raise ValueError("num_executors must be positive")
+        if self.gather_impl not in ("auto", "dma", "tiled", "xla"):
+            raise ValueError(f"unknown gather_impl {self.gather_impl!r}")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

@@ -1,0 +1,247 @@
+"""Pallas TPU kernels for the block data plane — ragged block gather ("fetch pack").
+
+The hot serving primitive of the reference is packing many variable-length
+shuffle blocks into ONE contiguous registered buffer and shipping that single
+buffer (``UcxWorkerWrapper.handleFetchBlockRequest``: parallel positioned file
+reads into one pooled bounce buffer ``[tag | sizes | data...]``, one AM reply —
+UcxWorkerWrapper.scala:397-448).  On TPU the blocks already live in HBM after
+the exchange collective (transport/tpu.py), so the equivalent primitive is a
+**device-side ragged gather**: copy B variable-length row runs out of an
+HBM-resident source into one packed HBM destination, without the bytes ever
+visiting the host.
+
+Three interchangeable lowerings (bit-identical results):
+
+* ``impl='dma'`` — Pallas kernel, one *dynamic-size* HBM->HBM DMA per block,
+  K-deep pipelined on a rotating semaphore ring (the DMA engine streams block
+  i+1..i+K while block i completes).  This is the TPU analogue of the
+  reference's ForkJoin parallel file reads (UcxWorkerWrapper.scala:416-426):
+  the DMA engine plays the IO thread pool.  TPU-only (Mosaic supports
+  dynamic-size DMA slices; the interpreter does not).
+* ``impl='tiled'`` — Pallas kernel with *static-size* tile DMAs (full tiles +
+  an overlapping shifted tail, single-row DMAs for sub-tile blocks).  Portable
+  to ``interpret=True``, which is how CI tests the kernel structure on CPU.
+* ``impl='xla'`` — pure jnp row gather (searchsorted + take), the portable
+  fallback and the oracle the Pallas paths are tested against.
+
+Sizes here are **rows** of ``lane`` 32-bit elements — the exchange's wire unit
+(one row = the store's block alignment; ops/exchange.py module docstring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Pipelining depth of the dynamic-DMA path: how many block copies may be in
+# flight at once (the numIoThreads analogue, UcxShuffleConf.scala:66-71).
+DMA_PIPELINE_DEPTH = 8
+
+# Rows per static-size DMA in the tiled path: 8 sublanes is the int32 native
+# tile height, so a (8, 128) tile is one 4 KiB descriptor.
+TILE_ROWS = 8
+
+
+def _gather_dma_kernel(starts_ref, counts_ref, outs_ref, src_ref, out_ref, sems):
+    """One dynamic-size DMA per block, K-deep pipelined.
+
+    Grid-free: a single program walks all B blocks with a fori_loop, starting
+    DMA i and waiting on DMA i-K, so up to K copies are in flight.  The wait
+    reconstructs the same descriptor (the standard Pallas double-buffer
+    pattern); empty blocks are skipped symmetrically on start and wait.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_blocks = starts_ref.shape[0]
+    k = DMA_PIPELINE_DEPTH
+
+    def get_dma(i):
+        return pltpu.make_async_copy(
+            src_ref.at[pl.ds(starts_ref[i], counts_ref[i])],
+            out_ref.at[pl.ds(outs_ref[i], counts_ref[i])],
+            sems.at[jax.lax.rem(i, k)],
+        )
+
+    def body(i, _):
+        @pl.when(jnp.logical_and(i >= k, counts_ref[i - k] > 0))
+        def _wait_prev():
+            get_dma(i - k).wait()
+
+        @pl.when(counts_ref[i] > 0)
+        def _start():
+            get_dma(i).start()
+
+        return 0
+
+    jax.lax.fori_loop(0, num_blocks, body, 0)
+
+    def drain(i, _):
+        @pl.when(counts_ref[i] > 0)
+        def _wait():
+            get_dma(i).wait()
+
+        return 0
+
+    jax.lax.fori_loop(jnp.maximum(num_blocks - k, 0), num_blocks, drain, 0)
+
+
+def _gather_tiled_kernel(starts_ref, counts_ref, outs_ref, src_ref, out_ref, sem):
+    """Static-size tile DMAs: portable to the Pallas interpreter.
+
+    Per block: full TILE_ROWS tiles, then either one overlapping shifted tail
+    tile (count >= TILE_ROWS — rewrites a few already-correct rows, which is
+    safe because src and dst shift together) or single-row DMAs (count <
+    TILE_ROWS).  Serial start/wait — this lowering is for correctness testing,
+    the dynamic path is the perf path.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_blocks = starts_ref.shape[0]
+
+    def copy(src_row, dst_row, rows):
+        dma = pltpu.make_async_copy(
+            src_ref.at[pl.ds(src_row, rows)],
+            out_ref.at[pl.ds(dst_row, rows)],
+            sem,
+        )
+        dma.start()
+        dma.wait()
+
+    def block_body(b, _):
+        start, count, out = starts_ref[b], counts_ref[b], outs_ref[b]
+        full = count // TILE_ROWS
+
+        def tile_body(t, _):
+            copy(start + t * TILE_ROWS, out + t * TILE_ROWS, TILE_ROWS)
+            return 0
+
+        jax.lax.fori_loop(0, full, tile_body, 0)
+
+        tail = count - full * TILE_ROWS
+
+        @pl.when(jnp.logical_and(tail > 0, count >= TILE_ROWS))
+        def _shifted_tail():
+            copy(start + count - TILE_ROWS, out + count - TILE_ROWS, TILE_ROWS)
+
+        @pl.when(count < TILE_ROWS)
+        def _tiny_block():
+            def row_body(r, _):
+                copy(start + r, out + r, 1)
+                return 0
+
+            jax.lax.fori_loop(0, count, row_body, 0)
+
+        return 0
+
+    jax.lax.fori_loop(0, num_blocks, block_body, 0)
+
+
+def _pallas_gather(kernel, interpret: bool, out_rows: int, starts, counts, outs, src):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    sem_shape = (
+        pltpu.SemaphoreType.DMA((DMA_PIPELINE_DEPTH,))
+        if kernel is _gather_dma_kernel
+        else pltpu.SemaphoreType.DMA
+    )
+    # The tiled kernel's (predicated) tail copy traces an 8-row slice even when
+    # it can never run, so the buffer must be at least one tile tall; the
+    # caller-visible shape is restored by the slice below.
+    alloc_rows = max(out_rows, TILE_ROWS)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((alloc_rows, src.shape[1]), src.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[sem_shape],
+        ),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(starts, counts, outs, src)
+    return out[:out_rows]
+
+
+def _xla_gather(out_rows: int, starts, counts, outs, src):
+    """Portable lowering: map each output row to its source row.
+
+    Output row p belongs to block b iff outs[b] <= p < outs[b]+counts[b]; rows
+    not covered by any block keep zeros.  Blocks must be packed (outs =
+    exclusive cumsum of counts) for the searchsorted inversion to hold — the
+    wrapper guarantees it.
+    """
+    ends = outs + counts
+    pos = jnp.arange(out_rows, dtype=jnp.int32)
+    b = jnp.clip(
+        jnp.searchsorted(ends, pos, side="right").astype(jnp.int32),
+        0,
+        jnp.maximum(starts.shape[0] - 1, 0),
+    )
+    src_row = starts[b] + (pos - outs[b])
+    covered = (pos >= outs[b]) & (pos < ends[b])
+    rows = src[jnp.clip(src_row, 0, src.shape[0] - 1)]
+    return jnp.where(covered[:, None], rows, jnp.zeros((), dtype=src.dtype))
+
+
+def build_block_gather(
+    num_blocks: int,
+    out_rows: int,
+    impl: Optional[str] = None,
+    interpret: bool = False,
+):
+    """Compile a ragged block gather: ``fn(starts, counts, outs, src) -> packed``.
+
+    * ``starts``/``counts``/``outs``: (num_blocks,) int32 — source row offset,
+      row count, and destination row offset per block.  Destinations must be
+      packed ascending (``outs`` = exclusive cumsum of ``counts``) — the layout
+      ``pack_plan`` produces and the reference's reply buffer uses.
+    * ``src``: (S, lane) int32 — HBM-resident source (a received exchange shard).
+    * returns (out_rows, lane) int32 — blocks packed back-to-back.  Rows past
+      the packed total are UNSPECIFIED (the Pallas paths leave the buffer
+      uninitialized there; the xla path happens to zero it) — callers must
+      slice ``[:total_rows]``.
+
+    ``impl``: 'dma' (TPU, pipelined dynamic-size DMAs) | 'tiled' (portable
+    static-size DMAs) | 'xla' (pure jnp).  Default: 'dma' on TPU else 'xla'.
+    """
+    if impl is None:
+        impl = "dma" if jax.devices()[0].platform == "tpu" else "xla"
+    if impl == "xla":
+        fn = jax.jit(functools.partial(_xla_gather, out_rows))
+    elif impl in ("dma", "tiled"):
+        kernel = _gather_dma_kernel if impl == "dma" else _gather_tiled_kernel
+        fn = jax.jit(functools.partial(_pallas_gather, kernel, interpret, out_rows))
+    else:
+        raise ValueError(f"unknown impl {impl!r}")
+    fn.impl = impl
+    return fn
+
+
+def pack_plan(
+    offsets_lengths: Sequence[Tuple[int, int]], row_bytes: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Host-side plan: byte (offset, length) pairs -> row-granular (starts,
+    counts, outs, total_rows) for ``build_block_gather``.
+
+    Offsets must be row-aligned (the store aligns every block,
+    store/hbm_store.py); lengths are padded up to whole rows — the per-block
+    padding the reference records at close (NvkvShuffleMapOutputWriter.scala:236-246).
+    """
+    starts, counts = [], []
+    for off, ln in offsets_lengths:
+        if off % row_bytes:
+            raise ValueError(f"block offset {off} not {row_bytes}-byte aligned")
+        starts.append(off // row_bytes)
+        counts.append(-(-ln // row_bytes))
+    counts_a = np.asarray(counts, dtype=np.int32)
+    outs = np.concatenate([[0], np.cumsum(counts_a)[:-1]]).astype(np.int32)
+    total = int(counts_a.sum())
+    return np.asarray(starts, dtype=np.int32), counts_a, outs, total

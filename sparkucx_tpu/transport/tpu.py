@@ -72,6 +72,9 @@ class _ShuffleMeta:
     # spill; a single round in the common case), each per executor:
     recv_shards: Optional[List[List[np.ndarray]]] = None  # [round][executor] uint8
     recv_sizes: Optional[List[np.ndarray]] = None         # [round] (n, n) rows j<-i
+    # HBM-resident copies of the received shards (conf.keep_device_recv) —
+    # the source the device-side block gather serves from:
+    recv_device: Optional[List[List[object]]] = None      # [round][executor] jax.Array
     exchanged: bool = False
 
     def owner_of_reduce(self, reduce_id: int) -> ExecutorId:
@@ -227,6 +230,10 @@ class TpuShuffleCluster:
                 [np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)]
             )
             meta.recv_sizes.append(np.asarray(recv_sizes))
+            if self.conf.keep_device_recv:
+                if meta.recv_device is None:
+                    meta.recv_device = []
+                meta.recv_device.append([shard_by_device[devices[j]] for j in range(n)])
         meta.exchanged = True
 
     # -- post-exchange block lookup ---------------------------------------
@@ -244,32 +251,134 @@ class TpuShuffleCluster:
         meta = self.meta(shuffle_id)
         if not meta.exchanged:
             raise TransportError(f"shuffle {shuffle_id} not exchanged yet")
+        rnd, src_row, rows = self._locate_rows(meta, consumer, map_id, reduce_id)
+        if rows == 0:
+            return np.empty(0, dtype=np.uint8), 0
+        length = meta.mapper_infos[map_id].partitions[reduce_id][1]
+        shard = meta.recv_shards[rnd][consumer]
+        start = src_row * self.row_bytes
+        return shard[start : start + length], length
+
+    def _locate_rows(
+        self, meta: _ShuffleMeta, consumer: ExecutorId, map_id: int, reduce_id: int
+    ) -> Tuple[int, int, int]:
+        """Row-granular location of a block inside ``consumer``'s received shard:
+        (round, src_row, row_count).  Same offset math as
+        ``locate_received_block`` in rows of ``row_bytes``."""
         if meta.owner_of_reduce(reduce_id) != consumer:
             raise TransportError(
-                f"reducer {reduce_id} is owned by executor {meta.owner_of_reduce(reduce_id)}, "
-                f"not {consumer}"
+                f"reducer {reduce_id} is owned by executor "
+                f"{meta.owner_of_reduce(reduce_id)}, not {consumer}"
             )
-        sender = meta.map_owner[map_id]
         info = meta.mapper_infos.get(map_id)
         if info is None:
             raise TransportError(f"map {map_id} never committed")
         abs_offset, length = info.partitions[reduce_id]
-        rnd = info.round_of(reduce_id)
         if length == 0:
-            return np.empty(0, dtype=np.uint8), 0
-
+            return 0, 0, 0
+        rnd = info.round_of(reduce_id)
+        sender = meta.map_owner[map_id]
         sender_store = self.transports[sender].store
-        region_bytes = sender_store._state(shuffle_id).region_size
+        region_bytes = sender_store._state(meta.shuffle_id).region_size
         region_rel = abs_offset - consumer * region_bytes
         if not (0 <= region_rel < region_bytes):
             raise TransportError(
-                f"block ({shuffle_id},{map_id},{reduce_id}) offset {abs_offset} not in "
-                f"consumer {consumer}'s region"
+                f"block ({meta.shuffle_id},{map_id},{reduce_id}) offset {abs_offset} "
+                f"not in consumer {consumer}'s region"
             )
-        chunk_start = int(meta.recv_sizes[rnd][consumer, :sender].sum()) * self.row_bytes
-        shard = meta.recv_shards[rnd][consumer]
-        start = chunk_start + region_rel
-        return shard[start : start + length], length
+        row = self.row_bytes
+        chunk_start = int(meta.recv_sizes[rnd][consumer, :sender].sum())
+        return rnd, chunk_start + region_rel // row, -(-length // row)
+
+    def _gather_fn(self, impl: Optional[str], num_blocks: int, out_rows: int):
+        """Cache compiled gathers; shapes are bucketed to powers of two (blocks
+        padded with zero-count entries, which the kernels skip) so repeated
+        fetches of varying batch sizes reuse a handful of compilations."""
+        from sparkucx_tpu.ops.pallas_kernels import build_block_gather
+
+        if impl is None or impl == "auto":
+            impl = self.conf.gather_impl
+        if impl == "auto":
+            impl = None  # build_block_gather picks by platform
+        b = 1 << max(num_blocks - 1, 0).bit_length()
+        r = 1 << max(out_rows - 1, 0).bit_length()
+        key = ("gather", impl, b, r)
+        with self._lock:
+            fn = self._exchange_cache.get(key)
+            if fn is None:
+                fn = build_block_gather(b, r, impl=impl)
+                self._exchange_cache[key] = fn
+        return fn, b, r
+
+    def fetch_blocks_to_device(
+        self,
+        consumer: ExecutorId,
+        shuffle_id: int,
+        block_ids: Sequence[ShuffleBlockId],
+        impl: Optional[str] = None,
+    ) -> Tuple[object, np.ndarray]:
+        """Device-side batch fetch: pack the requested blocks into ONE
+        HBM-resident buffer on ``consumer``'s device — the bytes never visit the
+        host.  The TPU analogue of the reference's reply packing (parallel
+        reads into one pooled bounce buffer, single AM reply —
+        UcxWorkerWrapper.scala:397-448), with the DMA engine playing the IO
+        thread pool (ops/pallas_kernels.py).
+
+        Returns ``(packed, entries)``: ``packed`` is a (rows, lane) int32
+        ``jax.Array`` (rows past the packed total are unspecified); ``entries``
+        is (B, 2) int64 — per requested block, its starting ROW in ``packed``
+        and its true byte length.  Requires ``conf.keep_device_recv``.
+        """
+        import jax.numpy as jnp
+
+        meta = self.meta(shuffle_id)
+        if not meta.exchanged:
+            raise TransportError(f"shuffle {shuffle_id} not exchanged yet")
+        if meta.recv_device is None:
+            raise TransportError("device shards not retained (conf.keep_device_recv=false)")
+
+        located = []  # (round, src_row, rows) per request
+        for bid in block_ids:
+            if bid.shuffle_id != shuffle_id:
+                raise TransportError(f"block {bid} not from shuffle {shuffle_id}")
+            located.append(self._locate_rows(meta, consumer, bid.map_id, bid.reduce_id))
+
+        entries = np.zeros((len(located), 2), dtype=np.int64)
+        lane = self.row_bytes // 4
+        segments = []
+        base = 0
+        for rnd in sorted({r for r, _, c in located if c}):
+            idxs = [i for i, (r, _, c) in enumerate(located) if r == rnd and c]
+            starts = np.asarray([located[i][1] for i in idxs], dtype=np.int32)
+            counts = np.asarray([located[i][2] for i in idxs], dtype=np.int32)
+            outs = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
+            total = int(counts.sum())
+            for i, o in zip(idxs, outs):
+                bid = block_ids[i]
+                entries[i] = (base + int(o), meta.mapper_infos[bid.map_id].partitions[bid.reduce_id][1])
+            fn, b_pad, _ = self._gather_fn(impl, len(idxs), total)
+            pad = b_pad - len(idxs)
+            if pad:
+                starts = np.pad(starts, (0, pad))
+                counts = np.pad(counts, (0, pad))
+                # Padding entries land at the packed end (outs=total, count=0):
+                # the xla lowering's searchsorted needs outs+counts non-
+                # decreasing; the Pallas lowerings skip zero-count blocks.
+                outs = np.pad(outs, (0, pad), constant_values=total)
+            src = meta.recv_device[rnd][consumer]
+            dev = src.device
+            packed = fn(
+                jax.device_put(starts, dev),
+                jax.device_put(counts, dev),
+                jax.device_put(outs, dev),
+                src,
+            )
+            segments.append(packed[:total])
+            base += total
+        if not segments:
+            return jnp.zeros((0, lane), dtype=jnp.int32), entries
+        packed_all = segments[0] if len(segments) == 1 else jnp.concatenate(segments, axis=0)
+        return packed_all, entries
 
 
 class TpuShuffleTransport(ShuffleTransport):
@@ -380,6 +489,17 @@ class TpuShuffleTransport(ShuffleTransport):
                 cb(result)
             requests.append(req)
         return requests
+
+    def fetch_blocks_device(
+        self, block_ids: Sequence[ShuffleBlockId], impl: Optional[str] = None
+    ) -> Tuple[object, np.ndarray]:
+        """Device-resident batch fetch: pack these blocks into one HBM buffer on
+        this executor's device (see ``TpuShuffleCluster.fetch_blocks_to_device``).
+        All blocks must be from one shuffle."""
+        if not block_ids:
+            raise ValueError("no block ids")
+        sid = block_ids[0].shuffle_id
+        return self.cluster.fetch_blocks_to_device(self.executor_id, sid, block_ids, impl=impl)
 
     def progress(self) -> None:
         """Poll outstanding async work (non-blocking).  Post-exchange fetches
