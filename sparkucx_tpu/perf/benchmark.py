@@ -27,6 +27,10 @@ Modes:
   (UcxPerfBenchmark.scala:100-154, bandwidth print :140-143).
 * ``superstep`` — the TPU-only mode with no reference counterpart: time the
   collective exchange on the local mesh (what bench.py wraps).
+* ``gather`` — time the device-side ragged block gather (ops/pallas_kernels.py),
+  the reply-packing hot path (UcxWorkerWrapper.scala:397-448 analogue): -n
+  blocks of -s bytes scattered through a source buffer, packed into one HBM
+  buffer.  ``--impl`` selects the lowering (dma | tiled | xla | auto).
 """
 
 from __future__ import annotations
@@ -47,7 +51,7 @@ from sparkucx_tpu.transport.peer import PeerTransport
 
 def _parse_args(argv):
     p = argparse.ArgumentParser(prog="sparkucx-tpu-perf", description=__doc__.split("\n")[0])
-    p.add_argument("mode", choices=["server", "client", "superstep"])
+    p.add_argument("mode", choices=["server", "client", "superstep", "gather"])
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
     p.add_argument("-f", "--file", default=None, help="file to serve blocks from (server)")
     p.add_argument("-n", "--num-blocks", type=int, default=8)
@@ -57,6 +61,10 @@ def _parse_args(argv):
     p.add_argument("-r", "--reports", type=int, default=1, help="batches per bandwidth print")
     p.add_argument("-t", "--threads", type=int, default=1)
     p.add_argument("--executors", type=int, default=1, help="mesh size (superstep mode)")
+    p.add_argument(
+        "--impl", default="auto", choices=["auto", "dma", "tiled", "xla"],
+        help="block-gather lowering (gather mode)",
+    )
     return p.parse_args(argv)
 
 
@@ -170,12 +178,56 @@ def run_superstep(args) -> None:
         )
 
 
+def run_gather(args) -> None:
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+
+    from sparkucx_tpu.ops.pallas_kernels import build_block_gather, pack_plan
+
+    size = parse_size(args.block_size)
+    row = 512
+    rows_each = max(1, size // row)
+    b = args.num_blocks
+    # blocks scattered at 2x stride through the source (every other slot used)
+    src_rows = 2 * b * rows_each
+    rng = np.random.default_rng(0)
+    src = jax.device_put(
+        rng.integers(-100, 100, size=(src_rows, row // 4), dtype=np.int32)
+    )
+    plan = [(2 * i * rows_each * row, rows_each * row) for i in range(b)]
+    starts, counts, outs, total = pack_plan(plan, row)
+    impl = None if args.impl == "auto" else args.impl
+    fn = build_block_gather(b, total, impl=impl)
+    dev = src.device
+    sargs = tuple(jax.device_put(a, dev) for a in (starts, counts, outs))
+    out = jax.block_until_ready(fn(*sargs, src))  # compile
+    assert np.array_equal(np.asarray(out[:rows_each]), np.asarray(src[:rows_each]))
+    moved = total * row
+    for it in range(args.iterations):
+        t0 = time.perf_counter()
+        for _ in range(args.outstanding):
+            out = fn(*sargs, src)
+        jax.block_until_ready(out)
+        np.asarray(out[0, :4])  # force completion through async tunnels
+        dt = time.perf_counter() - t0
+        tot = moved * args.outstanding
+        print(
+            f"iter {it}: {b} blocks x {rows_each * row} B packed {args.outstanding}x: "
+            f"{tot} bytes in {dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s [impl={fn.impl}]",
+            flush=True,
+        )
+
+
 def main(argv=None) -> None:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     if args.mode == "server":
         run_server(args)
     elif args.mode == "client":
         run_client(args)
+    elif args.mode == "gather":
+        run_gather(args)
     else:
         run_superstep(args)
 
