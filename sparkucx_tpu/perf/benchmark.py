@@ -31,6 +31,10 @@ Modes:
   the reply-packing hot path (UcxWorkerWrapper.scala:397-448 analogue): -n
   blocks of -s bytes scattered through a source buffer, packed into one HBM
   buffer.  ``--impl`` selects the lowering (dma | tiled | xla | auto).
+* ``sort`` — time the device-resident TeraSort step (ops/sort.py): -n rows of
+  100 B (uint32 key + 24 int32 lanes) through sample-sort over ``--executors``
+  devices; prints M rows/s.  The on-device analogue of the reference harness's
+  TeraSort workload (BASELINE.json configs[1]).
 """
 
 from __future__ import annotations
@@ -51,7 +55,7 @@ from sparkucx_tpu.transport.peer import PeerTransport
 
 def _parse_args(argv):
     p = argparse.ArgumentParser(prog="sparkucx-tpu-perf", description=__doc__.split("\n")[0])
-    p.add_argument("mode", choices=["server", "client", "superstep", "gather"])
+    p.add_argument("mode", choices=["server", "client", "superstep", "gather", "sort"])
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
     p.add_argument("-f", "--file", default=None, help="file to serve blocks from (server)")
     p.add_argument("-n", "--num-blocks", type=int, default=8)
@@ -220,6 +224,52 @@ def run_gather(args) -> None:
         )
 
 
+def run_sort(args) -> None:
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.sort import SortSpec, build_distributed_sort
+
+    n = args.executors
+    total_rows = args.num_blocks  # -n = total rows here
+    cap = -(-total_rows // n)
+    spec = SortSpec(
+        num_executors=n, capacity=cap, recv_capacity=2 * cap, width=24
+    )
+    mesh = make_mesh(n)
+    fn = build_distributed_sort(mesh, spec)
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(
+        rng.integers(0, 1 << 32, size=n * cap, dtype=np.uint32),
+        NamedSharding(mesh, P("ex")),
+    )
+    payload = jax.device_put(
+        np.zeros((n * cap, 24), np.int32), NamedSharding(mesh, P("ex", None))
+    )
+    nv = jax.device_put(
+        np.full(n, cap, np.int32), NamedSharding(mesh, P("ex"))
+    )
+    out = jax.block_until_ready(fn(keys, payload, nv))  # compile
+    assert int(np.asarray(out[2]).sum()) == n * cap, "sort dropped rows"
+    for it in range(args.iterations):
+        t0 = time.perf_counter()
+        out = fn(keys, payload, nv)
+        jax.block_until_ready(out)
+        np.asarray(out[0][:4])  # force completion through async tunnels
+        dt = time.perf_counter() - t0
+        print(
+            f"iter {it}: sorted {n * cap} x 100 B rows in {dt*1e3:.1f} ms = "
+            f"{n * cap / dt / 1e6:.2f} M rows/s ({n * cap * 100 / dt / 1e9:.2f} GB/s) "
+            f"[impl={fn.spec.impl}]",
+            flush=True,
+        )
+
+
 def main(argv=None) -> None:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     if args.mode == "server":
@@ -228,6 +278,8 @@ def main(argv=None) -> None:
         run_client(args)
     elif args.mode == "gather":
         run_gather(args)
+    elif args.mode == "sort":
+        run_sort(args)
     else:
         run_superstep(args)
 

@@ -74,3 +74,13 @@ def test_gather_mode_tiled_interpret(capsys):
     # CLI would need interpret mode, so just check flag plumbing
     args = benchmark._parse_args(["gather", "--impl", "dma"])
     assert args.impl == "dma"
+
+
+def test_sort_mode(capsys):
+    benchmark.run_sort(
+        benchmark._parse_args(
+            ["sort", "-n", "4096", "-i", "2", "--executors", "4"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "rows/s" in out and out.count("iter") == 2
