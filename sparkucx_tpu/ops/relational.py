@@ -246,6 +246,44 @@ def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
     return fn
 
 
+def _expand_matches(
+    out_capacity: int,
+    sbk: jnp.ndarray,
+    btotal: jnp.ndarray,
+    probe_keys: jnp.ndarray,
+    probe_valid: jnp.ndarray,
+    probe_cap: int,
+    build_cap: int,
+):
+    """Sort-merge match expansion shared by the hash join and the transitive
+    closure: given the build side's sorted (padded) keys ``sbk`` with
+    ``btotal`` valid rows and the probe keys, emit per output row p its probe
+    index ``j[p]`` and build index ``li[p]``.
+
+    Returns (j, li, ok, total): ``ok`` masks rows past the true match count;
+    ``total`` is wrap-guarded — int32 cumsum wraps at ~2.1e9 matches, so a
+    float32 shadow sum (exact enough for detection) saturates the reported
+    total at int32 max so a caller's ``total > out_capacity`` overflow check
+    cannot pass silently."""
+    lo = jnp.searchsorted(sbk, probe_keys, side="left").astype(jnp.int32)
+    hi = jnp.minimum(jnp.searchsorted(sbk, probe_keys, side="right").astype(jnp.int32), btotal)
+    cnt = jnp.where(probe_valid, jnp.maximum(hi - lo, 0), 0)
+    offs = exclusive_cumsum(cnt)
+    cum = jnp.cumsum(cnt)
+    total = jnp.where(
+        jnp.sum(cnt.astype(jnp.float32)) > jnp.float32(2**31 - 1),
+        jnp.int32(np.iinfo(np.int32).max),
+        cum[-1].astype(jnp.int32),
+    )
+    pos = jnp.arange(out_capacity, dtype=jnp.int32)
+    j = jnp.clip(
+        jnp.searchsorted(cum, pos, side="right").astype(jnp.int32), 0, probe_cap - 1
+    )
+    li = jnp.clip(lo[j] + (pos - offs[j]), 0, build_cap - 1)
+    ok = pos < total
+    return j, li, ok, total
+
+
 # ----------------------------------------------------------------------------
 # Hash join (inner equi-join)
 # ----------------------------------------------------------------------------
@@ -319,32 +357,12 @@ def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum):
     sbk = _padded_keys(rbk, rbvalid)[border]
     sbv = rbv[border]
 
-    # Match range per probe row; clamping hi at btotal keeps a KEY_MAX probe
-    # key from matching build padding.  Padding probe rows match nothing.
-    lo = jnp.searchsorted(sbk, rpk, side="left").astype(jnp.int32)
-    hi = jnp.minimum(jnp.searchsorted(sbk, rpk, side="right").astype(jnp.int32), btotal)
-    cnt = jnp.where(rpvalid, jnp.maximum(hi - lo, 0), 0)
-
-    # Expand matches into the static output: output row p belongs to probe row
-    # j = searchsorted(cumsum(cnt), p) at within-range delta p - offs[j].
-    offs = exclusive_cumsum(cnt)
-    cum = jnp.cumsum(cnt)
-    # int32 cumsum wraps at ~2.1e9 matches; a float32 shadow sum (exact enough
-    # for detection) saturates the reported total at int32 max so the caller's
-    # `count > out_capacity` overflow check cannot pass silently.
-    total = jnp.where(
-        jnp.sum(cnt.astype(jnp.float32)) > jnp.float32(2**31 - 1),
-        jnp.int32(np.iinfo(np.int32).max),
-        cum[-1].astype(jnp.int32),
+    # Match range per probe row (hi clamped at btotal so a KEY_MAX probe key
+    # never matches build padding), expanded into the static output.
+    j, li, ok, total = _expand_matches(
+        spec.out_capacity, sbk, btotal, rpk, rpvalid,
+        spec.probe_recv_capacity, spec.build_recv_capacity,
     )
-    pos = jnp.arange(spec.out_capacity, dtype=jnp.int32)
-    j = jnp.clip(
-        jnp.searchsorted(cum, pos, side="right").astype(jnp.int32),
-        0,
-        spec.probe_recv_capacity - 1,
-    )
-    li = jnp.clip(lo[j] + (pos - offs[j]), 0, spec.build_recv_capacity - 1)
-    ok = pos < total
     zero = jnp.zeros((), spec.dtype)
     out_keys = jnp.where(ok, rpk[j], jnp.uint32(0))
     out_build = jnp.where(ok[:, None], sbv[li], zero)

@@ -1,0 +1,90 @@
+"""Transitive closure (ops/tc.py) vs the CPU oracle — the SparkTC gate of the
+reference's integration harness (buildlib/test.sh:175-179), on the CPU mesh."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.ops.exchange import make_mesh
+from sparkucx_tpu.ops.tc import TcSpec, oracle_tc, run_transitive_closure
+
+N_EXEC = 4
+
+
+def _spec(edge_cap=256, tc_cap=2048, join_cap=4096, **kw):
+    return TcSpec(
+        num_executors=N_EXEC,
+        edge_capacity=edge_cap,
+        tc_capacity=tc_cap,
+        join_capacity=join_cap,
+        **kw,
+    )
+
+
+def _random_graph(rng, vertices, edges):
+    return rng.integers(0, vertices, size=(edges, 2), dtype=np.uint32)
+
+
+class TestTransitiveClosure:
+    def test_chain_graph(self):
+        # 0->1->2->...->9: closure is all (i, j), i<j — 45 pairs, 9 rounds max
+        edges = np.array([(i, i + 1) for i in range(9)], np.uint32)
+        mesh = make_mesh(N_EXEC)
+        got, rounds = run_transitive_closure(mesh, _spec(), edges)
+        want = oracle_tc(edges)
+        assert np.array_equal(got, want)
+        assert len(got) == 45
+
+    def test_cycle_graph(self):
+        # 0->1->2->3->0: closure is the complete digraph on 4 vertices (16 pairs)
+        edges = np.array([(0, 1), (1, 2), (2, 3), (3, 0)], np.uint32)
+        mesh = make_mesh(N_EXEC)
+        got, _ = run_transitive_closure(mesh, _spec(), edges)
+        assert np.array_equal(got, oracle_tc(edges))
+        assert len(got) == 16
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_random_graph_vs_oracle(self, seed):
+        # the SparkTC shape: random edges over a small vertex set (dense closure)
+        rng = np.random.default_rng(seed)
+        edges = _random_graph(rng, vertices=24, edges=60)
+        mesh = make_mesh(N_EXEC)
+        got, rounds = run_transitive_closure(mesh, _spec(), edges)
+        want = oracle_tc(edges)
+        assert np.array_equal(got, want), (
+            f"closure mismatch: got {len(got)} pairs, want {len(want)}"
+        )
+
+    def test_already_closed(self):
+        # closure of a closure converges in one round with no growth
+        edges = oracle_tc(np.array([(0, 1), (1, 2)], np.uint32))
+        mesh = make_mesh(N_EXEC)
+        got, rounds = run_transitive_closure(mesh, _spec(), edges)
+        assert np.array_equal(got, edges)
+        assert rounds == 1
+
+    def test_duplicate_edges_and_self_loops(self):
+        edges = np.array([(0, 1), (0, 1), (1, 1), (1, 2)], np.uint32)
+        mesh = make_mesh(N_EXEC)
+        got, _ = run_transitive_closure(mesh, _spec(), edges)
+        assert np.array_equal(got, oracle_tc(edges))
+
+    def test_capacity_overflow_raises(self):
+        # closure of a 12-chain is 66 pairs; tc_capacity 4/shard (16 global)
+        # cannot hold it — the overflow must surface, not silently truncate
+        edges = np.array([(i, i + 1) for i in range(11)], np.uint32)
+        mesh = make_mesh(N_EXEC)
+        with pytest.raises(RuntimeError, match="overflow"):
+            run_transitive_closure(mesh, _spec(tc_cap=4, join_cap=8), edges)
+
+    def test_non_convergence_raises(self):
+        # diameter 19 > max_rounds 5: a partial closure must never be returned
+        edges = np.array([(i, i + 1) for i in range(19)], np.uint32)
+        mesh = make_mesh(N_EXEC)
+        with pytest.raises(RuntimeError, match="no fixpoint"):
+            run_transitive_closure(mesh, _spec(), edges, max_rounds=5)
+
+    def test_vertex_id_range_guard(self):
+        edges = np.array([(0, 0xFFFFFFFF)], np.uint32)
+        mesh = make_mesh(N_EXEC)
+        with pytest.raises(ValueError, match="vertex ids"):
+            run_transitive_closure(mesh, _spec(), edges)
