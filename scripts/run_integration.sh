@@ -25,8 +25,14 @@ run_big_test() {      # test.sh:169-173 (GroupByTest 200 5000 ...)
     python scripts/integration_groupby.py
 }
 
+run_tc_test() {       # test.sh:175-179 (SparkTC; gate at :196)
+  EXECUTORS=4 VERTICES=100 EDGES=200 python scripts/integration_tc.py
+}
+
 echo "== groupby test =="
 run_groupby_test
 echo "== big test =="
 run_big_test
+echo "== tc test =="
+run_tc_test
 echo "ALL INTEGRATION TESTS PASSED"
