@@ -229,7 +229,6 @@ def run_sort(args) -> None:
 
     apply_platform_env()
     import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from sparkucx_tpu.ops.exchange import make_mesh
