@@ -78,6 +78,9 @@ class TpuShuffleConf:
     num_listener_threads: int = 3
     num_client_workers: int = 1
     max_blocks_per_request: int = 50
+    #: Per-block pull-path retries after a failed batch fetch (the reference
+    #: never retries — SURVEY.md section 5.3); 0 disables the fallback.
+    fetch_retries: int = 1
 
     # staged store (HBM; NVKV analogue).  512 = one exchange row (128 int32
     # lanes, the native XLA:TPU tile width) and exactly NVKV's sector alignment
@@ -151,6 +154,7 @@ class TpuShuffleConf:
             ("numListenerThreads", "num_listener_threads", int),
             ("numClientWorkers", "num_client_workers", int),
             ("maxBlocksPerRequest", "max_blocks_per_request", int),
+            ("fetchRetries", "fetch_retries", int),
             ("blockAlignment", "block_alignment", parse_size),
             ("stagingCapacity", "staging_capacity_per_executor", parse_size),
             ("storePort", "store_port", int),

@@ -131,6 +131,7 @@ class TpuShuffleManager:
             deserializer=deserializer,
             aggregator=aggregator,
             key_ordering=key_ordering,
+            fetch_retries=self.conf.fetch_retries,
         )
 
     def unregister_shuffle(self, shuffle_id: int) -> None:

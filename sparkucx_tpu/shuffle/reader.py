@@ -35,12 +35,14 @@ from sparkucx_tpu.memory.pool import MemoryPool
 
 @dataclass
 class ShuffleReadMetrics:
-    """UcxShuffleReader.scala:118-123,148-153 reporter fields."""
+    """UcxShuffleReader.scala:118-123,148-153 reporter fields (+ retry count,
+    which the reference has no analogue for — it never retries)."""
 
     records_read: int = 0
     remote_bytes_read: int = 0
     remote_blocks_fetched: int = 0
     fetch_wait_ns: int = 0
+    blocks_retried: int = 0
 
 
 @dataclass
@@ -92,6 +94,7 @@ class TpuShuffleReader:
         aggregator: Optional[Callable[[Any, Any], Any]] = None,
         key_ordering: bool = False,
         sender_of: Optional[Callable[[int], ExecutorId]] = None,
+        fetch_retries: int = 1,
     ) -> None:
         self.transport = transport
         self.executor_id = executor_id
@@ -106,6 +109,7 @@ class TpuShuffleReader:
         self.aggregator = aggregator
         self.key_ordering = key_ordering
         self.sender_of = sender_of or (lambda m: self.executor_id)
+        self.fetch_retries = max(0, fetch_retries)
         self.metrics = ShuffleReadMetrics()
 
     # -- raw block iterator ------------------------------------------------
@@ -155,13 +159,38 @@ class TpuShuffleReader:
             for bid, buf, req in requests:
                 result = req.wait(0)
                 if result.status != OperationStatus.SUCCESS:
-                    buf.close()
-                    raise TransportError(f"fetch of {bid} failed: {result.error}")
+                    result = self._retry_fetch(bid, buf, result)
                 payload = bytes(buf.host_view()[: result.stats.recv_size])
                 self.metrics.remote_bytes_read += len(payload)
                 self.metrics.remote_blocks_fetched += 1
                 buf.close()
                 yield BlockFetchResult(bid, payload)
+
+    def _retry_fetch(self, bid: ShuffleBlockId, buf: MemoryBlock, failed):
+        """Per-block pull-path retry — the straggler/failure escape hatch next
+        to the batch path.  The reference logs failed sends and gives up
+        (SURVEY.md section 5.3: "No retry, no re-fetch fallback"); here a failed
+        batch fetch falls back to ``transport.fetch_block`` (the per-block AM
+        ids 3/4 analogue) up to ``fetch_retries`` times before raising."""
+        last_error = failed.error
+        for _ in range(self.fetch_retries):
+            req = self.transport.fetch_block(
+                self.sender_of(bid.map_id), bid.shuffle_id, bid.map_id, bid.reduce_id, buf
+            )
+            t0 = time.monotonic_ns()
+            while not req.completed():
+                self.transport.progress()
+            self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
+            result = req.wait(0)
+            if result.status == OperationStatus.SUCCESS:
+                self.metrics.blocks_retried += 1
+                return result
+            last_error = result.error
+        buf.close()
+        raise TransportError(
+            f"fetch of {bid} failed after {self.fetch_retries} retr"
+            f"{'y' if self.fetch_retries == 1 else 'ies'}: {last_error}"
+        )
 
     # -- record pipeline ---------------------------------------------------
 

@@ -414,7 +414,12 @@ class HbmBlockStore:
     def read_block(self, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
         """Direct block read — HBM after seal, host staging before
         (the two arms of UcxShuffleBlockResolver.getBlockData,
-        compat/spark_3_0/UcxShuffleBlockResolver.scala:86-97)."""
+        compat/spark_3_0/UcxShuffleBlockResolver.scala:86-97).
+
+        The exchange collective *donates* sealed device payloads (the aliasing
+        that halves peak HBM), so post-exchange the HBM copy may be deleted;
+        the host staging area is retained until ``remove_shuffle`` exactly so
+        this read — the pull-fallback/retry path — keeps working."""
         st = self._state(shuffle_id)
         e = st.blocks.get((map_id, reduce_id))
         if e is None:
@@ -422,9 +427,14 @@ class HbmBlockStore:
         if e.length == 0:
             return b""
         if st.sealed:
-            payload = np.asarray(st.sealed_payload[e.round]).reshape(-1).view(np.uint8)
-            return payload[e.offset : e.offset + e.length].tobytes()
-        staging = st.staging if e.round == st.round else st.prev_rounds[e.round][0]
+            payload = st.sealed_payload[e.round]
+            if not (hasattr(payload, "is_deleted") and payload.is_deleted()):
+                flat = np.asarray(payload).reshape(-1).view(np.uint8)
+                return flat[e.offset : e.offset + e.length].tobytes()
+        if e.round < len(st.prev_rounds):
+            staging = st.prev_rounds[e.round][0]
+        else:
+            staging = st.staging
         return staging[e.offset : e.offset + e.length].tobytes()
 
     def block_length(self, shuffle_id: int, map_id: int, reduce_id: int) -> int:

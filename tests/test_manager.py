@@ -234,3 +234,100 @@ class TestManagerLifecycle:
         )
         mgr.stop()
         mgr.stop()
+
+
+class _FlakyTransport:
+    """Delegating wrapper that fails the batch fetch of one block N times —
+    the batch path breaks, the per-block pull path still works."""
+
+    def __init__(self, inner, fail_bid, fail_times=1):
+        self.inner = inner
+        self.fail_bid = fail_bid
+        self.remaining = fail_times
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def fetch_blocks_by_block_ids(self, executor_id, bids, bufs, cbs):
+        from sparkucx_tpu.core.operation import (
+            OperationResult, OperationStats, OperationStatus, Request, TransportError,
+        )
+
+        out = []
+        for bid, buf, cb in zip(bids, bufs, cbs):
+            if bid == self.fail_bid and self.remaining > 0:
+                self.remaining -= 1
+                req = Request(OperationStats())
+                req.stats.mark_done()
+                req.complete(OperationResult(
+                    OperationStatus.FAILURE,
+                    error=TransportError("injected batch-fetch failure"),
+                    stats=req.stats,
+                ))
+                out.append(req)
+            else:
+                out.extend(self.inner.fetch_blocks_by_block_ids(executor_id, [bid], [buf], [cb]))
+        return out
+
+
+class TestFetchRetry:
+    """The reference never retries a failed fetch (SURVEY.md section 5.3); the
+    reader's pull-path fallback must recover and count the retry."""
+
+    def _shuffled_cluster(self):
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 20, block_alignment=128, num_executors=2
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=2)
+        meta = cluster.create_shuffle(0, 2, 2)
+        payloads = {}
+        for m in range(2):
+            t = cluster.transport(meta.map_owner[m])
+            w = t.store.map_writer(0, m)
+            for r in range(2):
+                data = serialize_records([(f"k{m}{r}", m * 10 + r)])
+                payloads[(m, r)] = data
+                w.write_partition(r, data)
+            t.commit_block(w.commit().pack())
+        cluster.run_exchange(0)
+        return cluster, meta, payloads
+
+    def test_batch_failure_recovers_via_pull_path(self):
+        from sparkucx_tpu.core.block import ShuffleBlockId
+        from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+
+        cluster, meta, payloads = self._shuffled_cluster()
+        r = 0
+        consumer = meta.owner_of_reduce(r)
+        flaky = _FlakyTransport(cluster.transport(consumer), ShuffleBlockId(0, 1, r))
+        reader = TpuShuffleReader(
+            flaky, consumer, 0, r, r + 1, 2,
+            block_sizes=lambda m, rr: len(payloads[(m, rr)]),
+            sender_of=lambda m: meta.map_owner[m],
+            fetch_retries=1,
+        )
+        got = {blk.block_id.map_id: blk.data for blk in reader.fetch_blocks()}
+        assert got == {0: payloads[(0, r)], 1: payloads[(1, r)]}
+        assert reader.metrics.blocks_retried == 1
+        assert reader.metrics.remote_blocks_fetched == 2
+
+    def test_retries_disabled_raises(self):
+        from sparkucx_tpu.core.block import ShuffleBlockId
+        from sparkucx_tpu.core.operation import TransportError
+        from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+
+        cluster, meta, payloads = self._shuffled_cluster()
+        r = 0
+        consumer = meta.owner_of_reduce(r)
+        flaky = _FlakyTransport(cluster.transport(consumer), ShuffleBlockId(0, 1, r))
+        reader = TpuShuffleReader(
+            flaky, consumer, 0, r, r + 1, 2,
+            block_sizes=lambda m, rr: len(payloads[(m, rr)]),
+            sender_of=lambda m: meta.map_owner[m],
+            fetch_retries=0,
+        )
+        with pytest.raises(TransportError, match="injected"):
+            list(reader.fetch_blocks())
