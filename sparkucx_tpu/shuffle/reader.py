@@ -31,6 +31,7 @@ from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
 from sparkucx_tpu.core.operation import OperationStatus, Request, TransportError
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.memory.pool import MemoryPool
+from sparkucx_tpu.utils.trace import instant, span
 
 
 @dataclass
@@ -152,8 +153,9 @@ class TpuShuffleReader:
                 requests.extend((bid, buf, req) for (bid, buf), req in zip(items, reqs))
 
             t0 = time.monotonic_ns()
-            while not all(req.completed() for _, _, req in requests):
-                self.transport.progress()
+            with span("read.window", shuffle_id=self.shuffle_id, blocks=len(window)):
+                while not all(req.completed() for _, _, req in requests):
+                    self.transport.progress()
             self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
 
             for bid, buf, req in requests:
@@ -184,6 +186,10 @@ class TpuShuffleReader:
             result = req.wait(0)
             if result.status == OperationStatus.SUCCESS:
                 self.metrics.blocks_retried += 1
+                instant(
+                    "fetch.retry",
+                    shuffle_id=bid.shuffle_id, map_id=bid.map_id, reduce_id=bid.reduce_id,
+                )
                 return result
             last_error = result.error
         buf.close()

@@ -54,6 +54,7 @@ from sparkucx_tpu.core.operation import (
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
+from sparkucx_tpu.utils.trace import instant, span
 
 
 @dataclass
@@ -181,6 +182,10 @@ class TpuShuffleCluster:
         """Seal every executor's staging for this shuffle and run ONE collective
         superstep.  After this, every block is resident on its consuming
         executor and fetches are local."""
+        with span("exchange.superstep", shuffle_id=shuffle_id):
+            self._run_exchange(shuffle_id)
+
+    def _run_exchange(self, shuffle_id: int) -> None:
         meta = self.meta(shuffle_id)
         if meta.exchanged:
             raise TransportError(f"shuffle {shuffle_id} already exchanged")
@@ -190,7 +195,8 @@ class TpuShuffleCluster:
                 f"exchange before all maps committed ({committed}/{meta.num_mappers})"
             )
 
-        sealed = [t.store.seal(shuffle_id) for t in self.transports]
+        with span("exchange.seal", shuffle_id=shuffle_id):
+            sealed = [t.store.seal(shuffle_id) for t in self.transports]
         num_rounds = max(len(s) for s in sealed)
         first_payload = sealed[0][0][0]
         send_rows, lane = int(first_payload.shape[0]), int(first_payload.shape[1])
@@ -223,12 +229,15 @@ class TpuShuffleCluster:
             size_mat = jax.device_put(
                 np.stack(size_rows).astype(np.int32), NamedSharding(self.mesh, P(ax, None))
             )
-            recv, recv_sizes = fn(data, size_mat)
+            with span("exchange.collective", shuffle_id=shuffle_id, round=rnd, rows=send_rows):
+                recv, recv_sizes = fn(data, size_mat)
+                jax.block_until_ready(recv)
             # One D2H per executor shard; fetches then slice host memory.
             shard_by_device = {s.device: s.data for s in recv.addressable_shards}
-            meta.recv_shards.append(
-                [np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)]
-            )
+            with span("exchange.d2h", shuffle_id=shuffle_id, round=rnd):
+                meta.recv_shards.append(
+                    [np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)]
+                )
             meta.recv_sizes.append(np.asarray(recv_sizes))
             if self.conf.keep_device_recv:
                 if meta.recv_device is None:
@@ -329,13 +338,17 @@ class TpuShuffleCluster:
         is (B, 2) int64 — per requested block, its starting ROW in ``packed``
         and its true byte length.  Requires ``conf.keep_device_recv``.
         """
-        import jax.numpy as jnp
-
         meta = self.meta(shuffle_id)
         if not meta.exchanged:
             raise TransportError(f"shuffle {shuffle_id} not exchanged yet")
         if meta.recv_device is None:
             raise TransportError("device shards not retained (conf.keep_device_recv=false)")
+
+        with span("fetch.device_gather", shuffle_id=shuffle_id, blocks=len(block_ids)):
+            return self._fetch_blocks_to_device(meta, consumer, shuffle_id, block_ids, impl)
+
+    def _fetch_blocks_to_device(self, meta, consumer, shuffle_id, block_ids, impl):
+        import jax.numpy as jnp
 
         located = []  # (round, src_row, rows) per request
         for bid in block_ids:

@@ -1,0 +1,129 @@
+"""Span tracer with chrome://tracing export — an aux subsystem the reference
+lacks entirely (SURVEY.md section 5.1: "No tracer"; it has only per-op nanoTime
+deltas in debug logs, UcxWorkerWrapper.scala:388-390).
+
+Usage::
+
+    from sparkucx_tpu.utils.trace import TRACER, span
+
+    with span("exchange.superstep", shuffle_id=0):
+        ...
+    TRACER.export("/tmp/shuffle_trace.json")   # open in chrome://tracing / Perfetto
+
+Disabled by default: every ``span`` is a no-op unless the tracer is enabled
+(constructor, ``TRACER.enable()``, or the ``SPARKUCX_TPU_TRACE`` env var, whose
+value — if not "1" — is a path auto-exported at interpreter exit).  Events are
+"X" (complete) events with thread/process ids, so concurrent mapper threads,
+server threads, and the collective lane out per-track in the viewer.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import List, Optional
+
+
+class Tracer:
+    def __init__(self, enabled: bool = False) -> None:
+        self.enabled = enabled
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events = []
+
+    @contextmanager
+    def span(self, name: str, category: str = "shuffle", **args):
+        """Time a region; nested spans nest in the viewer (same tid)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter_ns() - t0
+            ev = {
+                "name": name,
+                "cat": category,
+                "ph": "X",
+                "ts": t0 / 1e3,  # microseconds, the chrome trace unit
+                "dur": dur / 1e3,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() & 0xFFFFFFFF,
+            }
+            if args:
+                ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+            with self._lock:
+                self._events.append(ev)
+
+    def instant(self, name: str, category: str = "shuffle", **args) -> None:
+        """Zero-duration marker (commits, failures, retries)."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "cat": category,
+            "ph": "i",
+            "s": "t",
+            "ts": time.perf_counter_ns() / 1e3,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+        }
+        if args:
+            ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+        with self._lock:
+            self._events.append(ev)
+
+    @property
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def to_json(self) -> str:
+        return json.dumps({"traceEvents": self.events, "displayTimeUnit": "ms"})
+
+    def export(self, path: str) -> int:
+        """Write the chrome trace file; returns the event count."""
+        events = self.events
+        with open(path, "w") as f:
+            f.write(json.dumps({"traceEvents": events, "displayTimeUnit": "ms"}))
+        return len(events)
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def _from_env() -> "Tracer":
+    flag = os.environ.get("SPARKUCX_TPU_TRACE", "")
+    t = Tracer(enabled=bool(flag))
+    if flag and flag != "1":
+        atexit.register(lambda: t.events and t.export(flag))
+    return t
+
+
+#: Process-wide default tracer (env-gated); libraries call ``span(...)``.
+TRACER = _from_env()
+
+
+def span(name: str, category: str = "shuffle", **args):
+    return TRACER.span(name, category=category, **args)
+
+
+def instant(name: str, category: str = "shuffle", **args) -> None:
+    TRACER.instant(name, category=category, **args)

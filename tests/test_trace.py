@@ -1,0 +1,97 @@
+"""Span tracer (utils/trace.py) — chrome-trace export and hot-path wiring.
+An aux subsystem with no reference counterpart (SURVEY.md section 5.1)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.utils import trace as trace_mod
+from sparkucx_tpu.utils.trace import Tracer
+
+
+class TestTracer:
+    def test_disabled_records_nothing(self):
+        t = Tracer(enabled=False)
+        with t.span("x"):
+            pass
+        t.instant("y")
+        assert t.events == []
+
+    def test_span_event_shape(self):
+        t = Tracer(enabled=True)
+        with t.span("exchange.superstep", shuffle_id=3):
+            pass
+        [ev] = t.events
+        assert ev["name"] == "exchange.superstep" and ev["ph"] == "X"
+        assert ev["dur"] >= 0 and ev["args"] == {"shuffle_id": 3}
+
+    def test_nested_and_exception_spans(self):
+        t = Tracer(enabled=True)
+        with pytest.raises(ValueError):
+            with t.span("outer"):
+                with t.span("inner"):
+                    raise ValueError("boom")
+        names = [e["name"] for e in t.events]
+        assert names == ["inner", "outer"]  # closed innermost-first, both recorded
+
+    def test_export_valid_chrome_trace(self, tmp_path):
+        t = Tracer(enabled=True)
+        with t.span("a"):
+            t.instant("marker", category="debug", extra=object())
+        path = tmp_path / "trace.json"
+        n = t.export(str(path))
+        doc = json.loads(path.read_text())
+        assert n == 2 and len(doc["traceEvents"]) == 2
+        marker = next(e for e in doc["traceEvents"] if e["ph"] == "i")
+        assert isinstance(marker["args"]["extra"], str)  # non-JSON values stringified
+
+    def test_thread_ids_distinguish_tracks(self):
+        t = Tracer(enabled=True)
+
+        def work():
+            with t.span("w"):
+                pass
+
+        th = threading.Thread(target=work)
+        th.start()
+        th.join()
+        with t.span("main"):
+            pass
+        tids = {e["tid"] for e in t.events}
+        assert len(tids) == 2
+
+
+class TestHotPathWiring:
+    def test_exchange_emits_spans(self):
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+        trace_mod.TRACER.clear()
+        trace_mod.TRACER.enable()
+        try:
+            conf = TpuShuffleConf(
+                staging_capacity_per_executor=1 << 20, block_alignment=128, num_executors=2
+            )
+            cluster = TpuShuffleCluster(conf, num_executors=2)
+            cluster.create_shuffle(0, 2, 2)
+            for m in range(2):
+                t = cluster.transport(cluster.meta(0).map_owner[m])
+                w = t.store.map_writer(0, m)
+                for r in range(2):
+                    w.write_partition(r, np.full(300, m * 2 + r, np.uint8).tobytes())
+                t.commit_block(w.commit().pack())
+            cluster.run_exchange(0)
+            names = [e["name"] for e in trace_mod.TRACER.events]
+            assert "exchange.superstep" in names
+            assert "exchange.seal" in names
+            assert "exchange.collective" in names
+            assert "exchange.d2h" in names
+            # nesting: superstep duration covers the collective
+            sup = next(e for e in trace_mod.TRACER.events if e["name"] == "exchange.superstep")
+            col = next(e for e in trace_mod.TRACER.events if e["name"] == "exchange.collective")
+            assert sup["ts"] <= col["ts"] and sup["ts"] + sup["dur"] >= col["ts"] + col["dur"]
+        finally:
+            trace_mod.TRACER.disable()
+            trace_mod.TRACER.clear()
