@@ -1,0 +1,149 @@
+"""Concurrency stress: many mapper threads writing one shuffle while commits
+and reads race — structural-safety evidence the reference never had
+(SURVEY.md section 5.2: no race detection, safety is structural only)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus
+from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+N_EXEC = 4
+
+
+def _payload(m, r):
+    rng = np.random.default_rng(1000 * m + r)
+    return rng.integers(0, 256, size=int(rng.integers(1, 1200)), dtype=np.uint8).tobytes()
+
+
+class TestConcurrentShuffle:
+    def test_parallel_map_writers_then_exchange(self):
+        """All map tasks write concurrently from threads (the Spark executor
+        thread-pool shape); one exchange; every block verified."""
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=2 << 20, block_alignment=128, num_executors=N_EXEC
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        M, R = 16, 16
+        meta = cluster.create_shuffle(0, M, R)
+        errors = []
+
+        def map_task(m):
+            try:
+                t = cluster.transport(meta.map_owner[m])
+                w = t.store.map_writer(0, m)
+                for r in range(R):
+                    w.write_partition(r, _payload(m, r))
+                t.commit_block(w.commit().pack())
+            except Exception as e:  # surfaced below
+                errors.append((m, e))
+
+        threads = [threading.Thread(target=map_task, args=(m,)) for m in range(M)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors
+
+        cluster.run_exchange(0)
+
+        read_errors = []
+
+        def reduce_task(r):
+            try:
+                consumer = meta.owner_of_reduce(r)
+                t = cluster.transport(consumer)
+                bids = [ShuffleBlockId(0, m, r) for m in range(M)]
+                bufs = [MemoryBlock(np.zeros(2048, np.uint8), size=2048) for _ in range(M)]
+                reqs = t.fetch_blocks_by_block_ids(consumer, bids, bufs, [None] * M)
+                for m, (req, buf) in enumerate(zip(reqs, bufs)):
+                    res = req.wait(5)
+                    assert res.status == OperationStatus.SUCCESS, str(res.error)
+                    got = buf.host_view()[: buf.size].tobytes()
+                    assert got == _payload(m, r), f"mismatch map={m} reduce={r}"
+            except Exception as e:
+                read_errors.append((r, e))
+
+        rthreads = [threading.Thread(target=reduce_task, args=(r,)) for r in range(R)]
+        for th in rthreads:
+            th.start()
+        for th in rthreads:
+            th.join()
+        assert not read_errors, read_errors
+
+    def test_task_retry_race_first_commit_wins(self):
+        """Two attempts of the same map task race; exactly one set of writes
+        lands (IndexShuffleBlockResolver's check-or-replace semantics)."""
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 20, block_alignment=128, num_executors=2
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=2)
+        meta = cluster.create_shuffle(0, 1, 2)
+        t = cluster.transport(meta.map_owner[0])
+
+        barrier = threading.Barrier(2)
+        results = []
+
+        def attempt(tag):
+            barrier.wait()
+            w = t.store.map_writer(0, 0)
+            for r in range(2):
+                w.write_partition(r, bytes([tag]) * 400)
+            info = w.commit()
+            results.append((tag, w.is_retry_discard, info))
+
+        threads = [threading.Thread(target=attempt, args=(tag,)) for tag in (1, 2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+
+        # both commits returned a consistent table; the store holds ONE attempt
+        t.commit_block(results[0][2].pack())
+        cluster.run_exchange(0)
+        blocks = [
+            cluster.locate_received_block(meta.owner_of_reduce(r), 0, 0, r)[0].tobytes()
+            for r in range(2)
+        ]
+        tags = {b[0] for b in blocks if b}
+        assert len(tags) == 1, f"mixed attempts visible: {tags}"
+        assert all(len(b) == 400 for b in blocks)
+
+    def test_concurrent_shuffle_create_remove(self):
+        """Shuffle lifecycle churn from threads: create/write/exchange/remove
+        many shuffles concurrently without cross-talk."""
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 20, block_alignment=128, num_executors=2
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=2)
+        errors = []
+
+        def lifecycle(sid):
+            try:
+                meta = cluster.create_shuffle(sid, 2, 2)
+                for m in range(2):
+                    t = cluster.transport(meta.map_owner[m])
+                    w = t.store.map_writer(sid, m)
+                    for r in range(2):
+                        w.write_partition(r, bytes([sid]) * 256)
+                    t.commit_block(w.commit().pack())
+                cluster.run_exchange(sid)
+                for r in range(2):
+                    view, ln = cluster.locate_received_block(
+                        meta.owner_of_reduce(r), sid, 0, r
+                    )
+                    assert view.tobytes() == bytes([sid]) * 256
+                cluster.remove_shuffle(sid)
+            except Exception as e:
+                errors.append((sid, e))
+
+        threads = [threading.Thread(target=lifecycle, args=(sid,)) for sid in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors

@@ -203,3 +203,84 @@ def test_q5_multi_join_then_group(mesh, rng):
     for nk, rev in zip(nation_of_line, l_revenue[:, 0]):
         want[int(nk)] = want.get(int(nk), 0) + int(rev)
     assert got == want
+
+
+def test_q1_pricing_summary(mesh, rng):
+    """q1 shape: pure grouped aggregation, several agg columns at once over a
+    tiny key domain (returnflag/linestatus combos) — the no-join plan."""
+    rows = 600
+    # 6 distinct (returnflag, linestatus) combos, encoded as one uint32 key
+    flags = rng.integers(0, 6, size=rows).astype(np.uint32)
+    qty = rng.integers(1, 51, size=rows).astype(np.int32)
+    price = rng.integers(100, 10000, size=rows).astype(np.int32)
+    disc = rng.integers(0, 10, size=rows).astype(np.int32)
+    values = np.stack([qty, price, disc, qty], axis=1)  # sum, sum, min, max
+
+    spec = AggregateSpec(
+        num_executors=N, capacity=CAP, recv_capacity=4 * CAP,
+        aggs=("sum", "sum", "min", "max"),
+    )
+    fn = build_grouped_aggregate(mesh, spec)
+    k, v, nv = _pad_table(flags, values, CAP)
+    gk, gv, gc, ng, rt = fn(*_shard(mesh, k, v, nv))
+    keys, vals, cnts = _groups_to_host(gk, gv, gc, ng, rt, spec.recv_capacity)
+
+    order = np.argsort(keys)
+    keys, vals, cnts = keys[order], vals[order], cnts[order]
+    assert np.array_equal(keys, np.arange(6, dtype=np.uint32))
+    for f in range(6):
+        m = flags == f
+        assert vals[f, 0] == qty[m].sum(), "sum_qty"
+        assert vals[f, 1] == price[m].sum(), "sum_price"
+        assert vals[f, 2] == disc[m].min(), "min_disc"
+        assert vals[f, 3] == qty[m].max(), "max_qty"
+        assert cnts[f] == m.sum(), "count_order"
+
+
+def test_q3_join_group_topk(mesh, rng):
+    """q3 shape: customer⋈orders filter-join, then GROUP BY order with SUM
+    (revenue), then host-side top-k — join feeding aggregation feeding sort."""
+    n_cust, n_orders = 40, 300
+    # build side: customers in the BUILDING segment (the filter), value = custkey
+    seg_custs = np.sort(rng.choice(n_cust, size=n_cust // 2, replace=False)).astype(np.uint32)
+    cust_vals = seg_custs.astype(np.int32)[:, None]
+    # probe side: orders keyed by custkey, value = (orderkey, revenue)
+    order_cust = rng.integers(0, n_cust, size=n_orders).astype(np.uint32)
+    order_key = np.arange(n_orders, dtype=np.int32)
+    # unique revenues: the top-k cut is unambiguous regardless of seed
+    revenue = (rng.permutation(n_orders) + 1).astype(np.int32)
+    probe_vals = np.stack([order_key, revenue], axis=1)
+
+    jspec = JoinSpec(
+        num_executors=N,
+        build_capacity=CAP, build_recv_capacity=2 * CAP, build_width=1,
+        probe_capacity=CAP, probe_recv_capacity=2 * CAP, probe_width=2,
+        out_capacity=2 * CAP,
+    )
+    jfn = build_hash_join(mesh, jspec)
+    bk, bv, bn = _pad_table(seg_custs, cust_vals, CAP)
+    pk, pv, pn = _pad_table(order_cust, probe_vals, CAP)
+    ok, ob, op, cnt, rt = jfn(*_shard(mesh, bk, bv, bn), *_shard(mesh, pk, pv, pn))
+    jkeys, _, jprobe = _join_to_host(ok, ob, op, cnt, rt)
+
+    # stage 2: GROUP BY orderkey, SUM(revenue) over the join output
+    aspec = AggregateSpec(
+        num_executors=N, capacity=2 * CAP, recv_capacity=4 * CAP, aggs=("sum",)
+    )
+    afn = build_grouped_aggregate(mesh, aspec)
+    ak, av, an = _pad_table(
+        jprobe[:, 0].astype(np.uint32), jprobe[:, 1:2], 2 * CAP
+    )
+    gk, gv, gc, ng, art = afn(*_shard(mesh, ak, av, an))
+    keys, vals, _ = _groups_to_host(gk, gv, gc, ng, art, aspec.recv_capacity)
+
+    # stage 3 (host, like Spark's TakeOrdered): top-5 by revenue
+    top = np.argsort(-vals[:, 0], kind="stable")[:5]
+    got = {(int(keys[i]), int(vals[i, 0])) for i in top}
+
+    # oracle
+    in_seg = np.isin(order_cust, seg_custs)
+    o_keys, o_rev = order_key[in_seg], revenue[in_seg]
+    want_sorted = sorted(zip(o_rev, o_keys), reverse=True)[:5]
+    want = {(int(k), int(r)) for r, k in want_sorted}
+    assert got == want
