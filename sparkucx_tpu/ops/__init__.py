@@ -1,0 +1,57 @@
+"""Device-resident operators: the shuffle collective and the workloads on it.
+
+Everything here is a compiled SPMD program over the executor mesh — specs are
+static (capacities, widths), data is runtime (sizes, validity) — so one
+compilation serves every batch.  See each module's docstring for the reference
+behavior it reproduces.
+"""
+
+from sparkucx_tpu.ops.columnar import ColumnarSpec, build_columnar_shuffle
+from sparkucx_tpu.ops.exchange import (
+    ExchangeSpec,
+    build_exchange,
+    make_mesh,
+    oracle_exchange,
+    pack_chunks_slots,
+    unpack_received,
+)
+from sparkucx_tpu.ops.pallas_kernels import build_block_gather, pack_plan
+from sparkucx_tpu.ops.relational import (
+    AggregateSpec,
+    JoinSpec,
+    build_grouped_aggregate,
+    build_hash_join,
+)
+from sparkucx_tpu.ops.sort import SortSpec, build_distributed_sort, oracle_sort
+from sparkucx_tpu.ops.tc import (
+    TcSpec,
+    build_tc_prep,
+    build_tc_step,
+    oracle_tc,
+    run_transitive_closure,
+)
+
+__all__ = [
+    "ColumnarSpec",
+    "build_columnar_shuffle",
+    "ExchangeSpec",
+    "build_exchange",
+    "make_mesh",
+    "oracle_exchange",
+    "pack_chunks_slots",
+    "unpack_received",
+    "build_block_gather",
+    "pack_plan",
+    "AggregateSpec",
+    "JoinSpec",
+    "build_grouped_aggregate",
+    "build_hash_join",
+    "SortSpec",
+    "build_distributed_sort",
+    "oracle_sort",
+    "TcSpec",
+    "build_tc_prep",
+    "build_tc_step",
+    "oracle_tc",
+    "run_transitive_closure",
+]
