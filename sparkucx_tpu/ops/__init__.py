@@ -22,7 +22,12 @@ from sparkucx_tpu.ops.relational import (
     build_grouped_aggregate,
     build_hash_join,
 )
-from sparkucx_tpu.ops.sort import SortSpec, build_distributed_sort, oracle_sort
+from sparkucx_tpu.ops.sort import (
+    SortSpec,
+    build_distributed_sort,
+    oracle_sort,
+    run_distributed_sort,
+)
 from sparkucx_tpu.ops.tc import (
     TcSpec,
     build_tc_prep,
@@ -49,6 +54,7 @@ __all__ = [
     "SortSpec",
     "build_distributed_sort",
     "oracle_sort",
+    "run_distributed_sort",
     "TcSpec",
     "build_tc_prep",
     "build_tc_step",

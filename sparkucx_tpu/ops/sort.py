@@ -216,3 +216,69 @@ def oracle_sort(keys: np.ndarray, payload: np.ndarray):
     """CPU reference: globally sorted (keys, payload) for oracle checks."""
     order = np.argsort(keys, kind="stable")
     return keys[order], payload[order]
+
+
+def run_distributed_sort(
+    mesh: Mesh,
+    spec: SortSpec,
+    keys: np.ndarray,
+    payload: np.ndarray,
+    max_attempts: int = 3,
+):
+    """Host driver: shard, run the compiled sort, and retry with doubled
+    ``recv_capacity`` when splitter skew overflows a shard — the re-run
+    contract the spec documents, automated (the TeraSort job surface, like
+    ``run_transitive_closure`` is SparkTC's).
+
+    ``keys``: (T,) uint32; ``payload``: (T, width).  Returns (sorted keys,
+    payload rows in the same order) as host arrays.  Raises after
+    ``max_attempts`` doublings (pathological skew: most keys identical).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = spec.num_executors
+    total = keys.shape[0]
+    cap = spec.capacity
+    if total > n * cap:
+        raise ValueError(f"{total} rows exceed {n} x {cap} capacity")
+    if mesh.devices.size != n:
+        raise ValueError(f"mesh size {mesh.devices.size} != num_executors {n}")
+
+    pk = np.full(n * cap, KEY_MAX, np.uint32)
+    pv = np.zeros((n * cap, spec.width), spec.dtype)
+    nv = np.zeros(n, np.int32)
+    base, rem = divmod(total, n)
+    start = 0
+    for s in range(n):
+        take = base + (1 if s < rem else 0)
+        pk[s * cap : s * cap + take] = keys[start : start + take]
+        pv[s * cap : s * cap + take] = payload[start : start + take]
+        nv[s] = take
+        start += take
+
+    key_sh = NamedSharding(mesh, P(spec.axis_name))
+    row_sh = NamedSharding(mesh, P(spec.axis_name, None))
+    gk = jax.device_put(pk, key_sh)
+    gv = jax.device_put(pv, row_sh)
+    gn = jax.device_put(nv, key_sh)
+
+    attempt_spec = spec
+    for attempt in range(max_attempts):
+        fn = build_distributed_sort(mesh, attempt_spec)
+        out_keys, out_pay, counts = fn(gk, gv, gn)
+        counts_h = np.asarray(counts)
+        if (counts_h <= attempt_spec.recv_capacity).all():
+            rc = attempt_spec.recv_capacity
+            ka = np.asarray(out_keys).reshape(n, rc)
+            pa = np.asarray(out_pay).reshape(n, rc, spec.width)
+            sk = np.concatenate([ka[s, : counts_h[s]] for s in range(n)])
+            sp = np.concatenate([pa[s, : counts_h[s]] for s in range(n)])
+            return sk, sp
+        attempt_spec = replace(
+            attempt_spec, recv_capacity=2 * attempt_spec.recv_capacity
+        )
+    raise RuntimeError(
+        f"sort overflowed recv_capacity {attempt_spec.recv_capacity // 2} after "
+        f"{max_attempts} doublings — key distribution too skewed for range "
+        f"partitioning (most keys identical?)"
+    )

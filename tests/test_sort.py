@@ -184,3 +184,56 @@ class TestDistributedSort:
                 num_executors=N, capacity=8, recv_capacity=8,
                 samples_per_shard=2, impl="dense",
             ).validate()
+
+
+class TestRunDistributedSort:
+    """Host driver with automatic skew retry (run_distributed_sort)."""
+
+    def test_uniform_keys_roundtrip(self, rng):
+        from sparkucx_tpu.ops.sort import SortSpec, oracle_sort, run_distributed_sort
+        from sparkucx_tpu.ops.exchange import make_mesh
+
+        n, total = 4, 3000
+        keys = rng.integers(0, 1 << 31, size=total, dtype=np.uint32)
+        payload = rng.integers(-99, 99, size=(total, 3), dtype=np.int32)
+        spec = SortSpec(
+            num_executors=n, capacity=1024, recv_capacity=1536, width=3, impl="dense"
+        )
+        sk, sp = run_distributed_sort(make_mesh(n), spec, keys, payload)
+        ok, op = oracle_sort(keys, payload)
+        assert np.array_equal(sk, ok)
+        # payload rows must travel with their keys (same multiset per key)
+        assert sorted(map(tuple, sp)) == sorted(map(tuple, op))
+
+    def test_skewed_keys_trigger_retry(self, rng):
+        from sparkucx_tpu.ops.sort import SortSpec, oracle_sort, run_distributed_sort
+        from sparkucx_tpu.ops.exchange import make_mesh
+
+        n, total = 4, 2000
+        # 90% of keys identical: one range gets almost everything, so the
+        # balanced recv_capacity must overflow and the driver must double it
+        keys = np.where(
+            rng.uniform(size=total) < 0.9,
+            np.uint32(7),
+            rng.integers(0, 1 << 31, size=total).astype(np.uint32),
+        )
+        payload = rng.integers(-99, 99, size=(total, 1), dtype=np.int32)
+        spec = SortSpec(
+            num_executors=n, capacity=512, recv_capacity=600, width=1, impl="dense"
+        )
+        sk, sp = run_distributed_sort(make_mesh(n), spec, keys, payload)
+        ok, _ = oracle_sort(keys, payload)
+        assert np.array_equal(sk, ok)
+
+    def test_pathological_skew_raises(self, rng):
+        from sparkucx_tpu.ops.sort import SortSpec, run_distributed_sort
+        from sparkucx_tpu.ops.exchange import make_mesh
+
+        n, total = 4, 2000
+        keys = np.full(total, 7, np.uint32)  # every key identical
+        payload = np.zeros((total, 1), np.int32)
+        spec = SortSpec(
+            num_executors=n, capacity=512, recv_capacity=520, width=1, impl="dense"
+        )
+        with pytest.raises(RuntimeError, match="skewed"):
+            run_distributed_sort(make_mesh(n), spec, keys, payload, max_attempts=1)
