@@ -21,6 +21,7 @@ from sparkucx_tpu.ops.relational import (
     JoinSpec,
     build_grouped_aggregate,
     build_hash_join,
+    run_grouped_aggregate,
 )
 from sparkucx_tpu.ops.sort import (
     SortSpec,
@@ -51,6 +52,7 @@ __all__ = [
     "JoinSpec",
     "build_grouped_aggregate",
     "build_hash_join",
+    "run_grouped_aggregate",
     "SortSpec",
     "build_distributed_sort",
     "oracle_sort",

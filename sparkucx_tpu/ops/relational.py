@@ -412,6 +412,70 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
     return fn
 
 
+def run_grouped_aggregate(
+    mesh: Mesh,
+    spec: AggregateSpec,
+    keys: np.ndarray,
+    values: np.ndarray,
+    max_attempts: int = 3,
+):
+    """Host driver: shard, run the compiled GROUP BY, retry with doubled
+    ``recv_capacity`` when hash skew overflows a shard — the GroupByTest job
+    surface (run_distributed_sort's contract for aggregation).
+
+    ``keys``: (T,) uint32; ``values``: (T, len(aggs)).  Returns (group keys
+    ascending, aggregated columns, counts) as host arrays.
+    """
+    n = spec.num_executors
+    total = keys.shape[0]
+    cap = spec.capacity
+    if total > n * cap:
+        raise ValueError(f"{total} rows exceed {n} x {cap} capacity")
+    if mesh.devices.size != n:
+        raise ValueError(f"mesh size {mesh.devices.size} != num_executors {n}")
+
+    pk = np.zeros(n * cap, np.uint32)
+    pv = np.zeros((n * cap, spec.width), spec.dtype)
+    nv = np.zeros(n, np.int32)
+    base, rem = divmod(total, n)
+    start = 0
+    for s in range(n):
+        take = base + (1 if s < rem else 0)
+        pk[s * cap : s * cap + take] = keys[start : start + take]
+        pv[s * cap : s * cap + take] = values[start : start + take]
+        nv[s] = take
+        start += take
+
+    key_sh = NamedSharding(mesh, P(spec.axis_name))
+    row_sh = NamedSharding(mesh, P(spec.axis_name, None))
+    gk = jax.device_put(pk, key_sh)
+    gv = jax.device_put(pv, row_sh)
+    gn = jax.device_put(nv, key_sh)
+
+    attempt_spec = spec
+    for _ in range(max_attempts):
+        fn = build_grouped_aggregate(mesh, attempt_spec)
+        out_k, out_v, out_c, num_groups, recv_totals = fn(gk, gv, gn)
+        if (np.asarray(recv_totals) <= attempt_spec.recv_capacity).all():
+            rc = attempt_spec.recv_capacity
+            ka = np.asarray(out_k).reshape(n, rc)
+            va = np.asarray(out_v).reshape(n, rc, spec.width)
+            ca = np.asarray(out_c).reshape(n, rc)
+            ng = np.asarray(num_groups)
+            keys_h = np.concatenate([ka[s, : ng[s]] for s in range(n)])
+            vals_h = np.concatenate([va[s, : ng[s]] for s in range(n)])
+            cnts_h = np.concatenate([ca[s, : ng[s]] for s in range(n)])
+            order = np.argsort(keys_h)
+            return keys_h[order], vals_h[order], cnts_h[order]
+        attempt_spec = replace(
+            attempt_spec, recv_capacity=2 * attempt_spec.recv_capacity
+        )
+    raise RuntimeError(
+        f"aggregation overflowed recv_capacity {attempt_spec.recv_capacity // 2} "
+        f"after {max_attempts} doublings — hash(key) distribution too skewed"
+    )
+
+
 # ----------------------------------------------------------------------------
 # CPU oracles
 # ----------------------------------------------------------------------------

@@ -274,3 +274,43 @@ class TestHashJoin:
         full = np.full(N, CAP, np.int32)
         _, _, _, _, rt = f(*_join_inputs(mesh, keys, ones, full, keys, ones, full))
         assert np.asarray(rt)[:, 0].max() == N * CAP  # true total, > recv_capacity
+
+
+class TestRunGroupedAggregate:
+    """Host driver with automatic hash-skew retry (run_grouped_aggregate)."""
+
+    def test_roundtrip_vs_oracle(self, rng):
+        from sparkucx_tpu.ops.exchange import make_mesh
+        from sparkucx_tpu.ops.relational import (
+            AggregateSpec, oracle_aggregate, run_grouped_aggregate,
+        )
+
+        n, total = 4, 3000
+        keys = rng.integers(0, 50, size=total).astype(np.uint32)
+        values = rng.integers(-99, 99, size=(total, 2)).astype(np.int32)
+        spec = AggregateSpec(
+            num_executors=n, capacity=1024, recv_capacity=1536,
+            aggs=("sum", "max"), impl="dense",
+        )
+        gk, gv, gc = run_grouped_aggregate(make_mesh(n), spec, keys, values)
+        ok, ov, oc = oracle_aggregate(keys, values, ("sum", "max"))
+        assert np.array_equal(gk, ok)
+        assert np.array_equal(gv, ov)
+        assert np.array_equal(gc, oc)
+
+    def test_single_hot_key_triggers_retry(self, rng):
+        from sparkucx_tpu.ops.exchange import make_mesh
+        from sparkucx_tpu.ops.relational import (
+            AggregateSpec, oracle_aggregate, run_grouped_aggregate,
+        )
+
+        n, total = 4, 2000
+        keys = np.full(total, 42, np.uint32)  # every row hashes to one shard
+        values = rng.integers(0, 10, size=(total, 1)).astype(np.int32)
+        spec = AggregateSpec(
+            num_executors=n, capacity=512, recv_capacity=600,
+            aggs=("sum",), impl="dense",
+        )
+        gk, gv, gc = run_grouped_aggregate(make_mesh(n), spec, keys, values)
+        assert gk.tolist() == [42]
+        assert gv[0, 0] == values.sum() and gc[0] == total
