@@ -47,6 +47,12 @@ def pack_frame(am_id: AmId, header: bytes = b"", body: bytes = b"") -> bytes:
     return _FRAME.pack(int(am_id), len(header), len(body)) + header + body
 
 
+def pack_frame_prefix(am_id: AmId, header: bytes, body_len: int) -> bytes:
+    """Frame prefix announcing a ``body_len``-byte body that the caller sends
+    separately (scatter-send of a large zero-copy reply buffer)."""
+    return _FRAME.pack(int(am_id), len(header), body_len) + header
+
+
 def unpack_frame_header(data: bytes) -> Tuple[AmId, int, int]:
     am_id, hlen, blen = _FRAME.unpack_from(data)
     return AmId(am_id), hlen, blen

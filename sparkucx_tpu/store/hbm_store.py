@@ -305,20 +305,22 @@ class HbmBlockStore:
             self.apply_mapper_info(info)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
-        """unregisterShuffle analogue (UcxShuffleTransport.scala:249-259)."""
+        """unregisterShuffle analogue (UcxShuffleTransport.scala:249-259).
+        The shm closer runs under the store lock so no reader holding the lock
+        can see a staging mapping that is about to be munmapped."""
         with self._lock:
             st = self._shuffles.pop(shuffle_id, None)
-        if st is not None and st.staging_closer is not None:
-            st.staging = None
-            st.staging_closer()
+            if st is not None and st.staging_closer is not None:
+                st.staging = None
+                st.staging_closer()
 
     def close(self) -> None:
         with self._lock:
             states, self._shuffles = list(self._shuffles.values()), {}
-        for st in states:
-            if st.staging_closer is not None:
-                st.staging = None
-                st.staging_closer()
+            for st in states:
+                if st.staging_closer is not None:
+                    st.staging = None
+                    st.staging_closer()
 
     def _state(self, shuffle_id: int) -> _ShuffleState:
         with self._lock:
@@ -431,11 +433,44 @@ class HbmBlockStore:
             if not (hasattr(payload, "is_deleted") and payload.is_deleted()):
                 flat = np.asarray(payload).reshape(-1).view(np.uint8)
                 return flat[e.offset : e.offset + e.length].tobytes()
-        if e.round < len(st.prev_rounds):
-            staging = st.prev_rounds[e.round][0]
-        else:
-            staging = st.staging
-        return staging[e.offset : e.offset + e.length].tobytes()
+        # Lock: (prev_rounds, staging) must be read atomically vs _rollover,
+        # and the bytes copy must complete before a concurrent remove_shuffle
+        # can munmap shm staging (the closer also runs under this lock).
+        with self._lock:
+            if e.round < len(st.prev_rounds):
+                staging = st.prev_rounds[e.round][0]
+            else:
+                staging = st.staging
+            if staging is None:
+                raise TransportError(f"shuffle {shuffle_id} staging already released")
+            return staging[e.offset : e.offset + e.length].tobytes()
+
+    def block_staging_view(
+        self, shuffle_id: int, map_id: int, reduce_id: int
+    ) -> Optional[Tuple[np.ndarray, int, int]]:
+        """Zero-copy serving handle: (host staging uint8 array, offset, length)
+        for a staged block, or None when unknown.  Staging is append-only and
+        retained until ``remove_shuffle`` (it is the shuffle's backing store),
+        so the view stays valid for the shuffle's lifetime even after the seal
+        donated the device copy — this is what the batch reply's native gather
+        (``ts_batch_copy``) reads from."""
+        st = self._state(shuffle_id)
+        e = st.blocks.get((map_id, reduce_id))
+        if e is None:
+            return None
+        with self._lock:
+            staging = (
+                st.prev_rounds[e.round][0] if e.round < len(st.prev_rounds) else st.staging
+            )
+            if staging is None:
+                return None
+            if st.staging_closer is not None:
+                # shm-backed staging can be munmapped by remove_shuffle at any
+                # time after we release the lock — hand out a private copy, not
+                # a view into the mapping (private ndarray staging is safe: a
+                # rollover replaces the reference, never the array contents).
+                return np.array(staging[e.offset : e.offset + e.length]), 0, e.length
+        return staging, e.offset, e.length
 
     def block_length(self, shuffle_id: int, map_id: int, reduce_id: int) -> int:
         """getPartitonLength analogue (NvkvHandler.scala:258-265)."""

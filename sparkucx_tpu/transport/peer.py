@@ -38,6 +38,7 @@ from sparkucx_tpu.core.definitions import (
     AmId,
     MapperInfo,
     pack_frame,
+    pack_frame_prefix,
     unpack_frame_header,
 )
 from sparkucx_tpu.core.operation import (
@@ -167,7 +168,9 @@ class BlockServer:
                 self._accepted.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
-    def _read_one(self, bid: ShuffleBlockId) -> Optional[bytes]:
+    def _resolve_one(self, bid: ShuffleBlockId):
+        """Resolve to ``bytes`` (registry blocks — may hit files) or a
+        zero-copy ``(staging, offset, length)`` view (store blocks) or None."""
         if self.registry_lookup is not None:
             blk = self.registry_lookup(bid)
             if blk is not None:
@@ -175,10 +178,51 @@ class BlockServer:
                     return blk.get_memory_block().to_bytes()
         if self.store is not None:
             try:
-                return self.store.read_block(bid.shuffle_id, bid.map_id, bid.reduce_id)
+                return self.store.block_staging_view(
+                    bid.shuffle_id, bid.map_id, bid.reduce_id
+                )
             except TransportError:
                 return None
         return None
+
+    def _assemble_reply(self, entries) -> Tuple[bytes, "np.ndarray"]:
+        """Build ``(sizes blob, one contiguous body)`` from resolved entries —
+        the reference's single pooled reply buffer (UcxWorkerWrapper.scala:397-448).
+        Store-backed views gather through the native threaded batch copy
+        (ts_batch_copy, the ForkJoin ioThreadPool analogue); only registry
+        blocks take the per-block bytes path."""
+        from sparkucx_tpu import native
+
+        sizes, total = [], 0
+        for e in entries:
+            if e is None:
+                sizes.append(-1)
+            else:
+                ln = len(e) if isinstance(e, bytes) else e[2]
+                sizes.append(ln)
+                total += ln
+        body = np.empty(total, dtype=np.uint8)
+        by_staging: Dict[int, Tuple[np.ndarray, list]] = {}
+        pos = 0
+        for e in entries:
+            if e is None:
+                continue
+            if isinstance(e, bytes):
+                if e:
+                    body[pos : pos + len(e)] = np.frombuffer(e, dtype=np.uint8)
+                pos += len(e)
+            else:
+                staging, off, ln = e
+                if ln:
+                    key = id(staging)
+                    if key not in by_staging:
+                        by_staging[key] = (staging.reshape(-1).view(np.uint8), [])
+                    by_staging[key][1].append((pos, off, ln))
+                pos += ln
+        for src, segs in by_staging.values():
+            native.batch_copy(body, src, segs, max_threads=self.conf.num_io_threads)
+        blob = b"".join(_SIZE.pack(s) for s in sizes)
+        return blob, body
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
@@ -190,15 +234,16 @@ class BlockServer:
                 if am_id == AmId.FETCH_BLOCK_REQ:
                     tag, bids = unpack_batch_fetch_req(header)
                     if self._io is not None:
-                        payloads = list(self._io.map(self._read_one, bids))
+                        entries = list(self._io.map(self._resolve_one, bids))
                     else:
-                        payloads = [self._read_one(b) for b in bids]
-                    sizes = b"".join(
-                        _SIZE.pack(-1 if p is None else len(p)) for p in payloads
-                    )
+                        entries = [self._resolve_one(b) for b in bids]
+                    sizes, body = self._assemble_reply(entries)
                     reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
-                    reply_body = b"".join(p for p in payloads if p is not None)
-                    conn.sendall(pack_frame(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, reply_body))
+                    conn.sendall(
+                        pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, body.size)
+                    )
+                    if body.size:
+                        conn.sendall(memoryview(body))
                 elif am_id == AmId.MAPPER_INFO:
                     info = MapperInfo.unpack(body)
                     if self.store is not None:

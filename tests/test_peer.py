@@ -223,3 +223,68 @@ class TestMultiProcess:
                 pass
             proc.terminate()
             proc.wait(timeout=10)
+
+
+class TestNativeReplyAssembly:
+    """Zero-copy reply assembly (_assemble_reply + block_staging_view): store
+    blocks gather through ts_batch_copy from host staging; registry blocks and
+    failures keep the bytes path."""
+
+    def test_mixed_sources_roundtrip(self):
+        import numpy as np
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.core.block import BytesBlock, ShuffleBlockId
+        from sparkucx_tpu.store.hbm_store import HbmBlockStore
+        from sparkucx_tpu.transport.peer import BlockServer
+
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20, block_alignment=128)
+        store = HbmBlockStore(conf)
+        store.create_shuffle(7, 1, 3)
+        w = store.map_writer(7, 0)
+        rng = np.random.default_rng(3)
+        p0 = rng.integers(0, 256, size=999, dtype=np.uint8).tobytes()
+        w.write_partition(0, p0)
+        w.write_partition(1, b"")          # empty block
+        w.write_partition(2, b"z" * 300)
+        w.commit()
+
+        reg_payload = b"registry-bytes" * 10
+        registry = {ShuffleBlockId(9, 0, 0): BytesBlock(np.frombuffer(reg_payload, np.uint8))}
+
+        srv = BlockServer(conf, store=store, registry_lookup=registry.get)
+        try:
+            bids = [
+                ShuffleBlockId(7, 0, 0),   # store view
+                ShuffleBlockId(9, 0, 0),   # registry bytes
+                ShuffleBlockId(7, 0, 1),   # empty store block
+                ShuffleBlockId(7, 0, 99),  # missing -> -1
+                ShuffleBlockId(7, 0, 2),   # store view again (same staging)
+            ]
+            entries = [srv._resolve_one(b) for b in bids]
+            sizes_blob, body = srv._assemble_reply(entries)
+            import struct
+
+            sizes = struct.unpack(f"<{len(bids)}q", sizes_blob)
+            assert sizes == (999, len(reg_payload), 0, -1, 300)
+            got = bytes(body)
+            assert got == p0 + reg_payload + b"z" * 300
+        finally:
+            srv.close()
+
+    def test_view_survives_seal(self):
+        import numpy as np
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.store.hbm_store import HbmBlockStore
+
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20, block_alignment=128)
+        store = HbmBlockStore(conf)
+        store.create_shuffle(1, 1, 1)
+        w = store.map_writer(1, 0)
+        w.write_partition(0, b"q" * 500)
+        w.commit()
+        store.seal(1)
+        view = store.block_staging_view(1, 0, 0)
+        assert view is not None
+        staging, off, ln = view
+        assert ln == 500
+        assert staging[off : off + ln].tobytes() == b"q" * 500
