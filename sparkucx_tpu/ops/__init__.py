@@ -15,6 +15,10 @@ from sparkucx_tpu.ops.exchange import (
     pack_chunks_slots,
     unpack_received,
 )
+from sparkucx_tpu.ops.hierarchy import (
+    build_hierarchical_exchange,
+    make_hierarchical_mesh,
+)
 from sparkucx_tpu.ops.pallas_kernels import build_block_gather, pack_plan
 from sparkucx_tpu.ops.relational import (
     AggregateSpec,
@@ -46,6 +50,8 @@ __all__ = [
     "oracle_exchange",
     "pack_chunks_slots",
     "unpack_received",
+    "build_hierarchical_exchange",
+    "make_hierarchical_mesh",
     "build_block_gather",
     "pack_plan",
     "AggregateSpec",

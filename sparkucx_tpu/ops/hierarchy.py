@@ -1,0 +1,156 @@
+"""Hierarchical (multi-slice) shuffle exchange — ICI + DCN two-phase routing.
+
+SURVEY.md section 5.8's TPU-native mapping for the reference's transport calls
+for "ICI for intra-slice, DCN for multi-slice".  The flat exchange
+(ops/exchange.py) runs ONE all_to_all over every executor pair — on a
+multi-slice deployment that means S*C*(S-1)*C point-to-point DCN flows of
+block granularity.  This lowering factors the executor mesh into
+``(dcn: slices, ici: chips-per-slice)`` and routes in two phases:
+
+    phase A (ICI):  all_to_all over the chip axis, grouping every chip's
+                    payload by DESTINATION CHIP INDEX — after it, chip c of
+                    slice s holds everything its slice sends to chip c of any
+                    slice;
+    phase B (DCN):  all_to_all over the slice axis delivers those aggregates —
+                    each datum crosses the slower DCN exactly once, in messages
+                    C x bigger than the flat lowering's (the aggregation that
+                    makes DCN all-to-alls viable);
+    compaction:     the received slot grid is packed into the same tight
+                    sender-major layout the flat lowerings produce.
+
+The phases move whole slots (dense) — intra-slice ICI bandwidth is cheap and
+XLA overlaps the two collectives; the contract (inputs, outputs, layouts) is
+IDENTICAL to ``build_exchange``, and the CPU-mesh tests assert bit-equality
+against the flat lowering on a factored mesh.
+
+Flat executor id convention: ``executor = slice * chips_per_slice + chip``
+(dcn-major), matching ``Mesh(devices.reshape(S, C), ("dcn", "ici"))``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.ops.exchange import ExchangeSpec, exclusive_cumsum
+
+
+def make_hierarchical_mesh(
+    num_slices: int, chips_per_slice: int, devices=None
+) -> Mesh:
+    """(dcn, ici) mesh over the first S*C devices, slice-major."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = num_slices * chips_per_slice
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(
+        np.array(devs[:n]).reshape(num_slices, chips_per_slice), ("dcn", "ici")
+    )
+
+
+def _region_permutation(order_outer: int, order_inner: int, slot: int) -> jnp.ndarray:
+    """Row indices permuting a slot grid from (inner-major regions) to
+    (outer-major): new region k = outer*inner_count... returns (rows,) int32.
+
+    Used to regroup regions (a, b) -> (b, a): region at old index
+    ``a * order_inner + b`` moves to new index ``b * order_outer + a``."""
+    idx = np.empty(order_outer * order_inner * slot, dtype=np.int32)
+    pos = 0
+    for b in range(order_inner):
+        for a in range(order_outer):
+            start = (a * order_inner + b) * slot
+            idx[pos : pos + slot] = np.arange(start, start + slot, dtype=np.int32)
+            pos += slot
+    return jnp.asarray(idx)
+
+
+def _compact_slots(flat: jnp.ndarray, recv_sizes: jnp.ndarray, slot: int, recv_rows: int):
+    """Pack a sender-major slot grid into the tight layout (the dense
+    lowering's compaction, shared shape — ops/exchange.py)."""
+    n = recv_sizes.shape[0]
+    starts = exclusive_cumsum(recv_sizes)
+    cum = jnp.cumsum(recv_sizes)
+    total = cum[-1]
+    pos = jnp.arange(recv_rows, dtype=jnp.int32)
+    k = jnp.clip(jnp.searchsorted(cum, pos, side="right").astype(jnp.int32), 0, n - 1)
+    src = k * slot + (pos - starts[k])
+    valid = pos < total
+    rows = flat[jnp.clip(src, 0, n * slot - 1)]
+    return jnp.where(valid[:, None], rows, jnp.zeros((), dtype=flat.dtype))
+
+
+def _hier_shard(spec: ExchangeSpec, num_slices: int, chips: int, data, size_row):
+    slot = spec.slot_rows
+    s_idx = jax.lax.axis_index("dcn")
+    c_idx = jax.lax.axis_index("ici")
+    me = s_idx * chips + c_idx
+
+    # full size matrix: gather over both axes, dcn-major = flat executor order
+    sizes = jax.lax.all_gather(size_row, ("dcn", "ici"), tiled=True)  # (n, n)
+    recv_sizes = sizes[:, me]
+
+    # phase A prep: regions are dest-flat-major (s' outer, c' inner); regroup
+    # to c'-outer so each ICI peer's group is contiguous
+    perm_a = _region_permutation(num_slices, chips, slot)  # (s',c') -> (c',s')
+    grouped = data[perm_a]
+
+    # phase A: ICI all_to_all over the chip axis — after it, this chip holds
+    # its slice's aggregate for chip index c_idx of every slice
+    a = jax.lax.all_to_all(
+        grouped.reshape(chips, num_slices * slot, spec.lane),
+        "ici", split_axis=0, concat_axis=0, tiled=True,
+    ).reshape(chips * num_slices * slot, spec.lane)
+    # layout now: (c_src, s') regions — regroup to s'-outer for the DCN phase
+    perm_b = _region_permutation(chips, num_slices, slot)  # (c_src,s') -> (s',c_src)
+    staged = a[perm_b]
+
+    # phase B: DCN all_to_all over the slice axis — one crossing per datum,
+    # messages aggregated across the whole source slice
+    b = jax.lax.all_to_all(
+        staged.reshape(num_slices, chips * slot, spec.lane),
+        "dcn", split_axis=0, concat_axis=0, tiled=True,
+    ).reshape(num_slices * chips * slot, spec.lane)
+    # layout: (s_src, c_src) regions = flat sender id ascending — compact
+    out = _compact_slots(b, recv_sizes, slot, spec.recv_rows)
+    return out, recv_sizes[None, :]
+
+
+def build_hierarchical_exchange(mesh: Mesh, spec: ExchangeSpec):
+    """Compile the two-phase exchange for a (dcn, ici) mesh.
+
+    Same contract as ``build_exchange`` (ops/exchange.py): jitted
+    ``fn(data, size_matrix) -> (recv, recv_sizes)`` with data/sizes sharded
+    over the FLAT executor order (slice-major product of the two mesh axes).
+    ``spec.num_executors`` must equal S*C.
+    """
+    if set(mesh.axis_names) != {"dcn", "ici"}:
+        raise ValueError(f"mesh axes must be ('dcn', 'ici'), got {mesh.axis_names}")
+    num_slices = mesh.shape["dcn"]
+    chips = mesh.shape["ici"]
+    if spec.num_executors != num_slices * chips:
+        raise ValueError(
+            f"spec.num_executors={spec.num_executors} != {num_slices}x{chips} mesh"
+        )
+    spec.validate()
+
+    shard = jax.shard_map(
+        functools.partial(_hier_shard, spec, num_slices, chips),
+        mesh=mesh,
+        in_specs=(P(("dcn", "ici"), None), P(("dcn", "ici"), None)),
+        out_specs=(P(("dcn", "ici"), None), P(("dcn", "ici"), None)),
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, P(("dcn", "ici"), None))
+    donate = (0,) if spec.send_rows == spec.recv_rows else ()
+    fn = jax.jit(
+        shard,
+        in_shardings=(sharding, sharding),
+        out_shardings=(sharding, sharding),
+        donate_argnums=donate,
+    )
+    fn.spec = spec
+    return fn
