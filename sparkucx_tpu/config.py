@@ -98,6 +98,11 @@ class TpuShuffleConf:
     # TPU mesh (L2)
     mesh_axis_name: str = "ex"
     num_executors: int = 1
+    #: Multi-slice factorization: when > 1, the cluster's exchange routes in
+    #: two phases (ICI aggregate within a slice, ONE DCN crossing between
+    #: slices — ops/hierarchy.py).  Executors are slice-major:
+    #: executor = slice * (num_executors // num_slices) + chip.
+    num_slices: int = 1
 
     #: Keep each executor's received exchange shard resident in HBM after the
     #: superstep, enabling device-side block fetch (ops/pallas_kernels.py) —
@@ -162,6 +167,7 @@ class TpuShuffleConf:
             ("useShmStaging", "use_shm_staging", lambda v: str(v).lower() == "true"),
             ("shmNamespace", "shm_namespace", str),
             ("numExecutors", "num_executors", int),
+            ("numSlices", "num_slices", int),
             ("meshAxisName", "mesh_axis_name", str),
             ("keepDeviceRecv", "keep_device_recv", lambda v: str(v).lower() == "true"),
             ("gatherImpl", "gather_impl", str),
@@ -188,6 +194,10 @@ class TpuShuffleConf:
             raise ValueError("num_executors must be positive")
         if self.gather_impl not in ("auto", "dma", "tiled", "xla"):
             raise ValueError(f"unknown gather_impl {self.gather_impl!r}")
+        if self.num_slices <= 0:
+            raise ValueError("num_slices must be positive")
+        if self.num_slices > 1 and self.num_executors % self.num_slices:
+            raise ValueError("num_executors must be divisible by num_slices")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

@@ -162,7 +162,7 @@ class TpuShuffleCluster:
         return self.conf.block_alignment
 
     def _exchange_fn(self, send_rows: int):
-        key = (self.num_executors, send_rows, self.row_bytes)
+        key = (self.num_executors, send_rows, self.row_bytes, self.conf.num_slices)
         with self._lock:
             fn = self._exchange_cache.get(key)
             if fn is None:
@@ -174,7 +174,22 @@ class TpuShuffleCluster:
                     axis_name=self.conf.mesh_axis_name,
                     impl="auto",
                 )
-                fn = build_exchange(self.mesh, spec)
+                if self.conf.num_slices > 1:
+                    # multi-slice: two-phase ICI+DCN route over the same
+                    # devices, slice-major (ops/hierarchy.py)
+                    from sparkucx_tpu.ops.hierarchy import (
+                        build_hierarchical_exchange,
+                        make_hierarchical_mesh,
+                    )
+
+                    hmesh = make_hierarchical_mesh(
+                        self.conf.num_slices,
+                        self.num_executors // self.conf.num_slices,
+                        devices=list(self.mesh.devices.reshape(-1)),
+                    )
+                    fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
+                else:
+                    fn = build_exchange(self.mesh, spec)
                 self._exchange_cache[key] = fn
         return fn
 

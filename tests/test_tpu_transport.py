@@ -212,3 +212,32 @@ class TestRegistry:
         assert t.registered_block(bid) is not None
         t.unregister_shuffle(99)
         assert t.registered_block(bid) is None
+
+
+class TestHierarchicalCluster:
+    """numSlices > 1 routes the cluster's superstep through the two-phase
+    ICI+DCN exchange (ops/hierarchy.py) — same results, different lowering."""
+
+    def test_full_shuffle_vs_oracle_two_slices(self, rng):
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 20,
+            block_alignment=128,
+            num_executors=N_EXEC,
+            num_slices=2,
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        M, R = 8, 16
+        meta, oracle = _run_shuffle(cluster, 0, M, R, rng)
+        for r in range(R):
+            consumer = meta.owner_of_reduce(r)
+            t = cluster.transport(consumer)
+            bids = [ShuffleBlockId(0, m, r) for m in range(M)]
+            bufs = [_buf(4096) for _ in range(M)]
+            t.fetch_blocks_by_block_ids(consumer, bids, bufs, [None] * M)
+            for m, buf in enumerate(bufs):
+                got = buf.host_view()[: buf.size].tobytes()
+                assert got == oracle[(m, r)], f"mismatch map={m} reduce={r}"
+
+    def test_invalid_factorization_rejected(self):
+        with pytest.raises(ValueError, match="divisible"):
+            TpuShuffleConf().replace(num_executors=8, num_slices=3)
