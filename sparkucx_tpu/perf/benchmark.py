@@ -66,6 +66,10 @@ def _parse_args(argv):
     p.add_argument("-t", "--threads", type=int, default=1)
     p.add_argument("--executors", type=int, default=1, help="mesh size (superstep mode)")
     p.add_argument(
+        "--slices", type=int, default=1,
+        help="factor the superstep mesh into this many slices (two-phase ICI+DCN route)",
+    )
+    p.add_argument(
         "--impl", default="auto", choices=["auto", "dma", "tiled", "xla"],
         help="block-gather lowering (gather mode)",
     )
@@ -153,15 +157,25 @@ def run_superstep(args) -> None:
     rows_per_peer = max(1, size // 512)
     send_rows = n * rows_per_peer
     spec = ExchangeSpec(num_executors=n, send_rows=send_rows, recv_rows=send_rows, lane=128)
-    mesh = make_mesh(n)
-    fn = build_exchange(mesh, spec)
+    if args.slices > 1:
+        from sparkucx_tpu.ops.hierarchy import (
+            build_hierarchical_exchange,
+            make_hierarchical_mesh,
+        )
+
+        mesh = make_hierarchical_mesh(args.slices, n // args.slices)
+        fn = build_hierarchical_exchange(mesh, spec.resolve_impl())
+        sharding = NamedSharding(mesh, P(("dcn", "ici"), None))
+    else:
+        mesh = make_mesh(n)
+        fn = build_exchange(mesh, spec)
+        sharding = NamedSharding(mesh, P("ex", None))
     rng = np.random.default_rng(0)
     data = jax.device_put(
-        rng.integers(-100, 100, size=(n * send_rows, 128), dtype=np.int32),
-        NamedSharding(mesh, P("ex", None)),
+        rng.integers(-100, 100, size=(n * send_rows, 128), dtype=np.int32), sharding
     )
     sizes = jax.device_put(
-        np.full((n, n), rows_per_peer, dtype=np.int32), NamedSharding(mesh, P("ex", None))
+        np.full((n, n), rows_per_peer, dtype=np.int32), sharding
     )
     out, _ = fn(data, sizes)
     jax.block_until_ready(out)

@@ -84,3 +84,13 @@ def test_sort_mode(capsys):
     )
     out = capsys.readouterr().out
     assert "rows/s" in out and out.count("iter") == 2
+
+
+def test_superstep_hierarchical_mode(capsys):
+    benchmark.run_superstep(
+        benchmark._parse_args(
+            ["superstep", "-s", "64k", "-i", "1", "-o", "2", "--executors", "8", "--slices", "2"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert out.count("GB/s") == 1
