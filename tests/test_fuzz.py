@@ -1,0 +1,76 @@
+"""Randomized differential tests: arbitrary shuffle shapes through the full
+cluster path vs the host oracle — the safety net over dimension/padding edge
+cases (empty blocks, empty maps, odd M/R vs executor counts, tiny alignments,
+multi-round spill) that targeted tests enumerate one at a time."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus
+from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+
+def _run_case(seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    n = int(rng.choice([1, 2, 3, 4, 8]))
+    M = int(rng.integers(1, 12))
+    R = int(rng.integers(1, 12))
+    alignment = int(rng.choice([128, 256, 512]))
+    # capacity small enough that some seeds force multi-round spill
+    capacity = int(rng.choice([1 << 14, 1 << 16, 1 << 20]))
+    num_slices = int(rng.choice([1, 2])) if n % 2 == 0 and n >= 4 else 1
+    # a single partition must fit one peer region (the store's documented
+    # contract — larger blocks are a config error it raises on)
+    region = (capacity // n) // alignment * alignment
+    max_block = min(int(rng.choice([0, 17, 300, 4000])), region)
+
+    conf = TpuShuffleConf(
+        staging_capacity_per_executor=capacity,
+        block_alignment=alignment,
+        num_executors=n,
+        num_slices=num_slices,
+    )
+    cluster = TpuShuffleCluster(conf, num_executors=n)
+    meta = cluster.create_shuffle(0, M, R)
+    oracle = {}
+    try:
+        for m in range(M):
+            t = cluster.transport(meta.map_owner[m])
+            w = t.store.map_writer(0, m)
+            for r in range(R):
+                size = int(rng.integers(0, max_block + 1)) if max_block else 0
+                payload = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+                oracle[(m, r)] = payload
+                w.write_partition(r, payload)
+            t.commit_block(w.commit().pack())
+        cluster.run_exchange(0)
+        for r in range(R):
+            consumer = meta.owner_of_reduce(r)
+            t = cluster.transport(consumer)
+            bids = [ShuffleBlockId(0, m, r) for m in range(M)]
+            bufs = [
+                MemoryBlock(np.zeros(max_block + 1, np.uint8), size=max_block + 1)
+                for _ in range(M)
+            ]
+            reqs = t.fetch_blocks_by_block_ids(consumer, bids, bufs, [None] * M)
+            for m, (req, buf) in enumerate(zip(reqs, bufs)):
+                res = req.wait(5)
+                assert res.status == OperationStatus.SUCCESS, (
+                    f"seed={seed} n={n} M={M} R={R} align={alignment} "
+                    f"slices={num_slices}: {res.error}"
+                )
+                got = buf.host_view()[: buf.size].tobytes()
+                assert got == oracle[(m, r)], (
+                    f"seed={seed} n={n} M={M} R={R} align={alignment} cap={capacity} "
+                    f"slices={num_slices} block=({m},{r}): "
+                    f"{len(got)}B != {len(oracle[(m, r)])}B"
+                )
+    finally:
+        cluster.remove_shuffle(0)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_shuffle_shapes(seed):
+    _run_case(seed)
