@@ -255,7 +255,10 @@ class BlockServer:
                     (eid,) = _TAG.unpack_from(header)
                     self.handshaken[eid] = body
                     conn.sendall(pack_frame(AmId.INIT_EXECUTOR_ACK, header, b""))
-        except (OSError, ValueError):
+        except (OSError, ValueError, struct.error):
+            # malformed frame or dead socket: drop THIS connection, keep serving
+            # (the reference's endpoint error handler evicts one endpoint,
+            # UcxWorkerWrapper.scala:248-253)
             pass
         finally:
             conn.close()

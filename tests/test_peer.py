@@ -288,3 +288,47 @@ class TestNativeReplyAssembly:
         staging, off, ln = view
         assert ln == 500
         assert staging[off : off + ln].tobytes() == b"q" * 500
+
+
+class TestMalformedFrames:
+    """A misbehaving client must cost only its own connection — the server
+    keeps serving others (endpoint-eviction semantics,
+    UcxWorkerWrapper.scala:248-253)."""
+
+    def test_garbage_then_valid_client(self):
+        import socket as socketlib
+        import struct as structlib
+
+        import numpy as np
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.core.block import BytesBlock, ShuffleBlockId
+        from sparkucx_tpu.transport.peer import BlockServer, PeerTransport
+
+        conf = TpuShuffleConf()
+        payload = b"served" * 100
+        registry = {ShuffleBlockId(0, 0, 0): BytesBlock(np.frombuffer(payload, np.uint8))}
+        srv = BlockServer(conf, registry_lookup=registry.get)
+        try:
+            for garbage in (
+                b"\x00" * 16,                                   # bogus frame header
+                structlib.pack("<iqq", 3, 4, 10) + b"\xff" * 14,  # FETCH req, truncated header
+                b"short",
+            ):
+                s = socketlib.create_connection(srv.address, timeout=5)
+                s.sendall(garbage)
+                s.close()
+
+            # the server must still serve a well-formed client
+            t = PeerTransport(conf, executor_id=5)
+            t.add_executor(0, srv.address_bytes())
+            from sparkucx_tpu.core.block import MemoryBlock
+            buf = MemoryBlock(np.zeros(1024, np.uint8), size=1024)
+            [req] = t.fetch_blocks_by_block_ids(0, [ShuffleBlockId(0, 0, 0)], [buf], [None])
+            while not req.completed():
+                t.progress()
+            res = req.wait(5)
+            assert res.status.name == "SUCCESS", str(res.error)
+            assert buf.host_view()[: buf.size].tobytes() == payload
+            t.close()
+        finally:
+            srv.close()
