@@ -6,7 +6,11 @@ compilation serves every batch.  See each module's docstring for the reference
 behavior it reproduces.
 """
 
-from sparkucx_tpu.ops.columnar import ColumnarSpec, build_columnar_shuffle
+from sparkucx_tpu.ops.columnar import (
+    ColumnarSpec,
+    build_columnar_shuffle,
+    run_columnar_shuffle,
+)
 from sparkucx_tpu.ops.exchange import (
     ExchangeSpec,
     build_exchange,
@@ -44,6 +48,7 @@ from sparkucx_tpu.ops.tc import (
 __all__ = [
     "ColumnarSpec",
     "build_columnar_shuffle",
+    "run_columnar_shuffle",
     "ExchangeSpec",
     "build_exchange",
     "make_mesh",

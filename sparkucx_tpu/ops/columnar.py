@@ -176,6 +176,40 @@ def build_columnar_shuffle(mesh: Mesh, spec: ColumnarSpec):
     return fn
 
 
+def run_columnar_shuffle(
+    mesh: Mesh,
+    spec: ColumnarSpec,
+    rows,
+    owners,
+    max_attempts: int = 3,
+):
+    """Overflow-retry wrapper (the job surface of run_distributed_sort /
+    run_grouped_aggregate, for data already resident on device): runs the
+    compiled shuffle and doubles ``recv_capacity`` when a destination's row
+    count exceeds it.
+
+    ``rows``/``owners`` may be host or device arrays shaped per
+    ``build_columnar_shuffle``.  Returns (recv_rows, recv_counts) with the
+    final (possibly enlarged) capacity.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rows = jax.device_put(rows, NamedSharding(mesh, P(spec.axis_name, None)))
+    owners = jax.device_put(owners, NamedSharding(mesh, P(spec.axis_name)))
+    attempt_spec = spec
+    for _ in range(max_attempts):
+        fn = build_columnar_shuffle(mesh, attempt_spec)
+        recv, counts = fn(rows, owners)
+        per_dest = np.asarray(counts).sum(axis=1)
+        if (per_dest <= attempt_spec.recv_capacity).all():
+            return recv, counts
+        attempt_spec = replace(attempt_spec, recv_capacity=2 * attempt_spec.recv_capacity)
+    raise RuntimeError(
+        f"columnar shuffle overflowed recv_capacity {attempt_spec.recv_capacity // 2} "
+        f"after {max_attempts} doublings — destination skew too extreme"
+    )
+
+
 def owners_from_partitions(
     partition_ids: jnp.ndarray, num_partitions: int, num_executors: int
 ) -> jnp.ndarray:

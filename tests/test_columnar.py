@@ -119,3 +119,38 @@ class TestOwnersFromPartitions:
         owners = np.asarray(owners_from_partitions(pids, 6, 3))
         assert owners[0] == 3 and owners[3] == 3
         assert 0 <= owners[1] < 3 and 0 <= owners[2] < 3
+
+
+class TestRunColumnarShuffle:
+    """Overflow-retry wrapper for device-resident repartitioning."""
+
+    def test_skewed_destinations_trigger_retry(self, rng):
+        from sparkucx_tpu.ops.columnar import ColumnarSpec, run_columnar_shuffle
+        from sparkucx_tpu.ops.exchange import make_mesh
+
+        n, cap = 4, 256
+        mesh = make_mesh(n)
+        rows = rng.normal(size=(n * cap, 4)).astype(np.float32)
+        owners = np.zeros(n * cap, np.int32)  # everything to executor 0
+        spec = ColumnarSpec(
+            num_executors=n, capacity=cap, recv_capacity=cap, width=4, impl="dense"
+        )
+        recv, counts = run_columnar_shuffle(mesh, spec, rows, owners)
+        per_dest = np.asarray(counts).sum(axis=1)
+        assert per_dest[0] == n * cap and per_dest[1:].sum() == 0
+        got = np.asarray(recv)[: n * cap]
+        assert sorted(map(tuple, got)) == sorted(map(tuple, rows))
+
+    def test_no_retry_when_balanced(self, rng):
+        from sparkucx_tpu.ops.columnar import ColumnarSpec, run_columnar_shuffle
+        from sparkucx_tpu.ops.exchange import make_mesh
+
+        n, cap = 4, 64
+        mesh = make_mesh(n)
+        rows = rng.normal(size=(n * cap, 2)).astype(np.float32)
+        owners = (np.arange(n * cap) % n).astype(np.int32)
+        spec = ColumnarSpec(
+            num_executors=n, capacity=cap, recv_capacity=2 * cap, width=2, impl="dense"
+        )
+        recv, counts = run_columnar_shuffle(mesh, spec, rows, owners)
+        assert int(np.asarray(counts).sum()) == n * cap
