@@ -121,6 +121,8 @@ def run_client(args) -> None:
                 )
                 while not all(r.completed() for r in reqs):
                     transport.progress()
+                    # wakeup park instead of burning the recv thread's GIL
+                    transport.wait_for_activity(0.002)
                 for r in reqs:
                     res = r.wait(1)
                     assert res.status == OperationStatus.SUCCESS, str(res.error)

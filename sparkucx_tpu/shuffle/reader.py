@@ -153,9 +153,17 @@ class TpuShuffleReader:
                 requests.extend((bid, buf, req) for (bid, buf), req in zip(items, reqs))
 
             t0 = time.monotonic_ns()
+            # wakeup park between polls when the transport supports it
+            # (use_wakeup; GlobalWorkerRpcThread.scala:46-58) — a local fetch
+            # completes on the first poll so the wait never fires there
+            park = getattr(self.transport, "wait_for_activity", None)
             with span("read.window", shuffle_id=self.shuffle_id, blocks=len(window)):
                 while not all(req.completed() for _, _, req in requests):
                     self.transport.progress()
+                    if park is not None and not all(
+                        req.completed() for _, _, req in requests
+                    ):
+                        park(0.002)
             self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
 
             for bid, buf, req in requests:

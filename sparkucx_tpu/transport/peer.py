@@ -293,31 +293,109 @@ class _PeerConnection:
     """One client connection: sender + receiver thread parking acks for progress().
 
     The endpoint-cache entry of the reference (UcxWorkerWrapper.scala:64,233-276).
+    Fetch-ack bodies are received **directly into the caller's result buffers**
+    (``ack_buffers`` lookup) — the RNDV-into-registered-bounce-buffer receive
+    (UcxWorkerWrapper.scala:142-185) rather than parking a parsed copy; the
+    parked frame then carries an empty body and progress() only completes
+    requests.  ``activity`` is set whenever a frame parks (the wakeup doorbell).
     """
 
-    def __init__(self, address: Tuple[str, int]) -> None:
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        ack_buffers: Optional[Callable[[int], Optional[list]]] = None,
+        ack_done: Optional[Callable[[int], None]] = None,
+        activity: Optional[threading.Event] = None,
+    ) -> None:
         self.sock = socket.create_connection(address, timeout=30)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.pending: Dict[int, Callable[[bytes, bytes], None]] = {}
         self.lock = threading.Lock()
-        self.inbox: Deque[Tuple[AmId, bytes, bytes]] = deque()
+        #: parked (am_id, header, body, scattered) frames; ``scattered`` marks
+        #: acks whose payload already sits in the caller's result buffers
+        self.inbox: Deque[Tuple[AmId, bytes, bytes, bool]] = deque()
         self.inbox_lock = threading.Lock()
+        self.ack_buffers = ack_buffers
+        self.ack_done = ack_done
+        self.activity = activity
         self.alive = True
         self.recv_thread = threading.Thread(target=self._recv_loop, daemon=True)
         self.recv_thread.start()
 
+    def _recv_ack_into_buffers(self, header: bytes, blen: int) -> bool:
+        """Scatter a fetch-ack body straight into the batch's result buffers.
+        Returns False when the buffers are unknown (caller falls back to a
+        parked bytes body)."""
+        if self.ack_buffers is None:
+            return False
+        (tag,) = _TAG.unpack_from(header, 0)
+        (count,) = _COUNT.unpack_from(header, _TAG.size)
+        sizes = [
+            _SIZE.unpack_from(header, _TAG.size + _COUNT.size + i * _SIZE.size)[0]
+            for i in range(count)
+        ]
+        # Trust the FRAME boundary, not the header: a skewed/buggy peer whose
+        # size list disagrees with blen would otherwise make us read past the
+        # frame into the next one.  Fall back to the parked-bytes path, which
+        # fails loudly instead of completing with corrupt data.
+        if sum(s for s in sizes if s > 0) != blen:
+            return False
+        bufs = self.ack_buffers(tag)
+        if bufs is None or len(bufs) != count:
+            return False
+        for i in range(count):
+            size = sizes[i]
+            if size <= 0:
+                continue
+            view = bufs[i].host_view() if bufs[i] is not None else None
+            if view is not None and size <= view.size:
+                mv = memoryview(view)[:size]
+                while mv.nbytes:
+                    n = self.sock.recv_into(mv, mv.nbytes)
+                    if n == 0:
+                        raise OSError("peer closed mid-body")
+                    mv = mv[n:]
+            else:  # oversized/unknown: drain and let progress() report failure
+                if _recv_exact(self.sock, size) is None:
+                    raise OSError("peer closed mid-body")
+        return True
+
     def _recv_loop(self) -> None:
         try:
             while self.alive:
-                frame = _recv_frame(self.sock)
-                if frame is None:
+                hdr = _recv_exact(self.sock, FRAME_HEADER_SIZE)
+                if hdr is None:
                     break
+                am_id, hlen, blen = unpack_frame_header(hdr)
+                if hlen + blen > _MAX_FRAME:
+                    raise ValueError("frame too large")
+                header = _recv_exact(self.sock, hlen) if hlen else b""
+                if hlen and header is None:
+                    break
+                scattered = False
+                if am_id == AmId.FETCH_BLOCK_REQ_ACK and self.ack_buffers is not None:
+                    (tag,) = _TAG.unpack_from(header, 0)
+                    try:
+                        scattered = self._recv_ack_into_buffers(header, blen)
+                    finally:
+                        if self.ack_done is not None:
+                            self.ack_done(tag)
+                if not scattered:
+                    body = _recv_exact(self.sock, blen) if blen else b""
+                    if blen and body is None:
+                        break
+                else:
+                    body = b""  # payload already scattered into result buffers
                 # park — completion happens under progress() (explicit-poll contract)
                 with self.inbox_lock:
-                    self.inbox.append(frame)
-        except (OSError, ValueError):
+                    self.inbox.append((am_id, header, body, scattered))
+                if self.activity is not None:
+                    self.activity.set()
+        except (OSError, ValueError, struct.error):
             pass
         self.alive = False
+        if self.activity is not None:
+            self.activity.set()  # wake parked waiters so they observe the death
         try:  # release the fd as soon as the peer is gone
             self.sock.close()
         except OSError:
@@ -327,7 +405,7 @@ class _PeerConnection:
         with self.lock:
             self.sock.sendall(frame)
 
-    def drain_one(self) -> Optional[Tuple[AmId, bytes, bytes]]:
+    def drain_one(self) -> Optional[Tuple[AmId, bytes, bytes, bool]]:
         with self.inbox_lock:
             return self.inbox.popleft() if self.inbox else None
 
@@ -368,7 +446,37 @@ class PeerTransport(ShuffleTransport):
         self._next_tag = 0
         self._tag_lock = threading.Lock()
         self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]], Optional[_PeerConnection]]] = {}
+        self._scattering: set = set()
+        self._zombies: List[_PeerConnection] = []  # evicted, not yet drained
         self.stats_agg = StatsAggregator() if self.conf.collect_stats else None
+        #: Wakeup doorbell (conf.use_wakeup): recv threads set it when an ack
+        #: parks, so fetch loops can sleep in wait_for_activity() instead of
+        #: busy-spinning progress() against the receiver's GIL slices.
+        self._activity = threading.Event()
+
+    def _ack_buffers(self, tag: int) -> Optional[list]:
+        """Recv-thread lookup: the batch's result buffers, WITHOUT popping the
+        inflight entry (progress() still owns completion).  Marks the tag as
+        scattering so a concurrent eviction cannot fail-and-release the buffers
+        while the recv thread writes into them; ``_ack_buffers_done`` clears."""
+        with self._tag_lock:
+            entry = self._inflight.get(tag)
+            if entry is None:
+                return None
+            self._scattering.add(tag)
+            return list(entry[1])
+
+    def _ack_buffers_done(self, tag: int) -> None:
+        with self._tag_lock:
+            self._scattering.discard(tag)
+
+    def wait_for_activity(self, timeout: float = 0.01) -> None:
+        """Park until a recv thread posts an ack (or timeout) — the wakeup-mode
+        progress contract (GlobalWorkerRpcThread.scala:46-58).  No-op when
+        ``use_wakeup`` is off (pure busy-spin, like UCX without wakeup)."""
+        if self.conf.use_wakeup:
+            self._activity.wait(timeout)
+            self._activity.clear()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -383,8 +491,9 @@ class PeerTransport(ShuffleTransport):
 
     def close(self) -> None:
         with self._conn_lock:
-            conns = list(self._conns.values())
+            conns = list(self._conns.values()) + self._zombies
             self._conns.clear()
+            self._zombies = []
         for c in conns:
             c.close()
         for reqs, _, _, _ in list(self._inflight.values()):
@@ -455,7 +564,12 @@ class PeerTransport(ShuffleTransport):
                     break
             pending.wait(timeout=60)
         try:
-            conn = _PeerConnection(addr)
+            conn = _PeerConnection(
+                addr,
+                ack_buffers=self._ack_buffers,
+                ack_done=self._ack_buffers_done,
+                activity=self._activity,
+            )
         except OSError:
             with self._conn_lock:
                 self._connecting.pop(key, None)
@@ -561,6 +675,11 @@ class PeerTransport(ShuffleTransport):
         key = (executor_id, self._slot())
         with self._conn_lock:
             conn = self._conns.pop(key, None)
+            if conn is not None:
+                # keep the evicted conn visible to progress() until every tag
+                # riding it resolves — a mid-scatter ack must still be able to
+                # park and complete (or be swept once the recv thread dies)
+                self._zombies.append(conn)
         if conn is not None:
             conn.close()
             # Other batches still riding this connection will never get acks —
@@ -568,9 +687,22 @@ class PeerTransport(ShuffleTransport):
             self._fail_conn_inflight([conn])
 
     def _fail_conn_inflight(self, conns) -> None:
+        # honor acks that already arrived: drain parked frames first so only
+        # genuinely unanswered batches are failed
+        for conn in conns:
+            while True:
+                frame = conn.drain_one()
+                if frame is None:
+                    break
+                self._handle_frame(frame)
         with self._tag_lock:
             doomed = [
-                (tag, entry) for tag, entry in self._inflight.items() if entry[3] in conns
+                (tag, entry)
+                for tag, entry in self._inflight.items()
+                # a tag mid-scatter is skipped: its recv thread owns the
+                # buffers right now; it will either park the frame (normal
+                # completion) or die, after which the next sweep collects it
+                if entry[3] in conns and tag not in self._scattering
             ]
             for tag, _ in doomed:
                 del self._inflight[tag]
@@ -593,18 +725,26 @@ class PeerTransport(ShuffleTransport):
         and leaks them, UcxWorkerWrapper.scala:351-353 — we do better)."""
         with self._conn_lock:
             conns = list(self._conns.values())
-        for conn in conns:
+            zombies = list(self._zombies)
+        for conn in conns + zombies:
             while True:
                 frame = conn.drain_one()
                 if frame is None:
                     break
                 self._handle_frame(frame)
-        dead = [c for c in conns if not c.alive]
+        dead = [c for c in conns + zombies if not c.alive]
         if dead:
             self._fail_conn_inflight(dead)
+        if zombies:
+            # retire zombies once nothing references them: no inflight tag
+            # rides them and their inbox is drained
+            with self._tag_lock:
+                riding = {entry[3] for entry in self._inflight.values()}
+            with self._conn_lock:
+                self._zombies = [z for z in self._zombies if z in riding or z.inbox]
 
-    def _handle_frame(self, frame: Tuple[AmId, bytes, bytes]) -> None:
-        am_id, header, body = frame
+    def _handle_frame(self, frame: Tuple[AmId, bytes, bytes, bool]) -> None:
+        am_id, header, body, scattered = frame
         if am_id != AmId.FETCH_BLOCK_REQ_ACK:
             return
         (tag,) = _TAG.unpack_from(header, 0)
@@ -618,6 +758,9 @@ class PeerTransport(ShuffleTransport):
             _SIZE.unpack_from(header, _TAG.size + _COUNT.size + i * _SIZE.size)[0]
             for i in range(count)
         ]
+        # Scattered acks (explicit flag from the recv thread): the payload
+        # already sits in the result buffers; only completion remains here.
+        pre_filled = scattered
         pos = 0
         for i, (req, buf, cb) in enumerate(zip(reqs, bufs, cbs)):
             size = sizes[i]
@@ -629,10 +772,9 @@ class PeerTransport(ShuffleTransport):
                     stats=req.stats,
                 )
             else:
-                payload = body[pos : pos + size]
-                pos += size
                 view = buf.host_view()
                 if size > view.size:
+                    pos += size
                     req.stats.mark_done()
                     result = OperationResult(
                         OperationStatus.FAILURE,
@@ -642,7 +784,9 @@ class PeerTransport(ShuffleTransport):
                         stats=req.stats,
                     )
                 else:
-                    view[:size] = np.frombuffer(payload, dtype=np.uint8)
+                    if not pre_filled:
+                        view[:size] = np.frombuffer(body[pos : pos + size], dtype=np.uint8)
+                        pos += size
                     buf.size = size
                     req.stats.mark_done(recv_size=size)
                     result = OperationResult(OperationStatus.SUCCESS, stats=req.stats, data=buf)

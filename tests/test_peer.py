@@ -332,3 +332,51 @@ class TestMalformedFrames:
             t.close()
         finally:
             srv.close()
+
+
+class TestEvictedConnectionDrain:
+    """An ack that parked before its connection was evicted must still
+    complete under progress() (the zombie-drain path) — before, eviction
+    removed the conn from the cache and its parked frames were lost."""
+
+    def test_parked_ack_survives_eviction(self):
+        import time as timelib
+
+        import numpy as np
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.core.block import BytesBlock, MemoryBlock, ShuffleBlockId
+        from sparkucx_tpu.transport.peer import BlockServer, PeerTransport
+
+        conf = TpuShuffleConf()
+        payload = b"evict-me" * 200
+        registry = {ShuffleBlockId(0, 0, 0): BytesBlock(np.frombuffer(payload, np.uint8))}
+        srv = BlockServer(conf, registry_lookup=registry.get)
+        t = PeerTransport(conf, executor_id=3)
+        try:
+            t.add_executor(0, srv.address_bytes())
+            buf = MemoryBlock(np.zeros(4096, np.uint8), size=4096)
+            [req] = t.fetch_blocks_by_block_ids(0, [ShuffleBlockId(0, 0, 0)], [buf], [None])
+
+            # wait for the ack to PARK (recv thread) without draining it
+            deadline = timelib.monotonic() + 10
+            conns = list(t._conns.values())
+            assert conns
+            while timelib.monotonic() < deadline and not any(c.inbox for c in conns):
+                timelib.sleep(0.005)
+            assert any(c.inbox for c in conns), "ack never parked"
+
+            t._evict(0)  # connection gone from the cache, frame still parked
+
+            deadline = timelib.monotonic() + 10
+            while not req.completed() and timelib.monotonic() < deadline:
+                t.progress()
+            res = req.wait(1)
+            assert res.status.name == "SUCCESS", str(res.error)
+            assert buf.host_view()[: buf.size].tobytes() == payload
+            # zombie retired once nothing references it
+            for _ in range(10):
+                t.progress()
+            assert not t._zombies
+        finally:
+            t.close()
+            srv.close()
