@@ -159,16 +159,29 @@ class SpmdShuffleExecutor:
         ax = self.conf.mesh_axis_name
         send_rows, lane = int(rounds[0][0].shape[0]), int(rounds[0][0].shape[1])
 
-        key = (send_rows, lane)
+        key = (send_rows, lane, self.conf.num_slices)
         fn = self._exchange_fns.get(key)
         if fn is None:
-            fn = build_exchange(
-                self.mesh,
-                ExchangeSpec(
-                    num_executors=n, send_rows=send_rows, recv_rows=send_rows,
-                    lane=lane, axis_name=ax,
-                ),
+            spec = ExchangeSpec(
+                num_executors=n, send_rows=send_rows, recv_rows=send_rows,
+                lane=lane, axis_name=ax,
             )
+            if self.conf.num_slices > 1:
+                # multi-slice multi-host: the two-phase ICI+DCN route over the
+                # same global devices, slice-major (ops/hierarchy.py)
+                from sparkucx_tpu.ops.hierarchy import (
+                    build_hierarchical_exchange,
+                    make_hierarchical_mesh,
+                )
+
+                hmesh = make_hierarchical_mesh(
+                    self.conf.num_slices,
+                    n // self.conf.num_slices,
+                    devices=list(self.mesh.devices.reshape(-1)),
+                )
+                fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
+            else:
+                fn = build_exchange(self.mesh, spec)
             self._exchange_fns[key] = fn
 
         data_sharding = NamedSharding(self.mesh, P(ax, None))

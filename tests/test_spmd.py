@@ -29,7 +29,7 @@ CHILD = textwrap.dedent(
     from sparkucx_tpu.transport.spmd import SpmdShuffleExecutor
 
     pid = int(sys.argv[1]); coord = sys.argv[2]; driver_host, driver_port = sys.argv[3].split(":")
-    conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20)
+    conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20, num_slices=int(os.environ.get("TEST_NUM_SLICES", "1")))
     ex = SpmdShuffleExecutor(conf, coordinator_address=coord, num_processes=2, process_id=pid)
     assert ex.num_executors == 2, ex.num_executors
     addr = ex.init()
@@ -87,6 +87,36 @@ def test_two_process_spmd_exchange():
     coord = f"127.0.0.1:{_free_port()}"
     driver_addr = f"{driver.address[0]}:{driver.address[1]}"
     env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    script = CHILD.format(root=ROOT)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(pid), coord, driver_addr],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=ROOT, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"child {pid} failed:\n{out[-3000:]}"
+            assert f"CHILD_PASS pid={pid}" in out, out[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        driver.close()
+
+
+def test_two_process_spmd_exchange_two_slices():
+    """Multi-host AND multi-slice: each process is one slice of one chip; the
+    superstep routes through the two-phase hierarchy over jax.distributed."""
+    from sparkucx_tpu.parallel.bootstrap import DriverEndpoint
+
+    driver = DriverEndpoint()
+    coord = f"127.0.0.1:{_free_port()}"
+    driver_addr = f"{driver.address[0]}:{driver.address[1]}"
+    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["TEST_NUM_SLICES"] = "2"
     script = CHILD.format(root=ROOT)
     procs = [
         subprocess.Popen(
