@@ -1,0 +1,119 @@
+"""TPC-DS-style query pipelines (BASELINE.md: "TPC-DS SF=100 full suite") —
+the star-schema plan shapes at mini scale through the device operators, like
+tests/test_tpch.py does for TPC-H.  TPC-DS plans are dimension⋈fact joins
+feeding grouped aggregation; q3 and q42 are the canonical two-stage shapes."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.ops.exchange import make_mesh
+from sparkucx_tpu.ops.relational import (
+    AggregateSpec,
+    JoinSpec,
+    build_grouped_aggregate,
+    build_hash_join,
+    run_grouped_aggregate,
+)
+
+N = 8
+CAP = 128
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N)
+
+
+def _pad_table(keys, values, cap_per_shard):
+    width = values.shape[1]
+    k = np.zeros(N * cap_per_shard, np.uint32)
+    v = np.zeros((N * cap_per_shard, width), np.int32)
+    nvalid = np.zeros(N, np.int32)
+    for i, (ki, vi) in enumerate(zip(keys, values)):
+        j = i % N
+        assert nvalid[j] < cap_per_shard
+        k[j * cap_per_shard + nvalid[j]] = ki
+        v[j * cap_per_shard + nvalid[j]] = vi
+        nvalid[j] += 1
+    return k, v, nvalid
+
+
+def _shard(mesh, k, v, n):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return (
+        jax.device_put(k, NamedSharding(mesh, P("ex"))),
+        jax.device_put(v, NamedSharding(mesh, P("ex", None))),
+        jax.device_put(n, NamedSharding(mesh, P("ex"))),
+    )
+
+
+def test_q3_brand_revenue_by_year(mesh, rng):
+    """q3 shape: date_dim (filtered to one month) ⋈ store_sales on date key,
+    then GROUP BY brand with SUM(price) — dimension-filter join into agg."""
+    n_dates, n_sales, n_brands = 60, 400, 12
+    # build side: the dates surviving the moy=11 filter, value = year index
+    nov_dates = np.sort(rng.choice(n_dates, size=n_dates // 3, replace=False)).astype(np.uint32)
+    date_vals = (nov_dates % 3).astype(np.int32)[:, None]  # 3 "years"
+    # probe side: sales keyed by sold_date, value = (brand, price)
+    s_date = rng.integers(0, n_dates, size=n_sales).astype(np.uint32)
+    s_brand = rng.integers(0, n_brands, size=n_sales).astype(np.int32)
+    s_price = rng.integers(1, 500, size=n_sales).astype(np.int32)
+
+    jspec = JoinSpec(
+        num_executors=N,
+        build_capacity=CAP, build_recv_capacity=2 * CAP, build_width=1,
+        probe_capacity=CAP, probe_recv_capacity=2 * CAP, probe_width=2,
+        out_capacity=2 * CAP,
+    )
+    jfn = build_hash_join(mesh, jspec)
+    bk, bv, bn = _pad_table(nov_dates, date_vals, CAP)
+    pk, pv, pn = _pad_table(s_date, np.stack([s_brand, s_price], axis=1), CAP)
+    ok, ob, op, cnt, rt = jfn(*_shard(mesh, bk, bv, bn), *_shard(mesh, pk, pv, pn))
+
+    okh = np.asarray(ok).reshape(N, -1)
+    obh = np.asarray(ob).reshape(N, okh.shape[1], -1)
+    oph = np.asarray(op).reshape(N, okh.shape[1], -1)
+    cnth = np.asarray(cnt)
+    assert np.all(cnth <= 2 * CAP)
+    joined_brand = np.concatenate([oph[j, : cnth[j], 0] for j in range(N)])
+    joined_price = np.concatenate([oph[j, : cnth[j], 1] for j in range(N)])
+    joined_year = np.concatenate([obh[j, : cnth[j], 0] for j in range(N)])
+
+    # stage 2: GROUP BY (year, brand) — composite key in one uint32
+    gkeys = (joined_year.astype(np.uint32) << 8) | joined_brand.astype(np.uint32)
+    spec = AggregateSpec(
+        num_executors=N, capacity=2 * CAP, recv_capacity=4 * CAP, aggs=("sum",)
+    )
+    out_k, out_v, out_c = run_grouped_aggregate(
+        make_mesh(N), spec, gkeys, joined_price[:, None].astype(np.int32)
+    )
+
+    # oracle
+    in_nov = np.isin(s_date, nov_dates)
+    year_of = {int(d): int(y) for d, y in zip(nov_dates, date_vals[:, 0])}
+    expect = {}
+    for d, b, p in zip(s_date[in_nov], s_brand[in_nov], s_price[in_nov]):
+        key = (year_of[int(d)] << 8) | int(b)
+        expect[key] = expect.get(key, 0) + int(p)
+    got = {int(k): int(v[0]) for k, v in zip(out_k, out_v)}
+    assert got == expect
+
+
+def test_q42_category_sum_pure_agg(mesh, rng):
+    """q42 degenerates to the grouped-aggregation shape after the dimension
+    filter: SUM(price) by category over pre-joined rows — run at a size that
+    forces real multi-shard hash routing."""
+    rows, cats = 2000, 25
+    keys = rng.integers(0, cats, size=rows).astype(np.uint32)
+    price = rng.integers(1, 300, size=rows).astype(np.int32)
+    spec = AggregateSpec(
+        num_executors=N, capacity=512, recv_capacity=1024, aggs=("sum",)
+    )
+    out_k, out_v, out_c = run_grouped_aggregate(mesh, spec, keys, price[:, None])
+    for i, k in enumerate(out_k):
+        m = keys == k
+        assert out_v[i, 0] == price[m].sum()
+        assert out_c[i] == m.sum()
+    assert set(out_k.tolist()) == set(np.unique(keys).tolist())
