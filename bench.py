@@ -19,15 +19,28 @@ Baseline measured in the same run: the same byte volume served over a localhost 
 socket into preallocated buffers (the stock Spark Netty-shuffle transport
 analogue).  ``vs_baseline`` = tpu_gbps / tcp_gbps.
 
+Sub-metrics (same JSON line): ``gather_gbps`` — the device-side ragged block
+gather (ops/pallas_kernels.py), ``sort_mrows_s`` — the device-resident TeraSort
+step (ops/sort.py).
+
 A small end-to-end shuffle (stage -> commit -> exchange -> fetch vs oracle) runs
 untimed first as an integrity gate.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness contract (the round-1 bench gate died with no output, BENCH_r01.json
+rc=1/parsed=null): this script ALWAYS prints exactly one JSON line.  The TCP
+baseline needs no TPU and runs first; the chip is probed in a bounded subprocess
+(a dead tunnel makes in-process ``jax.devices()`` hang forever); a watchdog
+force-emits whatever has been measured if the deadline passes.  When the chip is
+unreachable the line carries ``"value": null``, ``"tpu": null`` (explicit
+no-measurement marker) and an ``"error"`` field.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
 import os
 import socket
+import subprocess
 import sys
 import threading
 import time
@@ -45,6 +58,75 @@ FILL = float(os.environ.get("BENCH_FILL", "0.9"))
 CHAIN = int(os.environ.get("BENCH_CHAIN", "64"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 TCP_BYTES = int(os.environ.get("BENCH_TCP_BYTES", str(256 << 20)))
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "30"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
+DEADLINE = float(os.environ.get("BENCH_DEADLINE", "720"))
+SKIP_SUBMETRICS = os.environ.get("BENCH_SKIP_SUBMETRICS", "") == "1"
+
+RESULT = {
+    "metric": "shuffle_superstep_throughput",
+    "value": None,
+    "unit": "GB/s",
+    "vs_baseline": None,
+}
+_EMITTED = threading.Lock()
+_emitted = False
+
+
+def emit_once() -> None:
+    """Print the single JSON result line exactly once (main path or watchdog)."""
+    global _emitted
+    with _EMITTED:
+        if _emitted:
+            return
+        _emitted = True
+    sys.stdout.flush()
+    print(json.dumps(RESULT), flush=True)
+
+
+def _watchdog() -> None:
+    time.sleep(DEADLINE)
+    RESULT.setdefault("error", f"deadline {DEADLINE}s exceeded; partial results emitted")
+    emit_once()
+    os._exit(0)
+
+
+def probe_tpu() -> tuple:
+    """Bounded out-of-process backend probe.
+
+    A dead chip tunnel makes ``jax.devices()`` block forever inside
+    ``make_c_api_client`` (no Python-level timeout can interrupt it), so the
+    first backend touch happens in a killable subprocess.  Returns
+    ``(platform, error)`` — platform is None on failure.
+    """
+    # honor JAX_PLATFORMS even when a site hook pinned jax_platforms (the same
+    # override parallel/mesh.apply_platform_env handles in-process)
+    code = (
+        "import os, jax\n"
+        "w = os.environ.get('JAX_PLATFORMS')\n"
+        "if w: jax.config.update('jax_platforms', w)\n"
+        "d = jax.devices(); print(d[0].platform, len(d))\n"
+    )
+    last = "unknown"
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                platform = r.stdout.strip().split()[0]
+                return platform, None
+            last = (r.stderr or "").strip().splitlines()[-1:] or ["nonzero exit"]
+            last = last[0][:300]
+        except subprocess.TimeoutExpired:
+            last = f"backend init timed out after {PROBE_TIMEOUT}s (tunnel down?)"
+        if attempt + 1 < PROBE_ATTEMPTS:
+            time.sleep(3 * (attempt + 1))
+    return None, last
 
 
 def tcp_shuffle_read_gbps(total_bytes: int, chunk: int = 1 << 20) -> float:
@@ -159,27 +241,66 @@ def device_superstep_gbps(send_rows: int) -> float:
 
 
 def main():
-    integrity_gate()
-    tcp = tcp_shuffle_read_gbps(TCP_BYTES)
-    tpu = None
-    for i, send_rows in enumerate(SEND_ROWS_CANDIDATES):
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    # 1. TCP baseline — needs no TPU, always recorded.
+    try:
+        tcp = tcp_shuffle_read_gbps(TCP_BYTES)
+        RESULT["tcp_gbps"] = round(tcp, 3)
+    except Exception as e:
+        tcp = None
+        RESULT["tcp_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # 2. Bounded chip probe — never touch the backend in-process before this.
+    platform, probe_err = probe_tpu()
+    if platform is None:
+        RESULT["tpu"] = None
+        RESULT["error"] = f"backend unreachable: {probe_err}"
+        emit_once()
+        return
+    RESULT["platform"] = platform
+
+    # 3. Measured path; any failure still emits what we have.
+    try:
+        from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+        apply_platform_env()
+        integrity_gate()
+        RESULT["integrity"] = "pass"
+        tpu = None
+        for i, send_rows in enumerate(SEND_ROWS_CANDIDATES):
+            try:
+                tpu = device_superstep_gbps(send_rows)
+                RESULT["send_rows"] = send_rows
+                break
+            except Exception as e:
+                if i + 1 == len(SEND_ROWS_CANDIDATES):
+                    raise
+                print(
+                    f"# {send_rows} rows failed ({type(e).__name__}); retrying smaller",
+                    file=sys.stderr,
+                )
+        RESULT["value"] = round(tpu, 3)
+        if tcp:
+            RESULT["vs_baseline"] = round(tpu / tcp, 3)
+    except Exception as e:
+        RESULT["error"] = f"{type(e).__name__}: {e}"[:300]
+
+    if not SKIP_SUBMETRICS and RESULT["value"] is not None:
+        from sparkucx_tpu.perf.benchmark import measure_gather, measure_sort
+
         try:
-            tpu = device_superstep_gbps(send_rows)
-            break
+            RESULT["gather_gbps"] = round(
+                measure_gather(64, 1 << 20, REPEATS, outstanding=8), 3
+            )
         except Exception as e:
-            if i + 1 == len(SEND_ROWS_CANDIDATES):
-                raise
-            print(f"# {send_rows} rows failed ({type(e).__name__}); retrying smaller", file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": "shuffle_superstep_throughput",
-                "value": round(tpu, 3),
-                "unit": "GB/s",
-                "vs_baseline": round(tpu / tcp, 3),
-            }
-        )
-    )
+            RESULT["gather_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            RESULT["sort_mrows_s"] = round(measure_sort(1, 1 << 21, REPEATS), 3)
+        except Exception as e:
+            RESULT["sort_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    emit_once()
 
 
 if __name__ == "__main__":

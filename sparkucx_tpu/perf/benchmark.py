@@ -198,7 +198,18 @@ def run_superstep(args) -> None:
         )
 
 
-def run_gather(args) -> None:
+def measure_gather(
+    num_blocks: int,
+    block_bytes: int,
+    iterations: int,
+    outstanding: int,
+    impl: str | None = None,
+    report=None,
+) -> float:
+    """Measurement core of the ``gather`` mode — device-side ragged block gather
+    (the reply-packing hot path, UcxWorkerWrapper.scala:397-448 analogue).
+    Returns best GB/s across iterations; ``report(it, seconds, bytes, impl)`` is
+    called per iteration when given.  Shared by the CLI and bench.py."""
     from sparkucx_tpu.parallel.mesh import apply_platform_env
 
     apply_platform_env()
@@ -206,10 +217,9 @@ def run_gather(args) -> None:
 
     from sparkucx_tpu.ops.pallas_kernels import build_block_gather, pack_plan
 
-    size = parse_size(args.block_size)
     row = 512
-    rows_each = max(1, size // row)
-    b = args.num_blocks
+    rows_each = max(1, block_bytes // row)
+    b = num_blocks
     # blocks scattered at 2x stride through the source (every other slot used)
     src_rows = 2 * b * rows_each
     rng = np.random.default_rng(0)
@@ -218,29 +228,56 @@ def run_gather(args) -> None:
     )
     plan = [(2 * i * rows_each * row, rows_each * row) for i in range(b)]
     starts, counts, outs, total = pack_plan(plan, row)
-    impl = None if args.impl == "auto" else args.impl
     fn = build_block_gather(b, total, impl=impl)
     dev = src.device
     sargs = tuple(jax.device_put(a, dev) for a in (starts, counts, outs))
     out = jax.block_until_ready(fn(*sargs, src))  # compile
     assert np.array_equal(np.asarray(out[:rows_each]), np.asarray(src[:rows_each]))
     moved = total * row
-    for it in range(args.iterations):
+    best = 0.0
+    for it in range(iterations):
         t0 = time.perf_counter()
-        for _ in range(args.outstanding):
+        for _ in range(outstanding):
             out = fn(*sargs, src)
         jax.block_until_ready(out)
         np.asarray(out[0, :4])  # force completion through async tunnels
         dt = time.perf_counter() - t0
-        tot = moved * args.outstanding
+        tot = moved * outstanding
+        best = max(best, tot / dt / 1e9)
+        if report is not None:
+            report(it, dt, tot, fn.impl)
+    return best
+
+
+def run_gather(args) -> None:
+    size = parse_size(args.block_size)
+    rows_each = max(1, size // 512)
+
+    def report(it, dt, tot, impl):
         print(
-            f"iter {it}: {b} blocks x {rows_each * row} B packed {args.outstanding}x: "
-            f"{tot} bytes in {dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s [impl={fn.impl}]",
+            f"iter {it}: {args.num_blocks} blocks x {rows_each * 512} B packed "
+            f"{args.outstanding}x: {tot} bytes in {dt*1e3:.1f} ms = "
+            f"{tot / dt / 1e9:.2f} GB/s [impl={impl}]",
             flush=True,
         )
 
+    measure_gather(
+        args.num_blocks,
+        size,
+        args.iterations,
+        args.outstanding,
+        impl=None if args.impl == "auto" else args.impl,
+        report=report,
+    )
 
-def run_sort(args) -> None:
+
+def measure_sort(
+    executors: int, total_rows: int, iterations: int, report=None
+) -> float:
+    """Measurement core of the ``sort`` mode — device-resident TeraSort step
+    (100 B rows: uint32 key + 24 int32 lanes; BASELINE.json configs[1]).
+    Returns best M rows/s; ``report(it, seconds, rows, impl)`` per iteration.
+    Shared by the CLI and bench.py."""
     from sparkucx_tpu.parallel.mesh import apply_platform_env
 
     apply_platform_env()
@@ -250,8 +287,7 @@ def run_sort(args) -> None:
     from sparkucx_tpu.ops.exchange import make_mesh
     from sparkucx_tpu.ops.sort import SortSpec, build_distributed_sort
 
-    n = args.executors
-    total_rows = args.num_blocks  # -n = total rows here
+    n = executors
     cap = -(-total_rows // n)
     spec = SortSpec(
         num_executors=n, capacity=cap, recv_capacity=2 * cap, width=24
@@ -271,18 +307,29 @@ def run_sort(args) -> None:
     )
     out = jax.block_until_ready(fn(keys, payload, nv))  # compile
     assert int(np.asarray(out[2]).sum()) == n * cap, "sort dropped rows"
-    for it in range(args.iterations):
+    best = 0.0
+    for it in range(iterations):
         t0 = time.perf_counter()
         out = fn(keys, payload, nv)
         jax.block_until_ready(out)
         np.asarray(out[0][:4])  # force completion through async tunnels
         dt = time.perf_counter() - t0
+        best = max(best, n * cap / dt / 1e6)
+        if report is not None:
+            report(it, dt, n * cap, fn.spec.impl)
+    return best
+
+
+def run_sort(args) -> None:
+    def report(it, dt, rows, impl):
         print(
-            f"iter {it}: sorted {n * cap} x 100 B rows in {dt*1e3:.1f} ms = "
-            f"{n * cap / dt / 1e6:.2f} M rows/s ({n * cap * 100 / dt / 1e9:.2f} GB/s) "
-            f"[impl={fn.spec.impl}]",
+            f"iter {it}: sorted {rows} x 100 B rows in {dt*1e3:.1f} ms = "
+            f"{rows / dt / 1e6:.2f} M rows/s ({rows * 100 / dt / 1e9:.2f} GB/s) "
+            f"[impl={impl}]",
             flush=True,
         )
+
+    measure_sort(args.executors, args.num_blocks, args.iterations, report=report)
 
 
 def main(argv=None) -> None:
