@@ -108,8 +108,10 @@ class TpuShuffleConf:
     #: superstep, enabling device-side block fetch (ops/pallas_kernels.py) —
     #: the serving analogue of the reference's registered bounce buffers that
     #: never leave the NIC-visible pool (MemoryPool.scala).  Costs one extra
-    #: device-resident copy of the received bytes per round.
-    keep_device_recv: bool = True
+    #: device-resident copy of the received bytes per round, doubling the HBM
+    #: envelope of received bytes — opt-in (default off) so large multi-round
+    #: shuffles keep the donation that halves peak HBM.
+    keep_device_recv: bool = False
     #: Ragged block-gather lowering: 'auto' (pipelined DMA kernel on TPU, XLA
     #: gather elsewhere) | 'dma' | 'tiled' | 'xla'.
     gather_impl: str = "auto"

@@ -68,7 +68,9 @@ def _gather_dma_kernel(starts_ref, counts_ref, outs_ref, src_ref, out_ref, sems)
         )
 
     def body(i, _):
-        @pl.when(jnp.logical_and(i >= k, counts_ref[i - k] > 0))
+        # clamp so the traced SMEM read stays in bounds even when i < k (the
+        # i >= k predicate discards the value but not the read itself)
+        @pl.when(jnp.logical_and(i >= k, counts_ref[jnp.maximum(i - k, 0)] > 0))
         def _wait_prev():
             get_dma(i - k).wait()
 

@@ -188,8 +188,14 @@ class TpuShuffleReader:
                 self.sender_of(bid.map_id), bid.shuffle_id, bid.map_id, bid.reduce_id, buf
             )
             t0 = time.monotonic_ns()
+            # same wakeup park as the batch window loop above — the retry path
+            # exists exactly for slow/straggling peers, where busy-spinning
+            # progress() would burn the GIL against the recv thread
+            park = getattr(self.transport, "wait_for_activity", None)
             while not req.completed():
                 self.transport.progress()
+                if park is not None and not req.completed():
+                    park(0.002)
             self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
             result = req.wait(0)
             if result.status == OperationStatus.SUCCESS:

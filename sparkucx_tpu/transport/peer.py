@@ -747,6 +747,8 @@ class PeerTransport(ShuffleTransport):
         am_id, header, body, scattered = frame
         if am_id != AmId.FETCH_BLOCK_REQ_ACK:
             return
+        if len(header) < _TAG.size + _COUNT.size:
+            return  # not even a tag to resolve; the recv loop killed the conn
         (tag,) = _TAG.unpack_from(header, 0)
         (count,) = _COUNT.unpack_from(header, _TAG.size)
         with self._tag_lock:
@@ -754,13 +756,44 @@ class PeerTransport(ShuffleTransport):
         if entry is None:
             return
         reqs, bufs, cbs, _conn = entry
-        sizes = [
-            _SIZE.unpack_from(header, _TAG.size + _COUNT.size + i * _SIZE.size)[0]
-            for i in range(count)
-        ]
+        # validate BEFORE unpacking: a truncated header must fail the batch,
+        # not raise struct.error out of progress() with the entry already popped
+        truncated = len(header) < _TAG.size + _COUNT.size + count * _SIZE.size
+        sizes = (
+            []
+            if truncated
+            else [
+                _SIZE.unpack_from(header, _TAG.size + _COUNT.size + i * _SIZE.size)[0]
+                for i in range(count)
+            ]
+        )
         # Scattered acks (explicit flag from the recv thread): the payload
         # already sits in the result buffers; only completion remains here.
         pre_filled = scattered
+        # A peer whose size list disagrees with the frame body (or with the
+        # batch size) produced an ack we cannot slice safely: fail the whole
+        # batch with FAILURE results instead of raising mid-loop out of
+        # progress() and leaving the rest of the batch incomplete.
+        malformed = (
+            truncated
+            or count != len(reqs)
+            or (not pre_filled and sum(s for s in sizes if s > 0) != len(body))
+        )
+        if malformed:
+            err = TransportError(
+                f"malformed fetch ack: {count} sizes summing to "
+                f"{sum(s for s in sizes if s > 0)} B for a {len(reqs)}-request "
+                f"batch with a {len(body)} B body"
+            )
+            for req, cb in zip(reqs, cbs):
+                if req.completed():
+                    continue
+                req.stats.mark_done()
+                result = OperationResult(OperationStatus.FAILURE, error=err, stats=req.stats)
+                req.complete(result)
+                if cb is not None:
+                    cb(result)
+            return
         pos = 0
         for i, (req, buf, cb) in enumerate(zip(reqs, bufs, cbs)):
             size = sizes[i]

@@ -94,6 +94,7 @@ def exchanged_cluster():
         block_alignment=128,
         num_executors=N_EXEC,
         gather_impl="xla",  # CPU mesh: the portable lowering
+        keep_device_recv=True,  # device-side fetch is the subject under test
     )
     cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
     rng = np.random.default_rng(11)
@@ -193,6 +194,7 @@ class TestDeviceFetch:
             block_alignment=128,
             num_executors=2,
             gather_impl="xla",
+            keep_device_recv=True,
         )
         cluster = TpuShuffleCluster(conf, num_executors=2)
         meta = cluster.create_shuffle(0, 2, 2)

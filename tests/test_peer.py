@@ -334,6 +334,78 @@ class TestMalformedFrames:
             srv.close()
 
 
+class TestMalformedAck:
+    """A fetch-ack whose size list disagrees with the frame body (skewed or
+    buggy peer) must fail the whole batch with FAILURE results — not raise a
+    slicing error out of progress() and leave the batch incomplete."""
+
+    def _inject(self, a, header, body):
+        from sparkucx_tpu.core.definitions import AmId
+        from sparkucx_tpu.core.operation import OperationStats, Request
+
+        reqs = [Request(OperationStats()) for _ in range(2)]
+        bufs = [_buf(64), _buf(64)]
+        a._inflight[7] = (reqs, bufs, [None, None], None)
+        a._handle_frame((AmId.FETCH_BLOCK_REQ_ACK, header, body, False))
+        return reqs
+
+    def test_sizes_disagree_with_body(self):
+        from sparkucx_tpu.transport import peer as peer_mod
+
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20)
+        a = PeerTransport(conf, executor_id=1)
+        try:
+            # sizes claim 10+10 bytes but the body carries only 5
+            header = (
+                peer_mod._TAG.pack(7)
+                + peer_mod._COUNT.pack(2)
+                + peer_mod._SIZE.pack(10)
+                + peer_mod._SIZE.pack(10)
+            )
+            reqs = self._inject(a, header, b"12345")
+            for r in reqs:
+                res = r.wait(1)
+                assert res.status == OperationStatus.FAILURE
+                assert "malformed" in str(res.error)
+            assert 7 not in a._inflight  # batch retired, nothing leaks
+        finally:
+            a.close()
+
+    def test_truncated_size_list(self):
+        from sparkucx_tpu.transport import peer as peer_mod
+
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20)
+        a = PeerTransport(conf, executor_id=1)
+        try:
+            # count says 2 but the header carries no size entries at all —
+            # must fail the batch, not raise struct.error out of progress()
+            header = peer_mod._TAG.pack(7) + peer_mod._COUNT.pack(2)
+            reqs = self._inject(a, header, b"")
+            for r in reqs:
+                res = r.wait(1)
+                assert res.status == OperationStatus.FAILURE
+                assert "malformed" in str(res.error)
+        finally:
+            a.close()
+
+    def test_count_disagrees_with_batch(self):
+        from sparkucx_tpu.transport import peer as peer_mod
+
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20)
+        a = PeerTransport(conf, executor_id=1)
+        try:
+            # one size entry for a two-request batch: zip would silently leave
+            # the second request incomplete
+            header = peer_mod._TAG.pack(7) + peer_mod._COUNT.pack(1) + peer_mod._SIZE.pack(3)
+            reqs = self._inject(a, header, b"abc")
+            for r in reqs:
+                res = r.wait(1)
+                assert res.status == OperationStatus.FAILURE
+                assert "malformed" in str(res.error)
+        finally:
+            a.close()
+
+
 class TestEvictedConnectionDrain:
     """An ack that parked before its connection was evicted must still
     complete under progress() (the zombie-drain path) — before, eviction
