@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import re
 from dataclasses import dataclass, field
-from typing import Dict, Mapping, Tuple
+from typing import Dict, Mapping, Optional, Tuple
 
 _SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kmgt]?i?b?)\s*$", re.IGNORECASE)
 _UNITS = {
@@ -94,6 +94,18 @@ class TpuShuffleConf:
     #: processes serve blocks zero-copy (single-host NVKV-store analogue).
     use_shm_staging: bool = False
     shm_namespace: str = "sparkucx_tpu"
+    #: Disk round tier — the capacity-beyond-RAM role of the reference's
+    #: DPU-attached NVMe (NvkvHandler.scala:160-242).  When a staging round
+    #: rolls over, the completed round is written to an ``np.memmap`` file and
+    #: its RAM is released, so a shuffle larger than host memory streams
+    #: through bounded staging.  ``spill_dir=None`` -> a per-store temp dir.
+    spill_to_disk: bool = True
+    spill_dir: Optional[str] = None
+    #: Total on-disk spill budget per store; 0 = unbounded.  Counts staged
+    #: (padded) bytes — spill files are sparse, holes cost nothing.  Exceeding
+    #: it is a TransportError at rollover (like region overflow), not silent
+    #: data loss.
+    spill_disk_cap_bytes: int = 0
 
     # TPU mesh (L2)
     mesh_axis_name: str = "ex"
@@ -173,6 +185,9 @@ class TpuShuffleConf:
             ("meshAxisName", "mesh_axis_name", str),
             ("keepDeviceRecv", "keep_device_recv", lambda v: str(v).lower() == "true"),
             ("gatherImpl", "gather_impl", str),
+            ("spillToDisk", "spill_to_disk", lambda v: str(v).lower() == "true"),
+            ("spillDir", "spill_dir", str),
+            ("spillDiskCap", "spill_disk_cap_bytes", parse_size),
         ]:
             v = get(name)
             if v is not None:

@@ -104,6 +104,8 @@ class _ShuffleState:
         #: (SURVEY.md section 5.7) applied to the bulk-synchronous plane.
         self.round = 0
         self.prev_rounds: List[Tuple[np.ndarray, np.ndarray]] = []  # (staging, region_used)
+        #: (path, nbytes) of rounds spilled to the disk tier (conf.spill_to_disk)
+        self.spill_files: List[Tuple[str, int]] = []
         self.region_used = np.zeros(n, dtype=np.int64)
         self.blocks: Dict[Tuple[int, int], _BlockEntry] = {}  # (map, reduce) -> entry
         self.committed_maps: set = set()
@@ -255,6 +257,9 @@ class HbmBlockStore:
         # arrive before this process registers the shuffle); applied at creation.
         self._pending_infos: Dict[int, List[MapperInfo]] = {}
         self._lock = threading.RLock()
+        # disk round tier accounting (conf.spill_to_disk)
+        self._spill_dir: Optional[str] = None
+        self._spill_bytes = 0
 
     def _shm_staging(self, shuffle_id: int, nbytes: int):
         """Shared-memory staging for single-host zero-copy serving
@@ -313,6 +318,8 @@ class HbmBlockStore:
             if st is not None and st.staging_closer is not None:
                 st.staging = None
                 st.staging_closer()
+            if st is not None:
+                self._release_spill(st)
 
     def close(self) -> None:
         with self._lock:
@@ -321,6 +328,7 @@ class HbmBlockStore:
                 if st.staging_closer is not None:
                     st.staging = None
                     st.staging_closer()
+                self._release_spill(st)
 
     def _state(self, shuffle_id: int) -> _ShuffleState:
         with self._lock:
@@ -331,11 +339,82 @@ class HbmBlockStore:
 
     def _rollover(self, st: _ShuffleState) -> None:
         """Snapshot the current staging epoch and start a fresh round (caller
-        holds self._lock)."""
-        st.prev_rounds.append((st.staging, st.region_used))
+        holds self._lock).
+
+        With ``conf.spill_to_disk`` (default) the completed round moves to an
+        ``np.memmap`` file and its RAM is released — the capacity-beyond-memory
+        tier the reference gets from DPU-attached NVMe (NvkvHandler.scala:
+        160-242); ``read_block``/``block_staging_view``/``seal`` serve spilled
+        rounds through the memmap transparently.  With it off, the round stays
+        as a RAM snapshot (bounded by host memory)."""
+        snap = st.staging
+        if self.conf.spill_to_disk:
+            snap = self._spill_round(st)
+        st.prev_rounds.append((snap, st.region_used))
         st.staging = np.zeros_like(st.staging)
         st.region_used = np.zeros_like(st.region_used)
         st.round += 1
+
+    def _spill_round(self, st: _ShuffleState) -> np.ndarray:
+        """Write the current round's staging to the disk tier; returns the
+        memmap that replaces the RAM snapshot (caller holds self._lock).
+
+        The file is logically full-capacity (so block offsets are unchanged)
+        but only each region's used prefix is written — the rest stays a sparse
+        hole, so disk writes and the spillDiskCap budget are proportional to
+        bytes actually staged, not to stagingCapacity."""
+        import os
+        import tempfile
+
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(
+                prefix=f"sparkucx_tpu_spill_e{self.executor_id}_",
+                dir=self.conf.spill_dir,
+            )
+        cap = self.conf.spill_disk_cap_bytes
+        nbytes = int(st.region_used.sum())
+        if cap and self._spill_bytes + nbytes > cap:
+            raise TransportError(
+                f"disk spill cap exceeded: {self._spill_bytes} B spilled + "
+                f"{nbytes} B round > spillDiskCap {cap} B"
+            )
+        path = os.path.join(self._spill_dir, f"s{st.shuffle_id}_r{st.round}.bin")
+        mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=st.staging.shape)
+        for p in range(len(st.peer_ranges)):
+            used = int(st.region_used[p])
+            if used:
+                start = p * st.region_size
+                mm[start : start + used] = st.staging[start : start + used]
+        mm.flush()
+        st.spill_files.append((path, nbytes))
+        self._spill_bytes += nbytes
+        return mm
+
+    def _release_spill(self, st: _ShuffleState) -> None:
+        """Unlink a removed shuffle's spill files (caller holds self._lock).
+
+        The state object is deliberately NOT mutated: a reader that resolved
+        the state before removal keeps serving correct bytes — open memmaps
+        stay readable after unlink (the inode lives until the mapping drops),
+        and GC reclaims everything once in-flight readers finish."""
+        import os
+
+        for path, nbytes in st.spill_files:
+            self._spill_bytes -= nbytes
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        st.spill_files = []
+        if self._spill_dir is not None and not any(
+            s.spill_files for s in self._shuffles.values()
+        ):
+            try:
+                os.rmdir(self._spill_dir)
+            except OSError:
+                pass  # non-empty (foreign files) or already gone
+            else:
+                self._spill_dir = None
 
     # -- write path --------------------------------------------------------
 

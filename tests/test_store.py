@@ -105,6 +105,158 @@ class TestWriteReadback:
         assert store.read_block(4, 0, 0) == b""
 
 
+class TestDiskSpillTier:
+    """Completed staging rounds move to np.memmap files (the capacity-beyond-RAM
+    role of the reference's DPU-attached NVMe, NvkvHandler.scala:160-242), so a
+    shuffle larger than the staging RAM budget streams through bounded memory."""
+
+    def _fill_rounds(self, s, shuffle_id, num_rounds, region):
+        """Write num_rounds full regions for reducer 0 via distinct mappers;
+        returns the oracle {(map_id, 0): payload}."""
+        oracle = {}
+        for m in range(num_rounds):
+            payload = bytes([m + 1]) * region
+            w = s.map_writer(shuffle_id, m)
+            w.write_partition(0, payload)
+            w.commit()
+            oracle[(m, 0)] = payload
+        return oracle
+
+    def test_rounds_spill_to_memmap_and_read_back(self, tmp_path):
+        import os
+
+        s = HbmBlockStore(
+            TpuShuffleConf(
+                staging_capacity_per_executor=4096,
+                block_alignment=ALIGN,
+                spill_dir=str(tmp_path),
+            )
+        )
+        # 8 rounds x 4096 B through a 4096 B RAM budget: 8x larger than staging
+        s.create_shuffle(0, 8, 1)
+        region = s._state(0).region_size
+        oracle = self._fill_rounds(s, 0, 8, region)
+        assert s.num_rounds(0) == 8
+        st = s._state(0)
+        assert len(st.prev_rounds) == 7
+        assert all(isinstance(p, np.memmap) for p, _ in st.prev_rounds)
+        spilled = [f for f in os.listdir(str(tmp_path)) if not f.startswith(".")]
+        assert len(spilled) == 1  # the per-store spill subdir
+        files = os.listdir(tmp_path / spilled[0])
+        assert len(files) == 7
+        for (m, r), expect in oracle.items():
+            assert s.read_block(0, m, r) == expect, f"round {m} corrupted"
+        # zero-copy serving handle works against the memmap too
+        arr, off, ln = s.block_staging_view(0, 0, 0)
+        assert bytes(arr[off : off + ln]) == oracle[(0, 0)]
+        s.remove_shuffle(0)
+        assert os.listdir(str(tmp_path)) == []  # files AND subdir reclaimed
+        s.close()
+
+    def test_seal_serves_spilled_rounds(self, tmp_path):
+        s = HbmBlockStore(
+            TpuShuffleConf(
+                staging_capacity_per_executor=4096,
+                block_alignment=ALIGN,
+                spill_dir=str(tmp_path),
+            )
+        )
+        s.create_shuffle(0, 3, 1)
+        region = s._state(0).region_size
+        oracle = self._fill_rounds(s, 0, 3, region)
+        rounds = s.seal(0)
+        assert len(rounds) == 3
+        for m, (payload, sizes) in enumerate(rounds):
+            flat = np.asarray(payload).reshape(-1).view(np.uint8)
+            assert flat[:region].tobytes() == oracle[(m, 0)]
+            assert int(sizes[0]) == region // ALIGN
+        s.close()
+
+    def test_spill_disabled_keeps_ram_snapshots(self, tmp_path):
+        s = HbmBlockStore(
+            TpuShuffleConf(
+                staging_capacity_per_executor=4096,
+                block_alignment=ALIGN,
+                spill_to_disk=False,
+                spill_dir=str(tmp_path),
+            )
+        )
+        s.create_shuffle(0, 2, 1)
+        region = s._state(0).region_size
+        oracle = self._fill_rounds(s, 0, 2, region)
+        st = s._state(0)
+        assert len(st.prev_rounds) == 1
+        assert not isinstance(st.prev_rounds[0][0], np.memmap)
+        import os
+
+        assert os.listdir(str(tmp_path)) == []
+        assert s.read_block(0, 0, 0) == oracle[(0, 0)]
+        s.close()
+
+    def test_spill_cap_enforced(self, tmp_path):
+        s = HbmBlockStore(
+            TpuShuffleConf(
+                staging_capacity_per_executor=4096,
+                block_alignment=ALIGN,
+                spill_dir=str(tmp_path),
+                spill_disk_cap_bytes=2 * 4096,
+            )
+        )
+        s.create_shuffle(0, 4, 1)
+        region = s._state(0).region_size
+        self._fill_rounds(s, 0, 3, region)  # two rounds spilled = cap
+        with pytest.raises(TransportError, match="spill cap"):
+            w = s.map_writer(0, 3)
+            w.write_partition(0, b"x" * region)
+        s.close()
+
+    def test_shuffle_beyond_ram_budget_end_to_end(self, tmp_path):
+        """BASELINE-shaped gate: exchange a shuffle ~10x the configured staging
+        RAM budget through multi-round collectives and verify every block
+        against the oracle (VERDICT round-1 item 4's done criterion,
+        scaled down via the small capacity)."""
+        from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+        n, M, R = 2, 6, 4
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=8192,
+            block_alignment=ALIGN,
+            num_executors=n,
+            spill_dir=str(tmp_path),
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=n)
+        meta = cluster.create_shuffle(0, M, R)
+        rng = np.random.default_rng(42)
+        region = cluster.transport(0).store._state(0).region_size
+        oracle = {}
+        for m in range(M):
+            t = cluster.transport(meta.map_owner[m])
+            w = t.store.map_writer(0, m)
+            for r in range(R):
+                # ~0.9 region per block forces a rollover nearly every write
+                payload = rng.integers(
+                    0, 256, size=int(region * 0.9), dtype=np.uint8
+                ).tobytes()
+                oracle[(m, r)] = payload
+                w.write_partition(r, payload)
+            t.commit_block(w.commit().pack())
+        total = sum(len(v) for v in oracle.values())
+        assert total > 10 * conf.staging_capacity_per_executor
+        cluster.run_exchange(0)
+        for (m, r), expect in oracle.items():
+            consumer = meta.owner_of_reduce(r)
+            view, ln = cluster.locate_received_block(consumer, 0, m, r)
+            assert ln == len(expect)
+            assert view[:ln].tobytes() == expect, f"mismatch at ({m},{r})"
+        cluster.remove_shuffle(0)
+        import os
+
+        leftovers = [
+            f for d in os.listdir(str(tmp_path)) for f in os.listdir(tmp_path / d)
+        ]
+        assert leftovers == []
+
+
 class TestAlignmentAndLayout:
     def test_blocks_aligned(self, store):
         store.create_shuffle(0, 2, 2, peer_ranges=default_peer_ranges(2, 1))
