@@ -31,7 +31,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkucx_tpu.ops.exchange import exclusive_cumsum
+from sparkucx_tpu.ops.exchange import (
+    compact_input_offsets,
+    exclusive_cumsum,
+    ragged_params,
+)
 
 
 @dataclass(frozen=True)
@@ -69,9 +73,9 @@ def size_matrix_from_owners(axis_name: str, num_executors: int, owners: jnp.ndar
     me = jax.lax.axis_index(axis_name)
     counts = jnp.bincount(owners, length=n + 1)[:n].astype(jnp.int32)  # rows me -> j
     sizes = jax.lax.all_gather(counts[None, :], axis_name, tiled=True)  # (n, n)
-    send_sizes = sizes[me]
-    recv_sizes = sizes[:, me]
-    output_offsets = exclusive_cumsum(sizes, axis=0)[me]
+    # compact-layout ragged params — ONE formula source (exchange.ragged_params)
+    # shared with the exchange and covered by tests/test_ragged_plan.py
+    _, send_sizes, output_offsets, recv_sizes = ragged_params(sizes, me, None)
     return sizes, send_sizes, recv_sizes, output_offsets
 
 
@@ -87,7 +91,7 @@ def _sort_and_sizes(spec: ColumnarSpec, rows: jnp.ndarray, owners: jnp.ndarray):
 
 
 def _columnar_shard_ragged(spec: ColumnarSpec, payload, send_sizes, recv_sizes, output_offsets):
-    input_offsets = exclusive_cumsum(send_sizes)
+    input_offsets = compact_input_offsets(send_sizes)
     out = jnp.zeros((spec.recv_capacity, payload.shape[1]), dtype=payload.dtype)
     out = jax.lax.ragged_all_to_all(
         payload,

@@ -103,17 +103,52 @@ class ExchangeSpec:
             raise ValueError("lane must be positive")
 
 
-def _sizes_and_offsets(spec: ExchangeSpec, size_row: jnp.ndarray):
-    """Phase 1 (shared): gather the size matrix, derive send/recv sizes + offsets."""
+def compact_input_offsets(send_sizes, xp=jnp):
+    """Input offsets of a compact (sorted/packed) payload — chunk j starts
+    right after chunks 0..j-1 (the columnar shuffle / distributed sort input
+    layout, as opposed to the exchange's slot layout)."""
+    return xp.cumsum(send_sizes) - send_sizes
+
+
+def ragged_params(sizes, me, slot_rows: Optional[int], xp=jnp):
+    """The ragged lowering's offset/size formulas, factored for standalone
+    verification (``xp=np`` in tests, ``xp=jnp`` traced inside the collective —
+    the SAME expressions either way, so a formula regression fails the
+    property tests in tests/test_ragged_plan.py even though XLA:CPU cannot
+    execute ragged_all_to_all itself).
+
+    Given the full (n, n) size matrix (``sizes[i, j]`` = rows i sends j), the
+    parameters executor ``me`` passes to ``jax.lax.ragged_all_to_all``:
+
+    * ``input_offsets[j]`` — where j's chunk starts in my send buffer: the
+      slot start ``j * slot_rows`` (exchange staging layout), or the compact
+      exclusive cumsum when ``slot_rows`` is None (columnar/sort layout);
+    * ``send_sizes[j]`` — rows I send j: row ``me`` of the matrix;
+    * ``output_offsets[j]`` — where MY chunk lands inside receiver j's buffer:
+      rows from senders i < me bound for j, i.e. the exclusive cumsum down
+      column j, row ``me``;
+    * ``recv_sizes[i]`` — rows I receive from i: column ``me``.
+
+    This is the layout contract of the reference's reply packing
+    (UcxWorkerWrapper.scala:397-448: [sizes | data...] sender-major).
+    """
+    n = sizes.shape[0]
+    send_sizes = sizes[me]                                      # (n,)
+    recv_sizes = sizes[:, me]                                   # (n,)
+    output_offsets = (xp.cumsum(sizes, axis=0) - sizes)[me]     # (n,)
+    if slot_rows is None:
+        input_offsets = compact_input_offsets(send_sizes, xp)   # (n,)
+    else:
+        input_offsets = xp.arange(n, dtype=xp.int32) * slot_rows
+    return input_offsets, send_sizes, output_offsets, recv_sizes
+
+
+def _gather_sizes(spec: ExchangeSpec, size_row: jnp.ndarray):
+    """Phase 1 (shared): gather the full size matrix device-side."""
     ax = spec.axis_name
     me = jax.lax.axis_index(ax)
     sizes = jax.lax.all_gather(size_row, ax, tiled=True)  # (n, n): sizes[i, j] = i -> j rows
-    send_sizes = sizes[me]                                # (n,)
-    recv_sizes = sizes[:, me]                             # (n,)
-    # Landing offset of MY chunk inside each receiver j's buffer: rows from
-    # senders i < me bound for j — exclusive cumsum down each column, row `me`.
-    output_offsets = exclusive_cumsum(sizes, axis=0)[me]  # (n,)
-    return me, send_sizes, recv_sizes, output_offsets
+    return me, sizes
 
 
 def _exchange_shard_ragged(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.ndarray):
@@ -121,9 +156,10 @@ def _exchange_shard_ragged(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.
 
     Only each region's used prefix crosses the wire — the padding between
     regions stays home, unlike the dense lowering."""
-    n = spec.num_executors
-    _, send_sizes, recv_sizes, output_offsets = _sizes_and_offsets(spec, size_row)
-    input_offsets = jnp.arange(n, dtype=jnp.int32) * spec.slot_rows
+    me, sizes = _gather_sizes(spec, size_row)
+    input_offsets, send_sizes, output_offsets, recv_sizes = ragged_params(
+        sizes, me, spec.slot_rows
+    )
     out = jnp.zeros((spec.recv_rows, spec.lane), dtype=data.dtype)
     out = jax.lax.ragged_all_to_all(
         data,
@@ -146,7 +182,8 @@ def _exchange_shard_dense(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.n
     shapes."""
     n = spec.num_executors
     slot = spec.slot_rows
-    _, _, recv_sizes, _ = _sizes_and_offsets(spec, size_row)
+    me, sizes = _gather_sizes(spec, size_row)
+    recv_sizes = sizes[:, me]
 
     slots = data.reshape(n, slot, spec.lane)
     received = jax.lax.all_to_all(slots, spec.axis_name, split_axis=0, concat_axis=0, tiled=True)

@@ -367,6 +367,8 @@ class HbmBlockStore:
         import tempfile
 
         if self._spill_dir is None:
+            if self.conf.spill_dir is not None:
+                os.makedirs(self.conf.spill_dir, exist_ok=True)
             self._spill_dir = tempfile.mkdtemp(
                 prefix=f"sparkucx_tpu_spill_e{self.executor_id}_",
                 dir=self.conf.spill_dir,
