@@ -106,6 +106,10 @@ class TpuShuffleConf:
     #: it is a TransportError at rollover (like region overflow), not silent
     #: data loss.
     spill_disk_cap_bytes: int = 0
+    #: Reduce-side combine/sort memory budget (the ExternalSorter role,
+    #: UcxShuffleReader.scala:137-199): crossing it spills sorted runs to
+    #: ``spill_dir`` and the reader k-way-merges them back.
+    reduce_memory_budget: int = 64 << 20
 
     # TPU mesh (L2)
     mesh_axis_name: str = "ex"
@@ -188,6 +192,7 @@ class TpuShuffleConf:
             ("spillToDisk", "spill_to_disk", lambda v: str(v).lower() == "true"),
             ("spillDir", "spill_dir", str),
             ("spillDiskCap", "spill_disk_cap_bytes", parse_size),
+            ("reduceMemoryBudget", "reduce_memory_budget", parse_size),
         ]:
             v = get(name)
             if v is not None:

@@ -31,11 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkucx_tpu.ops.exchange import (
-    compact_input_offsets,
-    exclusive_cumsum,
-    ragged_params,
-)
+from sparkucx_tpu.ops.exchange import exclusive_cumsum, ragged_params
 
 
 @dataclass(frozen=True)
@@ -91,7 +87,7 @@ def _sort_and_sizes(spec: ColumnarSpec, rows: jnp.ndarray, owners: jnp.ndarray):
 
 
 def _columnar_shard_ragged(spec: ColumnarSpec, payload, send_sizes, recv_sizes, output_offsets):
-    input_offsets = compact_input_offsets(send_sizes)
+    input_offsets = exclusive_cumsum(send_sizes)
     out = jnp.zeros((spec.recv_capacity, payload.shape[1]), dtype=payload.dtype)
     out = jax.lax.ragged_all_to_all(
         payload,

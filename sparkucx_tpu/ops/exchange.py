@@ -54,8 +54,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def exclusive_cumsum(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
-    return jnp.cumsum(x, axis=axis) - x
+def exclusive_cumsum(x, axis: int = -1, xp=jnp):
+    return xp.cumsum(x, axis=axis) - x
 
 
 @dataclass(frozen=True)
@@ -103,13 +103,6 @@ class ExchangeSpec:
             raise ValueError("lane must be positive")
 
 
-def compact_input_offsets(send_sizes, xp=jnp):
-    """Input offsets of a compact (sorted/packed) payload — chunk j starts
-    right after chunks 0..j-1 (the columnar shuffle / distributed sort input
-    layout, as opposed to the exchange's slot layout)."""
-    return xp.cumsum(send_sizes) - send_sizes
-
-
 def ragged_params(sizes, me, slot_rows: Optional[int], xp=jnp):
     """The ragged lowering's offset/size formulas, factored for standalone
     verification (``xp=np`` in tests, ``xp=jnp`` traced inside the collective —
@@ -135,9 +128,9 @@ def ragged_params(sizes, me, slot_rows: Optional[int], xp=jnp):
     n = sizes.shape[0]
     send_sizes = sizes[me]                                      # (n,)
     recv_sizes = sizes[:, me]                                   # (n,)
-    output_offsets = (xp.cumsum(sizes, axis=0) - sizes)[me]     # (n,)
+    output_offsets = exclusive_cumsum(sizes, axis=0, xp=xp)[me]  # (n,)
     if slot_rows is None:
-        input_offsets = compact_input_offsets(send_sizes, xp)   # (n,)
+        input_offsets = exclusive_cumsum(send_sizes, xp=xp)     # (n,)
     else:
         input_offsets = xp.arange(n, dtype=xp.int32) * slot_rows
     return input_offsets, send_sizes, output_offsets, recv_sizes

@@ -1,0 +1,192 @@
+"""Bounded-memory combine/sort for the reduce side — the ExternalSorter role.
+
+The reference's read pipeline hands aggregation and ordering to Spark's
+spilling ExternalSorter (``UcxShuffleReader.scala:137-199``: ``aggregator
+.combineValuesByKey`` then ``ExternalSorter.insertAll``), which caps memory and
+spills sorted runs to disk.  The previous in-repo pipeline used an unbounded
+dict + ``sorted()`` over a full list, so a large reduce partition OOMed — this
+module closes that gap:
+
+* records insert into an in-memory map (combine) or list (no combine) under an
+  approximate byte budget;
+* crossing the budget spills the current contents to a temp file as ONE run,
+  sorted by the merge key (the actual key when ordering is requested —
+  orderable by definition then — else ``hash(key)``, which any dict key
+  supports);
+* iteration k-way-merges the runs + the in-memory tail with ``heapq.merge``
+  and, when combining, groups merge-key-equal records and aggregates per
+  actual key (same-hash-different-key collisions stay correct: groups are
+  tiny and combined through a dict).
+
+Like the rest of the staging tiers, spill files are ``spill_dir``-configurable
+(conf.spill_dir — shared with the store's disk round tier).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
+
+#: rough per-record bookkeeping overhead (dict entry / list slot, pointers)
+_RECORD_OVERHEAD = 64
+
+
+def _estimate(obj: Any) -> int:
+    import sys
+
+    try:
+        return sys.getsizeof(obj)
+    except TypeError:  # objects with broken __sizeof__
+        return 64
+
+
+class _Run:
+    """One spilled sorted run: a pickle stream of (merge_key, key, value)."""
+
+    def __init__(self, items: List[Tuple[Any, Any, Any]], spill_dir: Optional[str]):
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+        fd, self.path = tempfile.mkstemp(prefix="sparkucx_tpu_reduce_", dir=spill_dir)
+        with os.fdopen(fd, "wb") as f:
+            for item in items:
+                pickle.dump(item, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def __iter__(self) -> Iterator[Tuple[Any, Any, Any]]:
+        with open(self.path, "rb") as f:
+            while True:
+                try:
+                    yield pickle.load(f)
+                except EOFError:
+                    return
+
+    def close(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ExternalCombiner:
+    """Spillable combine/sort with an approximate in-memory byte budget.
+
+    ``aggregator(acc, v)`` folds a VALUE into an accumulator (Spark's
+    mergeValue); ``merge_combiners(acc1, acc2)`` merges two per-run
+    accumulators of the same key after a spill (Spark's mergeCombiners,
+    ExternalSorter's exact distinction) and defaults to ``aggregator`` — only
+    correct when accumulator and value have the same type (sum-like folds);
+    collect-style aggregators MUST pass an explicit ``merge_combiners``.
+    ``key_ordering`` yields output sorted by key.  Mirrors what Spark's
+    ExternalSorter provides the reference's reader
+    (UcxShuffleReader.scala:137-199).
+    """
+
+    def __init__(
+        self,
+        aggregator: Optional[Callable[[Any, Any], Any]] = None,
+        key_ordering: bool = False,
+        memory_budget: int = 64 << 20,
+        spill_dir: Optional[str] = None,
+        merge_combiners: Optional[Callable[[Any, Any], Any]] = None,
+    ) -> None:
+        self.aggregator = aggregator
+        self.merge_combiners = merge_combiners if merge_combiners is not None else aggregator
+        self.key_ordering = key_ordering
+        self.memory_budget = max(1, memory_budget)
+        self.spill_dir = spill_dir
+        self.spill_count = 0
+        self._map: dict = {}
+        self._list: List[Tuple[Any, Any]] = []
+        self._approx = 0
+        self._runs: List[_Run] = []
+
+    # -- insert ------------------------------------------------------------
+
+    def insert(self, key: Any, value: Any) -> None:
+        if self.aggregator is not None:
+            if key in self._map:
+                old = self._map[key]
+                new = self.aggregator(old, value)
+                self._map[key] = new
+                # growing accumulators (collect-style folds) must count against
+                # the budget too, or they bypass the spill entirely
+                self._approx += _estimate(new) - _estimate(old)
+            else:
+                self._map[key] = value
+                self._approx += _estimate(key) + _estimate(value) + _RECORD_OVERHEAD
+        else:
+            self._list.append((key, value))
+            self._approx += _estimate(key) + _estimate(value) + _RECORD_OVERHEAD
+        if self._approx > self.memory_budget:
+            self._spill()
+
+    def insert_all(self, records: Iterable[Tuple[Any, Any]]) -> None:
+        for k, v in records:
+            self.insert(k, v)
+
+    # -- spill -------------------------------------------------------------
+
+    def _merge_key(self, key: Any) -> Any:
+        return key if self.key_ordering else hash(key)
+
+    def _memory_items(self) -> List[Tuple[Any, Any, Any]]:
+        pairs = self._map.items() if self.aggregator is not None else self._list
+        return [(self._merge_key(k), k, v) for k, v in pairs]
+
+    def _spill(self) -> None:
+        items = self._memory_items()
+        items.sort(key=lambda t: t[0])
+        self._runs.append(_Run(items, self.spill_dir))
+        self.spill_count += 1
+        self._map = {}
+        self._list = []
+        self._approx = 0
+
+    # -- output ------------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Tuple[Any, Any]]:
+        if not self._runs:
+            # pure in-memory path: identical behavior to the pre-spill pipeline
+            pairs = (
+                iter(self._map.items()) if self.aggregator is not None else iter(self._list)
+            )
+            if self.key_ordering:
+                pairs = iter(sorted(pairs, key=lambda kv: kv[0]))
+            return pairs
+        return self._merged()
+
+    def _merged(self) -> Iterator[Tuple[Any, Any]]:
+        tail = self._memory_items()
+        tail.sort(key=lambda t: t[0])
+        streams = [iter(r) for r in self._runs] + [iter(tail)]
+        merged = heapq.merge(*streams, key=lambda t: t[0])
+        if self.aggregator is None:
+            for _mk, k, v in merged:
+                yield (k, v)
+        else:
+            # combine within each merge-key group; a group holds one key in the
+            # common case, a handful on hash collision — bounded either way.
+            # Entries are per-run ACCUMULATORS, so they merge with
+            # merge_combiners, not the value-folding aggregator.
+            for _mk, group in itertools.groupby(merged, key=lambda t: t[0]):
+                acc: dict = {}
+                order: list = []
+                for _, k, v in group:
+                    if k in acc:
+                        acc[k] = self.merge_combiners(acc[k], v)
+                    else:
+                        acc[k] = v
+                        order.append(k)
+                for k in order:
+                    yield (k, acc[k])
+
+    def close(self) -> None:
+        for r in self._runs:
+            r.close()
+        self._runs = []
+        self._map = {}
+        self._list = []
+        self._approx = 0

@@ -44,6 +44,8 @@ class ShuffleReadMetrics:
     remote_blocks_fetched: int = 0
     fetch_wait_ns: int = 0
     blocks_retried: int = 0
+    #: combine/sort runs spilled to disk (the ExternalSorter spill counter)
+    spills: int = 0
 
 
 @dataclass
@@ -96,6 +98,9 @@ class TpuShuffleReader:
         key_ordering: bool = False,
         sender_of: Optional[Callable[[int], ExecutorId]] = None,
         fetch_retries: int = 1,
+        memory_budget: int = 64 << 20,
+        spill_dir: Optional[str] = None,
+        merge_combiners: Optional[Callable[[Any, Any], Any]] = None,
     ) -> None:
         self.transport = transport
         self.executor_id = executor_id
@@ -111,6 +116,9 @@ class TpuShuffleReader:
         self.key_ordering = key_ordering
         self.sender_of = sender_of or (lambda m: self.executor_id)
         self.fetch_retries = max(0, fetch_retries)
+        self.memory_budget = memory_budget
+        self.spill_dir = spill_dir
+        self.merge_combiners = merge_combiners
         self.metrics = ShuffleReadMetrics()
 
     # -- raw block iterator ------------------------------------------------
@@ -215,7 +223,13 @@ class TpuShuffleReader:
     # -- record pipeline ---------------------------------------------------
 
     def read(self) -> Iterator[Any]:
-        """deserialize -> combine -> sort (UcxShuffleReader.scala:137-199)."""
+        """deserialize -> combine -> sort (UcxShuffleReader.scala:137-199).
+
+        Combine and sort run through the spillable ``ExternalCombiner``
+        (shuffle/external.py) under ``memory_budget`` — the ExternalSorter
+        role the reference's pipeline delegates to Spark — so a reduce
+        partition larger than memory streams through sorted disk runs instead
+        of OOMing."""
         records: Iterator[Any] = (
             rec for blk in self.fetch_blocks() for rec in self.deserializer(blk.data)
         )
@@ -226,11 +240,29 @@ class TpuShuffleReader:
                 yield rec
 
         records = counted(records)
-        if self.aggregator is not None:
-            combined: dict = {}
-            for k, v in records:
-                combined[k] = self.aggregator(combined[k], v) if k in combined else v
-            records = iter(combined.items())
-        if self.key_ordering:
-            records = iter(sorted(records, key=lambda kv: kv[0]))
-        return records
+        if self.aggregator is None and not self.key_ordering:
+            return records  # pure streaming, nothing materializes
+
+        from sparkucx_tpu.shuffle.external import ExternalCombiner
+
+        combiner = ExternalCombiner(
+            aggregator=self.aggregator,
+            key_ordering=self.key_ordering,
+            memory_budget=self.memory_budget,
+            spill_dir=self.spill_dir,
+            merge_combiners=self.merge_combiners,
+        )
+        try:
+            combiner.insert_all(records)
+        except BaseException:
+            combiner.close()  # reclaim spilled runs; mkstemp files don't self-delete
+            raise
+        self.metrics.spills = combiner.spill_count
+
+        def drain(c):
+            try:
+                yield from c
+            finally:
+                c.close()
+
+        return drain(combiner)

@@ -1,0 +1,194 @@
+"""Tests for the bounded-memory reduce combine/sort (the ExternalSorter role,
+UcxShuffleReader.scala:137-199)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.shuffle.external import ExternalCombiner
+
+
+def oracle_aggregate(records, agg):
+    out = {}
+    for k, v in records:
+        out[k] = agg(out[k], v) if k in out else v
+    return out
+
+
+class TestInMemoryPaths:
+    def test_combine_no_spill(self):
+        c = ExternalCombiner(aggregator=lambda a, b: a + b)
+        c.insert_all([("a", 1), ("b", 2), ("a", 3)])
+        assert dict(c) == {"a": 4, "b": 2}
+        assert c.spill_count == 0
+
+    def test_sort_no_spill(self):
+        c = ExternalCombiner(key_ordering=True)
+        c.insert_all([(3, "c"), (1, "a"), (2, "b")])
+        assert list(c) == [(1, "a"), (2, "b"), (3, "c")]
+
+    def test_combine_and_sort(self):
+        c = ExternalCombiner(aggregator=lambda a, b: a + b, key_ordering=True)
+        c.insert_all([(2, 1), (1, 1), (2, 1)])
+        assert list(c) == [(1, 1), (2, 2)]
+
+
+class TestSpillingPaths:
+    def test_combine_beyond_budget(self, tmp_path):
+        # ~100k distinct keys through a ~64 KB budget: dozens of spills, exact result
+        agg = lambda a, b: a + b
+        c = ExternalCombiner(
+            aggregator=agg, memory_budget=64 << 10, spill_dir=str(tmp_path)
+        )
+        rng = np.random.default_rng(0)
+        records = [(int(k), 1) for k in rng.integers(0, 100_000, size=200_000)]
+        c.insert_all(records)
+        assert c.spill_count > 5
+        got = dict(c)
+        assert got == oracle_aggregate(records, agg)
+        c.close()
+        assert os.listdir(str(tmp_path)) == []  # runs reclaimed
+
+    def test_sort_beyond_budget(self, tmp_path):
+        c = ExternalCombiner(
+            key_ordering=True, memory_budget=64 << 10, spill_dir=str(tmp_path)
+        )
+        rng = np.random.default_rng(1)
+        keys = rng.integers(0, 1 << 30, size=100_000)
+        c.insert_all([(int(k), i) for i, k in enumerate(keys)])
+        assert c.spill_count > 5
+        out_keys = [k for k, _ in c]
+        assert out_keys == sorted(int(k) for k in keys)
+        c.close()
+
+    def test_combine_and_sort_beyond_budget(self, tmp_path):
+        agg = lambda a, b: a + b
+        c = ExternalCombiner(
+            aggregator=agg, key_ordering=True, memory_budget=32 << 10,
+            spill_dir=str(tmp_path),
+        )
+        rng = np.random.default_rng(2)
+        records = [(int(k), 1) for k in rng.integers(0, 5_000, size=100_000)]
+        c.insert_all(records)
+        assert c.spill_count > 0
+        out = list(c)
+        expected = sorted(oracle_aggregate(records, agg).items())
+        assert out == expected
+        c.close()
+
+    def test_hash_collision_groups_stay_correct(self, tmp_path):
+        # unordered combine merges by hash(key); craft guaranteed collisions
+        # (int hash is identity-ish: x and -x-? no — use small ints plus their
+        # hash-equal float twins: hash(1) == hash(1.0))
+        agg = lambda a, b: a + b
+        c = ExternalCombiner(aggregator=agg, memory_budget=1, spill_dir=str(tmp_path))
+        records = [(1, 10), (1.0, 100), (2, 1), (1, 3)]
+        c.insert_all(records)  # budget 1 byte: spills every insert
+        assert c.spill_count >= 3
+        got = dict(c)
+        # python dict semantics: 1 == 1.0 so they are ONE key
+        assert got == oracle_aggregate(records, agg)
+        c.close()
+
+    def test_collect_style_aggregator_with_merge_combiners(self, tmp_path):
+        # accumulator type != value type: cross-run merge must use
+        # merge_combiners, and growing accumulators must count against the
+        # budget (both regressions found in review)
+        def agg(acc, v):
+            return (acc if isinstance(acc, list) else [acc]) + [v]
+
+        def merge(a, b):
+            la = a if isinstance(a, list) else [a]
+            lb = b if isinstance(b, list) else [b]
+            return la + lb
+
+        c = ExternalCombiner(
+            aggregator=agg, merge_combiners=merge, key_ordering=True,
+            memory_budget=8 << 10, spill_dir=str(tmp_path),
+        )
+        records = [(i % 5, i) for i in range(20_000)]
+        c.insert_all(records)
+        assert c.spill_count > 0, "growing accumulators never crossed the budget"
+        out = dict(c)
+        for k in range(5):
+            vals = out[k] if isinstance(out[k], list) else [out[k]]
+            assert sorted(vals) == [i for i in range(20_000) if i % 5 == k]
+        c.close()
+
+    def test_growing_accumulator_counts_against_budget(self, tmp_path):
+        # few keys, list-appending aggregator: without accumulator-growth
+        # accounting this never spills and memory is unbounded
+        agg = lambda acc, v: acc + [v] if isinstance(acc, list) else [acc, v]
+        c = ExternalCombiner(
+            aggregator=agg, merge_combiners=lambda a, b: a + b,
+            memory_budget=16 << 10, spill_dir=str(tmp_path),
+        )
+        c.insert_all([(0, i) for i in range(50_000)])
+        assert c.spill_count > 0
+        c.close()
+
+    def test_spill_dir_created_on_demand(self, tmp_path):
+        missing = tmp_path / "not" / "yet" / "there"
+        c = ExternalCombiner(
+            aggregator=lambda a, b: a + b, memory_budget=1, spill_dir=str(missing)
+        )
+        c.insert_all([(1, 1), (2, 2)])
+        assert c.spill_count >= 1
+        assert dict(c) == {1: 1, 2: 2}
+        c.close()
+
+    def test_unordered_no_aggregator_streams_all_records(self, tmp_path):
+        c = ExternalCombiner(memory_budget=1 << 10, spill_dir=str(tmp_path))
+        records = [(i % 50, i) for i in range(10_000)]
+        c.insert_all(records)
+        assert c.spill_count > 0
+        got = sorted(v for _, v in c)
+        assert got == list(range(10_000))
+        c.close()
+
+
+class TestReaderIntegration:
+    def test_reduce_beyond_budget_end_to_end(self, tmp_path):
+        """VERDICT round-1 item 7 done criterion: aggregate data several times
+        larger than a small configured memory budget through the full
+        manager/reader path."""
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+        from sparkucx_tpu.shuffle.reader import serialize_records
+
+        budget = 32 << 10
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=8 << 20,
+            block_alignment=128,
+            num_executors=1,
+            reduce_memory_budget=budget,
+            spill_dir=str(tmp_path),
+        )
+        manager = TpuShuffleManager(conf, num_executors=1)
+        M, R = 4, 2
+        manager.register_shuffle(0, M, R)
+        rng = np.random.default_rng(3)
+        all_records = {r: [] for r in range(R)}
+        for m in range(M):
+            writer = manager.get_writer(0, m)
+            for r in range(R):
+                recs = [(int(k), 1) for k in rng.integers(0, 20_000, size=20_000)]
+                all_records[r].extend(recs)
+                pw = writer.get_partition_writer(r)
+                with pw.open_stream() as stream:
+                    stream.write(serialize_records(recs))
+            writer.commit_all_partitions()
+        manager.run_exchange(0)
+
+        agg = lambda a, b: a + b
+        reader = manager.get_reader(0, 0, 1, aggregator=agg, key_ordering=True)
+        out = list(reader.read())
+        assert reader.metrics.spills > 0, "budget never exceeded — test too small"
+        expected = sorted(oracle_aggregate(all_records[0], agg).items())
+        assert out == expected
+        total_bytes = sum(
+            len(serialize_records(all_records[r])) for r in range(R)
+        )
+        assert total_bytes > 4 * budget
+        manager.stop()
