@@ -37,22 +37,59 @@ public final class DaemonClient implements AutoCloseable {
     this.in = new DataInputStream(socket.getInputStream());
   }
 
-  private static byte[] le32(int v) {
-    return ByteBuffer.allocate(4).order(ByteOrder.LITTLE_ENDIAN).putInt(v).array();
+  /**
+   * Pure frame encoder: u32 op | u64 headerLen | u64 bodyLen | header | body,
+   * little-endian. Exposed static so the golden wire fixtures
+   * (jvm/fixtures, FixtureCheck.java, tests/test_daemon.py) byte-check the
+   * exact encoding without a socket.
+   */
+  static byte[] encodeFrame(int op, String jsonHeader, byte[] body) {
+    byte[] header = jsonHeader == null ? new byte[0] : jsonHeader.getBytes(StandardCharsets.UTF_8);
+    byte[] payload = body == null ? new byte[0] : body;
+    ByteBuffer bb = ByteBuffer.allocate(20 + header.length + payload.length)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    bb.putInt(op).putLong(header.length).putLong(payload.length);
+    bb.put(header).put(payload);
+    return bb.array();
   }
 
-  private static byte[] le64(long v) {
-    return ByteBuffer.allocate(8).order(ByteOrder.LITTLE_ENDIAN).putLong(v).array();
+  // JSON header builders — the exact bytes each op puts on the wire, shared by
+  // the client methods and FixtureCheck so a format drift fails the fixtures.
+  static String headerCreateShuffle(int shuffleId, int numMappers, int numReducers) {
+    return String.format("{\"shuffle_id\": %d, \"num_mappers\": %d, \"num_reducers\": %d}",
+        shuffleId, numMappers, numReducers);
+  }
+
+  static String headerOpenMapWriter(int shuffleId, int mapId) {
+    return String.format("{\"shuffle_id\": %d, \"map_id\": %d}", shuffleId, mapId);
+  }
+
+  static String headerWritePartition(int writer, int reduceId) {
+    return String.format("{\"writer\": %d, \"reduce_id\": %d}", writer, reduceId);
+  }
+
+  static String headerCommitMap(int writer) {
+    return String.format("{\"writer\": %d}", writer);
+  }
+
+  static String headerShuffleId(int shuffleId) {
+    return String.format("{\"shuffle_id\": %d}", shuffleId);
+  }
+
+  /** Batched fetch request body: u64 tag | u32 count | (i32 shuffle, i32 map, i32 reduce)*n. */
+  static byte[] fetchRequestBody(long tag, int shuffleId, int[] mapIds, int[] reduceIds) {
+    int n = mapIds.length;
+    ByteBuffer req = ByteBuffer.allocate(12 + 12 * n).order(ByteOrder.LITTLE_ENDIAN);
+    req.putLong(tag);
+    req.putInt(n);
+    for (int i = 0; i < n; i++) {
+      req.putInt(shuffleId).putInt(mapIds[i]).putInt(reduceIds[i]);
+    }
+    return req.array();
   }
 
   private synchronized byte[][] call(int op, String jsonHeader, byte[] body) throws IOException {
-    byte[] header = jsonHeader == null ? new byte[0] : jsonHeader.getBytes(StandardCharsets.UTF_8);
-    byte[] payload = body == null ? new byte[0] : body;
-    out.write(le32(op));
-    out.write(le64(header.length));
-    out.write(le64(payload.length));
-    out.write(header);
-    out.write(payload);
+    out.write(encodeFrame(op, jsonHeader, body));
     out.flush();
     byte[] frameHeader = new byte[20];
     in.readFully(frameHeader);
@@ -77,29 +114,31 @@ public final class DaemonClient implements AutoCloseable {
   }
 
   public void createShuffle(int shuffleId, int numMappers, int numReducers) throws IOException {
-    controlCall(OP_CREATE_SHUFFLE,
-        String.format("{\"shuffle_id\": %d, \"num_mappers\": %d, \"num_reducers\": %d}",
-            shuffleId, numMappers, numReducers), null);
+    controlCall(OP_CREATE_SHUFFLE, headerCreateShuffle(shuffleId, numMappers, numReducers), null);
   }
 
   public int openMapWriter(int shuffleId, int mapId) throws IOException {
-    byte[][] reply = controlCall(OP_OPEN_MAP_WRITER,
-        String.format("{\"shuffle_id\": %d, \"map_id\": %d}", shuffleId, mapId), null);
+    byte[][] reply = controlCall(OP_OPEN_MAP_WRITER, headerOpenMapWriter(shuffleId, mapId), null);
     String ack = new String(reply[0], StandardCharsets.UTF_8);
-    int idx = ack.indexOf("\"writer\":");
-    return Integer.parseInt(ack.substring(idx + 9).replaceAll("[^0-9].*$", "").trim());
+    // ack is json.dumps output: {"ok": true, "writer": N} — skip the space
+    // after the colon, then take the digit run
+    int p = ack.indexOf("\"writer\":") + 9;
+    while (p < ack.length() && !Character.isDigit(ack.charAt(p))) p++;
+    int q = p;
+    while (q < ack.length() && Character.isDigit(ack.charAt(q))) q++;
+    if (p == q) throw new IOException("malformed OpenMapWriter ack: " + ack);
+    return Integer.parseInt(ack.substring(p, q));
   }
 
   public void writePartition(int writer, int reduceId, byte[] data, int off, int len)
       throws IOException {
     byte[] chunk = new byte[len];
     System.arraycopy(data, off, chunk, 0, len);
-    controlCall(OP_WRITE_PARTITION,
-        String.format("{\"writer\": %d, \"reduce_id\": %d}", writer, reduceId), chunk);
+    controlCall(OP_WRITE_PARTITION, headerWritePartition(writer, reduceId), chunk);
   }
 
   public long[] commitMap(int writer) throws IOException {
-    byte[][] reply = controlCall(OP_COMMIT_MAP, String.format("{\"writer\": %d}", writer), null);
+    byte[][] reply = controlCall(OP_COMMIT_MAP, headerCommitMap(writer), null);
     ByteBuffer bb = ByteBuffer.wrap(reply[1]).order(ByteOrder.LITTLE_ENDIAN);
     long[] lengths = new long[reply[1].length / 8];
     for (int i = 0; i < lengths.length; i++) lengths[i] = bb.getLong();
@@ -107,19 +146,12 @@ public final class DaemonClient implements AutoCloseable {
   }
 
   public void runExchange(int shuffleId) throws IOException {
-    controlCall(OP_RUN_EXCHANGE, String.format("{\"shuffle_id\": %d}", shuffleId), null);
+    controlCall(OP_RUN_EXCHANGE, headerShuffleId(shuffleId), null);
   }
 
   /** Batched fetch: returns one byte[] per requested block; null marks a miss. */
   public byte[][] fetchBlocks(int shuffleId, int[] mapIds, int[] reduceIds) throws IOException {
-    int n = mapIds.length;
-    ByteBuffer req = ByteBuffer.allocate(12 + 12 * n).order(ByteOrder.LITTLE_ENDIAN);
-    req.putLong(0L);           // tag
-    req.putInt(n);             // count
-    for (int i = 0; i < n; i++) {
-      req.putInt(shuffleId).putInt(mapIds[i]).putInt(reduceIds[i]);
-    }
-    byte[][] reply = call(OP_FETCH, null, req.array());
+    byte[][] reply = call(OP_FETCH, null, fetchRequestBody(0L, shuffleId, mapIds, reduceIds));
     ByteBuffer hdr = ByteBuffer.wrap(reply[0]).order(ByteOrder.LITTLE_ENDIAN);
     hdr.getLong();             // tag echo
     int count = hdr.getInt();
@@ -137,7 +169,7 @@ public final class DaemonClient implements AutoCloseable {
   }
 
   public void removeShuffle(int shuffleId) throws IOException {
-    controlCall(OP_REMOVE_SHUFFLE, String.format("{\"shuffle_id\": %d}", shuffleId), null);
+    controlCall(OP_REMOVE_SHUFFLE, headerShuffleId(shuffleId), null);
   }
 
   @Override

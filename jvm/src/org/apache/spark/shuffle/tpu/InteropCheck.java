@@ -1,0 +1,61 @@
+package org.apache.spark.shuffle.tpu;
+
+import java.util.Arrays;
+
+/**
+ * Live Java <-> Python interop gate: drives a running shuffle daemon
+ * (python -m sparkucx_tpu.shuffle.daemon) through a full
+ * create -> write -> commit -> exchange -> fetch -> remove cycle with the real
+ * {@link DaemonClient}, asserting every decoded value. This covers the DECODE
+ * side of the protocol that the byte-fixture checks cannot (FixtureCheck only
+ * proves encoding) — a daemon ack format drift fails here.
+ *
+ * Usage: java org.apache.spark.shuffle.tpu.InteropCheck [host] [port]
+ */
+public final class InteropCheck {
+  static void check(boolean cond, String what) {
+    if (!cond) {
+      System.err.println("FAIL: " + what);
+      System.exit(1);
+    }
+    System.out.println("ok: " + what);
+  }
+
+  public static void main(String[] args) throws Exception {
+    String host = args.length > 0 ? args[0] : "127.0.0.1";
+    int port = args.length > 1 ? Integer.parseInt(args[1]) : 1338;
+    int sid = 42, M = 2, R = 3;
+
+    try (DaemonClient c = new DaemonClient(host, port)) {
+      c.createShuffle(sid, M, R);
+
+      byte[][] payloads = new byte[M][];
+      for (int m = 0; m < M; m++) {
+        int w = c.openMapWriter(sid, m);
+        check(w == m, "openMapWriter handle " + m);
+        payloads[m] = new byte[100 * (m + 1)];
+        Arrays.fill(payloads[m], (byte) (m + 1));
+        // stream partition 1 in two chunks (repeated WRITE_PARTITION)
+        c.writePartition(w, 1, payloads[m], 0, 50);
+        c.writePartition(w, 1, payloads[m], 50, payloads[m].length - 50);
+        long[] lengths = c.commitMap(w);
+        check(lengths.length == R, "commit lengths count map " + m);
+        check(lengths[1] == payloads[m].length, "commit length map " + m);
+        check(lengths[0] == 0 && lengths[2] == 0, "empty partitions map " + m);
+      }
+
+      c.runExchange(sid);
+
+      byte[][] blocks = c.fetchBlocks(sid, new int[] {0, 1}, new int[] {1, 1});
+      check(blocks.length == 2, "fetch count");
+      check(Arrays.equals(blocks[0], payloads[0]), "fetch map 0 bytes");
+      check(Arrays.equals(blocks[1], payloads[1]), "fetch map 1 bytes");
+
+      byte[][] miss = c.fetchBlocks(sid, new int[] {0}, new int[] {2});
+      check(miss[0] != null && miss[0].length == 0, "empty partition fetch");
+
+      c.removeShuffle(sid);
+      System.out.println("interop cycle complete");
+    }
+  }
+}

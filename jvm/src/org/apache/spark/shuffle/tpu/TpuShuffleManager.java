@@ -102,7 +102,8 @@ public class TpuShuffleManager implements ShuffleManager {
       ShuffleReadMetricsReporter metrics) {
     TpuShuffleHandle<K, ?, C> h = (TpuShuffleHandle<K, ?, C>) handle;
     try {
-      return new TpuShuffleReader<>(daemon(), h, startPartition, endPartition, metrics);
+      return new TpuShuffleReader<>(
+          daemon(), h, startMapIndex, endMapIndex, startPartition, endPartition, metrics);
     } catch (IOException e) {
       throw new RuntimeException(e);
     }

@@ -19,24 +19,32 @@ import scala.collection.Iterator;
 
 /**
  * Reduce-side reader: batched OP_FETCH of every (map, reduce) block in
- * [startPartition, endPartition), then the dependency serializer's
- * deserialization stream — the reader pipeline of
+ * [startPartition, endPartition) x [startMapIndex, endMapIndex), then the
+ * dependency serializer's deserialization stream — the reader pipeline of
  * compat/spark_3_0/UcxShuffleReader.scala:137-199 with the daemon replacing the
- * ShuffleBlockFetcherIterator + UcxShuffleClient pair. Aggregation/ordering are
- * left to Spark (the dependency's aggregator runs above the reader in 3.x).
+ * ShuffleBlockFetcherIterator + UcxShuffleClient pair. The map range is AQE's
+ * partial-map read contract (endMapIndex == Integer.MAX_VALUE means all maps);
+ * ignoring it would return data from maps outside the requested range.
+ * Aggregation/ordering are left to Spark (the dependency's aggregator runs
+ * above the reader in 3.x).
  */
 public class TpuShuffleReader<K, C> implements ShuffleReader<K, C> {
   private final DaemonClient daemon;
   private final TpuShuffleManager.TpuShuffleHandle<K, ?, C> handle;
+  private final int startMapIndex;
+  private final int endMapIndex;
   private final int startPartition;
   private final int endPartition;
   private final ShuffleReadMetricsReporter metrics;
 
   public TpuShuffleReader(
       DaemonClient daemon, TpuShuffleManager.TpuShuffleHandle<K, ?, C> handle,
+      int startMapIndex, int endMapIndex,
       int startPartition, int endPartition, ShuffleReadMetricsReporter metrics) {
     this.daemon = daemon;
     this.handle = handle;
+    this.startMapIndex = startMapIndex;
+    this.endMapIndex = endMapIndex;
     this.startPartition = startPartition;
     this.endPartition = endPartition;
     this.metrics = metrics;
@@ -46,14 +54,16 @@ public class TpuShuffleReader<K, C> implements ShuffleReader<K, C> {
   @SuppressWarnings("unchecked")
   public Iterator<Product2<K, C>> read() {
     try {
-      int numMaps = handle.numMaps;
+      int mapStart = Math.max(0, startMapIndex);
+      int mapEnd = Math.min(handle.numMaps, endMapIndex);  // MAX_VALUE -> all maps
+      int numMaps = Math.max(0, mapEnd - mapStart);
       List<ByteArrayInputStream> chunks = new ArrayList<>();
       long t0 = System.nanoTime();
       for (int p = startPartition; p < endPartition; p++) {
         int[] mapIds = new int[numMaps];
         int[] reduceIds = new int[numMaps];
         for (int m = 0; m < numMaps; m++) {
-          mapIds[m] = m;
+          mapIds[m] = mapStart + m;
           reduceIds[m] = p;
         }
         byte[][] blocks = daemon.fetchBlocks(handle.shuffleId(), mapIds, reduceIds);

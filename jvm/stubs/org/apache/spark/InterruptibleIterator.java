@@ -1,0 +1,8 @@
+package org.apache.spark;
+
+/** Compile-only stub (see SparkConf stub header). */
+public class InterruptibleIterator<T> implements scala.collection.Iterator<T> {
+  public InterruptibleIterator(TaskContext context, scala.collection.Iterator<T> delegate) {}
+  @Override public boolean hasNext() { throw new UnsupportedOperationException("stub"); }
+  @Override public T next() { throw new UnsupportedOperationException("stub"); }
+}

@@ -1,0 +1,7 @@
+package org.apache.spark;
+
+/** Compile-only stub (see SparkConf stub header). */
+public abstract class Partitioner {
+  public abstract int numPartitions();
+  public abstract int getPartition(Object key);
+}

@@ -1,0 +1,11 @@
+package org.apache.spark;
+
+import org.apache.spark.rdd.RDD;
+import org.apache.spark.serializer.Serializer;
+
+/** Compile-only stub (see SparkConf stub header). */
+public class ShuffleDependency<K, V, C> {
+  public RDD<?> rdd() { throw new UnsupportedOperationException("stub"); }
+  public Partitioner partitioner() { throw new UnsupportedOperationException("stub"); }
+  public Serializer serializer() { throw new UnsupportedOperationException("stub"); }
+}

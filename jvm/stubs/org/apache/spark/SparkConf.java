@@ -1,0 +1,9 @@
+package org.apache.spark;
+
+/** Compile-only stub mirroring the spark-core 3.x signatures the shim uses.
+ * Never shipped: the real provided-scope spark-core supplies this class at
+ * runtime (see jvm/README.md). */
+public class SparkConf {
+  public String get(String key, String defaultValue) { throw new UnsupportedOperationException("stub"); }
+  public int getInt(String key, int defaultValue) { throw new UnsupportedOperationException("stub"); }
+}

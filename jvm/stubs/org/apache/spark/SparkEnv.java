@@ -1,0 +1,9 @@
+package org.apache.spark;
+
+import org.apache.spark.storage.BlockManager;
+
+/** Compile-only stub (see SparkConf stub header). */
+public class SparkEnv {
+  public static SparkEnv get() { throw new UnsupportedOperationException("stub"); }
+  public BlockManager blockManager() { throw new UnsupportedOperationException("stub"); }
+}

@@ -1,0 +1,6 @@
+package org.apache.spark;
+
+/** Compile-only stub (see SparkConf stub header). */
+public abstract class TaskContext {
+  public static TaskContext get() { throw new UnsupportedOperationException("stub"); }
+}

@@ -1,0 +1,6 @@
+package org.apache.spark.rdd;
+
+/** Compile-only stub (see SparkConf stub header). */
+public class RDD<T> {
+  public int getNumPartitions() { throw new UnsupportedOperationException("stub"); }
+}

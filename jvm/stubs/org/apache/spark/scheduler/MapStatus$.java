@@ -1,0 +1,12 @@
+package org.apache.spark.scheduler;
+
+import org.apache.spark.storage.BlockManagerId;
+
+/** Compile-only stub of the MapStatus companion object's static forwarder
+ * surface (see SparkConf stub header). */
+public final class MapStatus$ {
+  public static final MapStatus$ MODULE$ = new MapStatus$();
+  public MapStatus apply(BlockManagerId loc, long[] uncompressedSizes, long mapTaskId) {
+    throw new UnsupportedOperationException("stub");
+  }
+}

@@ -1,0 +1,4 @@
+package org.apache.spark.scheduler;
+
+/** Compile-only stub (see SparkConf stub header). */
+public interface MapStatus {}

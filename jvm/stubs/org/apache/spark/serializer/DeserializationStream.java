@@ -1,0 +1,7 @@
+package org.apache.spark.serializer;
+
+/** Compile-only stub (see SparkConf stub header). */
+public abstract class DeserializationStream {
+  public abstract scala.collection.Iterator<scala.Tuple2<Object, Object>> asKeyValueIterator();
+  public abstract void close();
+}

@@ -1,0 +1,8 @@
+package org.apache.spark.serializer;
+
+/** Compile-only stub (see SparkConf stub header). */
+public abstract class SerializationStream {
+  public abstract <T> SerializationStream writeKey(T key, scala.reflect.ClassTag<T> tag);
+  public abstract <T> SerializationStream writeValue(T value, scala.reflect.ClassTag<T> tag);
+  public abstract void close();
+}

@@ -1,0 +1,6 @@
+package org.apache.spark.serializer;
+
+/** Compile-only stub (see SparkConf stub header). */
+public abstract class Serializer {
+  public abstract SerializerInstance newInstance();
+}

@@ -1,0 +1,10 @@
+package org.apache.spark.serializer;
+
+import java.io.InputStream;
+import java.io.OutputStream;
+
+/** Compile-only stub (see SparkConf stub header). */
+public abstract class SerializerInstance {
+  public abstract SerializationStream serializeStream(OutputStream s);
+  public abstract DeserializationStream deserializeStream(InputStream s);
+}

@@ -1,0 +1,4 @@
+package org.apache.spark.shuffle;
+
+/** Compile-only stub (see SparkConf stub header). */
+public interface ShuffleBlockResolver {}

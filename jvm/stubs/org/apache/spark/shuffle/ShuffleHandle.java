@@ -1,0 +1,8 @@
+package org.apache.spark.shuffle;
+
+/** Compile-only stub (see SparkConf stub header). */
+public abstract class ShuffleHandle {
+  private final int shuffleId;
+  public ShuffleHandle(int shuffleId) { this.shuffleId = shuffleId; }
+  public int shuffleId() { return shuffleId; }
+}

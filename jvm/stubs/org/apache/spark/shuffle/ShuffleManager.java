@@ -1,0 +1,19 @@
+package org.apache.spark.shuffle;
+
+import org.apache.spark.ShuffleDependency;
+import org.apache.spark.TaskContext;
+
+/** Compile-only stub of the Spark 3.2+ ShuffleManager SPI (see SparkConf stub
+ * header). */
+public interface ShuffleManager {
+  <K, V, C> ShuffleHandle registerShuffle(int shuffleId, ShuffleDependency<K, V, C> dependency);
+  <K, V> ShuffleWriter<K, V> getWriter(
+      ShuffleHandle handle, long mapId, TaskContext context, ShuffleWriteMetricsReporter metrics);
+  <K, C> ShuffleReader<K, C> getReader(
+      ShuffleHandle handle, int startMapIndex, int endMapIndex,
+      int startPartition, int endPartition, TaskContext context,
+      ShuffleReadMetricsReporter metrics);
+  boolean unregisterShuffle(int shuffleId);
+  ShuffleBlockResolver shuffleBlockResolver();
+  void stop();
+}

@@ -1,0 +1,8 @@
+package org.apache.spark.shuffle;
+
+/** Compile-only stub (see SparkConf stub header). */
+public interface ShuffleReadMetricsReporter {
+  void incRemoteBytesRead(long v);
+  void incRemoteBlocksFetched(long v);
+  void incFetchWaitTime(long v);
+}

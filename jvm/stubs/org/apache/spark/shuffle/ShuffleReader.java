@@ -1,0 +1,6 @@
+package org.apache.spark.shuffle;
+
+/** Compile-only stub (see SparkConf stub header). */
+public interface ShuffleReader<K, C> {
+  scala.collection.Iterator<scala.Product2<K, C>> read();
+}

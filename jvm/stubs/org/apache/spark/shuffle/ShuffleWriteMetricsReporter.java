@@ -1,0 +1,7 @@
+package org.apache.spark.shuffle;
+
+/** Compile-only stub (see SparkConf stub header). */
+public interface ShuffleWriteMetricsReporter {
+  void incRecordsWritten(long v);
+  void incBytesWritten(long v);
+}

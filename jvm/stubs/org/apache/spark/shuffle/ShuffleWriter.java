@@ -1,0 +1,10 @@
+package org.apache.spark.shuffle;
+
+import java.io.IOException;
+import org.apache.spark.scheduler.MapStatus;
+
+/** Compile-only stub (see SparkConf stub header). */
+public abstract class ShuffleWriter<K, V> {
+  public abstract void write(scala.collection.Iterator<scala.Product2<K, V>> records) throws IOException;
+  public abstract scala.Option<MapStatus> stop(boolean success);
+}

@@ -1,0 +1,6 @@
+package org.apache.spark.storage;
+
+/** Compile-only stub (see SparkConf stub header). */
+public class BlockManager {
+  public BlockManagerId shuffleServerId() { throw new UnsupportedOperationException("stub"); }
+}

@@ -1,0 +1,4 @@
+package org.apache.spark.storage;
+
+/** Compile-only stub (see SparkConf stub header). */
+public class BlockManagerId {}

@@ -1,0 +1,8 @@
+package scala;
+
+/** Compile-only stub of scala.Option's static-forwarder surface (see the
+ * org.apache.spark.SparkConf stub header). */
+public abstract class Option<A> {
+  public static <A> Option<A> empty() { throw new UnsupportedOperationException("stub"); }
+  public static <A> Option<A> apply(A value) { throw new UnsupportedOperationException("stub"); }
+}

@@ -1,0 +1,7 @@
+package scala;
+
+/** Compile-only stub (see the org.apache.spark.SparkConf stub header). */
+public interface Product2<T1, T2> {
+  T1 _1();
+  T2 _2();
+}

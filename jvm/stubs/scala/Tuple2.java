@@ -1,0 +1,7 @@
+package scala;
+
+/** Compile-only stub (see the org.apache.spark.SparkConf stub header). */
+public class Tuple2<T1, T2> implements Product2<T1, T2> {
+  @Override public T1 _1() { throw new UnsupportedOperationException("stub"); }
+  @Override public T2 _2() { throw new UnsupportedOperationException("stub"); }
+}

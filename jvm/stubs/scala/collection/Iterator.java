@@ -1,0 +1,8 @@
+package scala.collection;
+
+/** Compile-only stub declaring only the members the shim touches (see the
+ * org.apache.spark.SparkConf stub header). */
+public interface Iterator<A> {
+  boolean hasNext();
+  A next();
+}

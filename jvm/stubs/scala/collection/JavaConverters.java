@@ -1,0 +1,9 @@
+package scala.collection;
+
+/** Compile-only stub of the static-forwarder surface (see the
+ * org.apache.spark.SparkConf stub header). */
+public final class JavaConverters {
+  public static <A> java.util.Iterator<A> asJavaIterator(scala.collection.Iterator<A> it) {
+    throw new UnsupportedOperationException("stub");
+  }
+}

@@ -1,0 +1,4 @@
+package scala.reflect;
+
+/** Compile-only stub (see the org.apache.spark.SparkConf stub header). */
+public interface ClassTag<T> {}

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Generate the golden wire-format fixtures for the JVM shim protocol.
+
+Each fixture is the exact request frame the Java DaemonClient puts on the wire
+(jvm/src/.../DaemonClient.java header builders; frame layout
+docs/SHIM_PROTOCOL.md).  Three parties assert against these bytes:
+
+* ``jvm/src/.../FixtureCheck.java`` re-encodes every frame with the Java
+  builders and compares (run by CI after javac);
+* ``tests/test_daemon.py`` regenerates them here (drift guard) and feeds the
+  raw bytes to a live daemon (decode interop);
+* a human diffing a protocol change sees exactly which bytes moved.
+
+Java's String.format JSON headers and Python's ``json.dumps`` agree
+byte-for-byte (same key order, ", "/": " separators) — that equality is the
+drift guard's whole point.
+
+Usage: python scripts/gen_shim_fixtures.py [--check]
+"""
+
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sparkucx_tpu.core.definitions import AmId  # noqa: E402
+from sparkucx_tpu.shuffle.daemon import DaemonOp, _frame  # noqa: E402
+
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "jvm", "fixtures")
+
+# Canonical parameters — FixtureCheck.java uses the same literals.
+SHUFFLE_ID, NUM_MAPPERS, NUM_REDUCERS = 7, 4, 8
+MAP_ID, WRITER, REDUCE_ID = 2, 3, 5
+FETCH_TAG = 0x1122334455667788
+FETCH_MAPS, FETCH_REDUCES = (0, 3), (5, 5)
+WRITE_BODY = bytes(range(256))
+
+
+def fetch_frame() -> bytes:
+    body = struct.pack("<QI", FETCH_TAG, len(FETCH_MAPS))
+    for m, r in zip(FETCH_MAPS, FETCH_REDUCES):
+        body += struct.pack("<iii", SHUFFLE_ID, m, r)
+    return struct.pack("<IQQ", int(AmId.FETCH_BLOCK_REQ), 0, len(body)) + body
+
+
+def fixtures() -> dict:
+    return {
+        "01_create_shuffle.bin": _frame(
+            DaemonOp.CREATE_SHUFFLE,
+            {"shuffle_id": SHUFFLE_ID, "num_mappers": NUM_MAPPERS, "num_reducers": NUM_REDUCERS},
+        ),
+        "02_open_map_writer.bin": _frame(
+            DaemonOp.OPEN_MAP_WRITER, {"shuffle_id": SHUFFLE_ID, "map_id": MAP_ID}
+        ),
+        "03_write_partition.bin": _frame(
+            DaemonOp.WRITE_PARTITION, {"writer": WRITER, "reduce_id": REDUCE_ID}, WRITE_BODY
+        ),
+        "04_commit_map.bin": _frame(DaemonOp.COMMIT_MAP, {"writer": WRITER}),
+        "05_run_exchange.bin": _frame(DaemonOp.RUN_EXCHANGE, {"shuffle_id": SHUFFLE_ID}),
+        "06_fetch.bin": fetch_frame(),
+        "07_remove_shuffle.bin": _frame(DaemonOp.REMOVE_SHUFFLE, {"shuffle_id": SHUFFLE_ID}),
+    }
+
+
+def main() -> int:
+    check = "--check" in sys.argv
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    ok = True
+    for name, frame in fixtures().items():
+        path = os.path.join(FIXTURE_DIR, name)
+        if check:
+            with open(path, "rb") as f:
+                if f.read() != frame:
+                    print(f"DRIFT: {name}", file=sys.stderr)
+                    ok = False
+        else:
+            with open(path, "wb") as f:
+                f.write(frame)
+            print(f"wrote {path} ({len(frame)} B)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,16 +28,20 @@ import heapq
 import itertools
 import os
 import pickle
+import sys
 import tempfile
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
 
 #: rough per-record bookkeeping overhead (dict entry / list slot, pointers)
 _RECORD_OVERHEAD = 64
 
+#: max spilled runs merged in one pass; beyond this, runs are hierarchically
+#: compacted first so the merge never holds an unbounded number of open files
+#: (Spark's ExternalSorter caps fan-in the same way)
+DEFAULT_MERGE_FAN_IN = 64
+
 
 def _estimate(obj: Any) -> int:
-    import sys
-
     try:
         return sys.getsizeof(obj)
     except TypeError:  # objects with broken __sizeof__
@@ -47,7 +51,7 @@ def _estimate(obj: Any) -> int:
 class _Run:
     """One spilled sorted run: a pickle stream of (merge_key, key, value)."""
 
-    def __init__(self, items: List[Tuple[Any, Any, Any]], spill_dir: Optional[str]):
+    def __init__(self, items: Iterable[Tuple[Any, Any, Any]], spill_dir: Optional[str]):
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
         fd, self.path = tempfile.mkstemp(prefix="sparkucx_tpu_reduce_", dir=spill_dir)
@@ -91,12 +95,14 @@ class ExternalCombiner:
         memory_budget: int = 64 << 20,
         spill_dir: Optional[str] = None,
         merge_combiners: Optional[Callable[[Any, Any], Any]] = None,
+        merge_fan_in: int = DEFAULT_MERGE_FAN_IN,
     ) -> None:
         self.aggregator = aggregator
         self.merge_combiners = merge_combiners if merge_combiners is not None else aggregator
         self.key_ordering = key_ordering
         self.memory_budget = max(1, memory_budget)
         self.spill_dir = spill_dir
+        self.merge_fan_in = max(2, merge_fan_in)
         self.spill_count = 0
         self._map: dict = {}
         self._list: List[Tuple[Any, Any]] = []
@@ -109,11 +115,14 @@ class ExternalCombiner:
         if self.aggregator is not None:
             if key in self._map:
                 old = self._map[key]
+                # growing accumulators (collect-style folds) must count against
+                # the budget too, or they bypass the spill entirely; size the
+                # old accumulator BEFORE the fold — an in-place aggregator
+                # returns the same (already grown) object
+                old_size = _estimate(old)
                 new = self.aggregator(old, value)
                 self._map[key] = new
-                # growing accumulators (collect-style folds) must count against
-                # the budget too, or they bypass the spill entirely
-                self._approx += _estimate(new) - _estimate(old)
+                self._approx += _estimate(new) - old_size
             else:
                 self._map[key] = value
                 self._approx += _estimate(key) + _estimate(value) + _RECORD_OVERHEAD
@@ -158,7 +167,20 @@ class ExternalCombiner:
             return pairs
         return self._merged()
 
+    def _compact_runs(self) -> None:
+        """Hierarchically merge runs until at most ``merge_fan_in`` remain, so
+        the final merge never holds an unbounded number of open files.  Plain
+        order-preserving concatenation of sorted streams — aggregator combine
+        happens only at final iteration, so duplicates pass through intact."""
+        while len(self._runs) > self.merge_fan_in:
+            batch, self._runs = self._runs[: self.merge_fan_in], self._runs[self.merge_fan_in :]
+            merged = heapq.merge(*(iter(r) for r in batch), key=lambda t: t[0])
+            self._runs.append(_Run(merged, self.spill_dir))
+            for r in batch:
+                r.close()
+
     def _merged(self) -> Iterator[Tuple[Any, Any]]:
+        self._compact_runs()
         tail = self._memory_items()
         tail.sort(key=lambda t: t[0])
         streams = [iter(r) for r in self._runs] + [iter(tail)]

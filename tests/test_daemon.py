@@ -101,3 +101,113 @@ class TestDaemonFlow:
             payload += s.recv(hlen - len(payload))
         assert b'"ok": false' in payload
         s.close()
+
+
+class TestGoldenWireFixtures:
+    """The jvm/fixtures/*.bin frames are the EXACT bytes the Java shim's
+    DaemonClient encodes (FixtureCheck.java re-encodes them in CI).  Here the
+    Python side holds up its half of the contract: the generator reproduces
+    the committed files bit-for-bit (drift guard), and a live daemon driven by
+    the raw fixture bytes executes a full write -> exchange -> fetch cycle."""
+
+    def _gen(self):
+        import importlib
+        import os
+        import sys
+
+        scripts = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts")
+        sys.path.insert(0, scripts)
+        try:
+            mod = importlib.import_module("gen_shim_fixtures")
+            return importlib.reload(mod)
+        finally:
+            sys.path.remove(scripts)
+
+    def test_fixture_files_match_generator(self):
+        import os
+
+        gen = self._gen()
+        for name, frame in gen.fixtures().items():
+            path = os.path.join(gen.FIXTURE_DIR, name)
+            with open(path, "rb") as f:
+                assert f.read() == frame, f"fixture {name} drifted — regen + sync FixtureCheck.java"
+
+    def test_daemon_decodes_java_frames_end_to_end(self):
+        import os
+        import socket
+        import struct
+
+        from sparkucx_tpu.shuffle.daemon import _read_frame
+
+        gen = self._gen()
+        fx = {n: open(os.path.join(gen.FIXTURE_DIR, n), "rb").read() for n in gen.fixtures()}
+        d = ShuffleDaemon(
+            TpuShuffleConf(staging_capacity_per_executor=1 << 20, num_executors=1),
+            num_executors=1,
+        )
+        client = DaemonClient(d.address)  # side channel for the non-fixture maps
+        raw = socket.create_connection(d.address)
+
+        def send_fixture(name, expect_ok=True):
+            raw.sendall(fx[name])
+            frame = _read_frame(raw)
+            assert frame is not None
+            op, meta, body = frame
+            if expect_ok:
+                assert meta.get("ok") is True, f"{name}: {meta}"
+            return meta, body
+
+        try:
+            send_fixture("01_create_shuffle.bin")  # shuffle 7: 4 maps x 8 reduces
+
+            # burn writer handles 0-2 so the fixture writer lands on handle 3
+            # (the handle baked into 03/04), and give the fetch fixture's maps
+            # (0 and 3) real payloads
+            burn = [client.open_map_writer(gen.SHUFFLE_ID, m) for m in (0, 1, 3)]
+            assert burn == [0, 1, 2]
+            payload_m0 = b"\xaa" * 100
+            payload_m3 = b"\xbb" * 300
+            client.write_partition(burn[0], gen.REDUCE_ID, payload_m0)
+            client.write_partition(burn[2], gen.REDUCE_ID, payload_m3)
+
+            meta, _ = send_fixture("02_open_map_writer.bin")  # map 2 -> handle 3
+            assert meta["writer"] == gen.WRITER
+
+            send_fixture("03_write_partition.bin")  # 256 bytes to reduce 5
+            _, commit_body = send_fixture("04_commit_map.bin")
+            lengths = np.frombuffer(commit_body, dtype="<i8")
+            assert lengths[gen.REDUCE_ID] == len(gen.WRITE_BODY)
+
+            for w in burn:
+                client.commit_map(w)
+
+            send_fixture("05_run_exchange.bin")
+
+            # batched fetch exactly as the Java client frames it
+            raw.sendall(fx["06_fetch.bin"])
+            hdr = b""
+            while len(hdr) < 20:
+                hdr += raw.recv(20 - len(hdr))
+            _, hlen, blen = struct.unpack("<IQQ", hdr)
+            reply_hdr = b""
+            while len(reply_hdr) < hlen:
+                reply_hdr += raw.recv(hlen - len(reply_hdr))
+            body = b""
+            while len(body) < blen:
+                body += raw.recv(blen - len(body))
+            tag, count = struct.unpack_from("<QI", reply_hdr)
+            assert tag == gen.FETCH_TAG and count == len(gen.FETCH_MAPS)
+            sizes = [
+                struct.unpack_from("<q", reply_hdr, 12 + 8 * i)[0] for i in range(count)
+            ]
+            assert sizes == [len(payload_m0), len(payload_m3)]
+            assert body[: sizes[0]] == payload_m0
+            assert body[sizes[0] :] == payload_m3
+
+            send_fixture("07_remove_shuffle.bin")
+            with pytest.raises(RuntimeError):
+                client.stats(gen.SHUFFLE_ID)
+        finally:
+            raw.close()
+            client.close()
+            d.close()

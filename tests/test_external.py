@@ -128,6 +128,40 @@ class TestSpillingPaths:
         assert c.spill_count > 0
         c.close()
 
+    def test_in_place_aggregator_counts_growth(self, tmp_path):
+        # mergeValue-style aggregator mutating and returning the SAME object:
+        # sizing the old accumulator after the fold would see zero growth and
+        # never spill (review regression)
+        def agg(acc, v):
+            if not isinstance(acc, list):
+                acc = [acc]
+            acc.append(v)
+            return acc
+
+        c = ExternalCombiner(
+            aggregator=agg, merge_combiners=lambda a, b: a + b,
+            memory_budget=16 << 10, spill_dir=str(tmp_path),
+        )
+        c.insert_all([(0, i) for i in range(50_000)])
+        assert c.spill_count > 0, "in-place accumulator growth bypassed the budget"
+        c.close()
+
+    def test_merge_fan_in_capped(self, tmp_path):
+        import os
+
+        agg = lambda a, b: a + b
+        c = ExternalCombiner(
+            aggregator=agg, memory_budget=1, spill_dir=str(tmp_path), merge_fan_in=4
+        )
+        records = [(i % 100, 1) for i in range(300)]  # budget 1 B: spill per insert
+        c.insert_all(records)
+        assert c.spill_count > 20
+        out = dict(c)
+        assert len(c._runs) <= 4, "hierarchical compaction did not cap fan-in"
+        assert out == oracle_aggregate(records, agg)
+        c.close()
+        assert os.listdir(str(tmp_path)) == []
+
     def test_spill_dir_created_on_demand(self, tmp_path):
         missing = tmp_path / "not" / "yet" / "there"
         c = ExternalCombiner(
