@@ -146,8 +146,7 @@ class TpuShuffleManager:
         for resolver in self.resolvers:
             resolver.remove_shuffle(shuffle_id)
         # cluster-level metadata (store shuffles were removed via resolvers)
-        with self.cluster._lock:
-            self.cluster._meta.pop(shuffle_id, None)
+        self.cluster.drop_meta(shuffle_id)
 
     def stop(self) -> None:
         """stop() closes transports/resolvers (CommonUcxShuffleManager.scala:111-124)."""

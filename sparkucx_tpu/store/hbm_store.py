@@ -492,6 +492,35 @@ class HbmBlockStore:
         st = self._state(shuffle_id)
         return st.region_size // st.alignment
 
+    def region_bytes(self, shuffle_id: int) -> int:
+        """Per-peer region size in bytes — public form of the staging geometry
+        the transports need for offset math (was reached via ``_state``)."""
+        return self._state(shuffle_id).region_size
+
+    def committed_map_ids(self, shuffle_id: int) -> frozenset:
+        """Snapshot of map ids with a successful commit (getPartitonOffset-table
+        coverage, NvkvHandler.scala:258-265)."""
+        st = self._state(shuffle_id)
+        with self._lock:
+            return frozenset(st.committed_maps)
+
+    def mapper_info(self, shuffle_id: int, map_id: int) -> MapperInfo:
+        """Reconstruct a committed map's MapperInfo from the offset table —
+        what a peer's AM id 2 blob would carry (used by the SPMD executor when
+        the commit landed in the store before the info arrived)."""
+        st = self._state(shuffle_id)
+        with self._lock:
+            if map_id not in st.committed_maps:
+                raise TransportError(f"map {map_id} not committed in shuffle {shuffle_id}")
+            parts, rounds = [], []
+            for r in range(st.num_reducers):
+                e = st.blocks.get((map_id, r))
+                parts.append((e.offset, e.length) if e is not None else (0, 0))
+                rounds.append(e.round if e is not None else 0)
+        return MapperInfo(
+            shuffle_id, map_id, tuple(parts), tuple(rounds) if any(rounds) else None
+        )
+
     # -- read path (serve staged blocks) ----------------------------------
 
     def read_block(self, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:

@@ -123,21 +123,10 @@ class SpmdShuffleExecutor:
         infos = self._mapper_infos[shuffle_id]
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            st = self.store._state(shuffle_id)
-            with self.store._lock:
-                committed = set(st.committed_maps)
-            for m in committed:
+            for m in self.store.committed_map_ids(shuffle_id):
                 if m not in infos:
                     # peer commit landed in the store table; reconstruct info
-                    parts, rounds = [], []
-                    _, num_reducers, _ = self._meta[shuffle_id]
-                    for r in range(num_reducers):
-                        e = st.blocks.get((m, r))
-                        parts.append((e.offset, e.length) if e is not None else (0, 0))
-                        rounds.append(e.round if e is not None else 0)
-                    infos[m] = MapperInfo(
-                        shuffle_id, m, tuple(parts), tuple(rounds) if any(rounds) else None
-                    )
+                    infos[m] = self.store.mapper_info(shuffle_id, m)
             if len(infos) >= num_mappers:
                 return
             time.sleep(0.005)
@@ -248,7 +237,7 @@ class SpmdShuffleExecutor:
             return b""
         rnd = info.round_of(reduce_id)
         sender = self.map_owner(map_id)
-        region_bytes = self.store._state(shuffle_id).region_size
+        region_bytes = self.store.region_bytes(shuffle_id)
         region_rel = abs_offset - self.executor_id * region_bytes
         shards, sizes_rows = self._recv[shuffle_id]
         chunk_start = int(sizes_rows[rnd][:sender].sum()) * self.conf.block_alignment

@@ -149,6 +149,13 @@ class TpuShuffleCluster:
         for t in self.transports:
             t.store.remove_shuffle(shuffle_id)
 
+    def drop_meta(self, shuffle_id: int) -> None:
+        """Forget cluster-level metadata only — for callers whose resolvers
+        already removed the per-store state (the unregisterShuffle split,
+        CommonUcxShuffleManager.scala:103-106)."""
+        with self._lock:
+            self._meta.pop(shuffle_id, None)
+
     def commit_mapper(self, info: MapperInfo) -> None:
         """AM id 2 sink — the cluster is the 'daemon' holding the commit table."""
         meta = self.meta(info.shuffle_id)
@@ -303,7 +310,7 @@ class TpuShuffleCluster:
         rnd = info.round_of(reduce_id)
         sender = meta.map_owner[map_id]
         sender_store = self.transports[sender].store
-        region_bytes = sender_store._state(meta.shuffle_id).region_size
+        region_bytes = sender_store.region_bytes(meta.shuffle_id)
         region_rel = abs_offset - consumer * region_bytes
         if not (0 <= region_rel < region_bytes):
             raise TransportError(
