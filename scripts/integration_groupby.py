@@ -45,13 +45,12 @@ R, PAIRS = int(sys.argv[4]), int(sys.argv[5])
 client = DaemonClient((host, port))
 for m in map_ids:
     rng = np.random.default_rng(1000 + m)  # deterministic per map (oracle twin)
-    records = [(int(rng.integers(0, 100)), 1) for _ in range(PAIRS)]
-    by_part = {{}}
-    for k, v in records:
-        by_part.setdefault(k % R, []).append((k, v))
+    keys = rng.integers(0, 100, size=PAIRS)
+    parts = keys % R
     w = client.open_map_writer({sid}, m)
-    for r in sorted(by_part):
-        client.write_partition(w, r, serialize_records(by_part[r]))
+    for r in np.unique(parts):
+        client.write_partition(
+            w, int(r), serialize_records((int(k), 1) for k in keys[parts == r]))
     client.commit_map(w)
 client.close()
 print("mapper done", map_ids)
@@ -84,16 +83,15 @@ print("REDUCER_RESULT " + json.dumps(counts))
 def oracle():
     import numpy as np
 
-    counts = {}
+    total = np.zeros(100, dtype=np.int64)
     for m in range(MAPPERS):
         rng = np.random.default_rng(1000 + m)
-        for _ in range(PAIRS):
-            k = int(rng.integers(0, 100))
-            counts[k] = counts.get(k, 0) + 1
-    return counts
+        total += np.bincount(rng.integers(0, 100, size=PAIRS), minlength=100)
+    return {k: int(v) for k, v in enumerate(total) if v}
 
 
 def main() -> int:
+    t0 = time.monotonic()
     env = dict(os.environ)
     daemon = subprocess.Popen(
         [sys.executable, "-m", "sparkucx_tpu.shuffle.daemon", "--port", "0",
@@ -168,7 +166,8 @@ def main() -> int:
             return 1
         total = sum(got.values())
         print(f"[integration] PASS: {MAPPERS} maps x {PAIRS} pairs -> "
-              f"{len(got)} keys, {total} records, {EXECUTORS} executor processes")
+              f"{len(got)} keys, {total} records, {EXECUTORS} executor processes, "
+              f"{time.monotonic() - t0:.1f}s wall")
         ctl.remove_shuffle(SHUFFLE_ID)
         ctl.shutdown()
         return 0

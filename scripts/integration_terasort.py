@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""TeraSort-style integration driver — the BASELINE.json TeraSort shape at
+real scale (>=1M rows) on the daemon + separate OS-process topology.
+
+Map side: each map task generates ROWS/MAPPERS random uint32 keys with a
+payload (val = key ^ MIX, the integrity twin), range-partitions them over the
+REDUCERS output ranges (partition = key * R >> 32, the TeraSort sampler's
+equal-width analogue), and writes each partition block over the daemon wire
+protocol.  Reduce side: each reducer fetches its partition's blocks from all
+maps, sorts, and runs the TeraValidate checks: every key inside the
+partition's range, payload integrity, and reports (count, min, max, checksum).
+The driver verifies record preservation (count + checksum vs a regenerated
+oracle) and cross-partition boundary ordering max(r) <= min(r+1).
+
+Reference gate analogue: buildlib/test.sh:169-173 (the big workload);
+BASELINE.json configs[1] (TeraSort, 4-executor single host).
+Knobs via env: EXECUTORS, MAPPERS, REDUCERS, ROWS.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXECUTORS = int(os.environ.get("EXECUTORS", "4"))
+MAPPERS = int(os.environ.get("MAPPERS", "8"))
+REDUCERS = int(os.environ.get("REDUCERS", "16"))
+ROWS = int(os.environ.get("ROWS", "1000000"))
+ROWS_PER_MAP = -(-ROWS // MAPPERS)
+SHUFFLE_ID = 7
+MIX = 0x9E3779B9  # payload = key ^ MIX; reducers verify the twin survived the wire
+
+MAPPER_SCRIPT = """
+import sys
+sys.path.insert(0, {root!r})
+import numpy as np
+from sparkucx_tpu.shuffle.daemon import DaemonClient
+
+host, port, map_ids = sys.argv[1], int(sys.argv[2]), [int(x) for x in sys.argv[3].split(",")]
+R, N = int(sys.argv[4]), int(sys.argv[5])
+client = DaemonClient((host, port))
+for m in map_ids:
+    rng = np.random.default_rng(7000 + m)  # deterministic per map (oracle twin)
+    keys = rng.integers(0, 2**32, size=N, dtype=np.uint64).astype(np.uint32)
+    vals = keys ^ np.uint32({mix})
+    parts = ((keys.astype(np.uint64) * R) >> 32).astype(np.int64)
+    w = client.open_map_writer({sid}, m)
+    for r in np.unique(parts):
+        sel = parts == r
+        block = np.stack([keys[sel], vals[sel]], axis=1)  # (n, 2) uint32 rows
+        client.write_partition(w, int(r), block.tobytes())
+    client.commit_map(w)
+client.close()
+print("mapper done", map_ids)
+"""
+
+REDUCER_SCRIPT = """
+import json, sys
+sys.path.insert(0, {root!r})
+import numpy as np
+from sparkucx_tpu.core.block import ShuffleBlockId
+from sparkucx_tpu.shuffle.daemon import DaemonClient
+
+host, port = sys.argv[1], int(sys.argv[2])
+partitions = [int(x) for x in sys.argv[3].split(",")]
+M, R = int(sys.argv[4]), int(sys.argv[5])
+client = DaemonClient((host, port))
+out = {{}}
+for r in partitions:
+    blocks = client.fetch_blocks([ShuffleBlockId({sid}, m, r) for m in range(M)])
+    rows = [np.frombuffer(b, dtype=np.uint32).reshape(-1, 2) for b in blocks if b]
+    data = np.concatenate(rows) if rows else np.empty((0, 2), dtype=np.uint32)
+    keys, vals = data[:, 0], data[:, 1]
+    # TeraValidate: range membership + payload integrity, then sort
+    lo = (r << 32) // R
+    hi = ((r + 1) << 32) // R
+    k64 = keys.astype(np.uint64)
+    assert bool(np.all((k64 * R) >> 32 == r)), f"partition {{r}}: key outside range"
+    assert bool(np.all(k64 >= lo)) and bool(np.all(k64 < hi))
+    assert bool(np.all(vals == (keys ^ np.uint32({mix})))), f"partition {{r}}: payload corrupt"
+    order = np.argsort(keys, kind="stable")
+    skeys = keys[order]
+    assert bool(np.all(skeys[1:] >= skeys[:-1]))
+    out[r] = dict(
+        count=int(keys.size),
+        lo=int(skeys[0]) if keys.size else None,
+        hi=int(skeys[-1]) if keys.size else None,
+        checksum=int(k64.sum()),
+    )
+client.close()
+print("REDUCER_RESULT " + json.dumps(out))
+"""
+
+
+def oracle():
+    """Per-partition (count, checksum) from a regenerated key stream."""
+    import numpy as np
+
+    counts = [0] * REDUCERS
+    checks = [0] * REDUCERS
+    for m in range(MAPPERS):
+        rng = np.random.default_rng(7000 + m)
+        keys = rng.integers(0, 2**32, size=ROWS_PER_MAP, dtype=np.uint64).astype(np.uint32)
+        parts = ((keys.astype(np.uint64) * REDUCERS) >> 32).astype(np.int64)
+        for r in range(REDUCERS):
+            sel = parts == r
+            counts[r] += int(sel.sum())
+            checks[r] += int(keys[sel].astype(np.uint64).sum())
+    return counts, checks
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    env = dict(os.environ)
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "sparkucx_tpu.shuffle.daemon", "--port", "0",
+         "--executors", str(EXECUTORS)],
+        stdout=subprocess.PIPE, text=True, cwd=ROOT, env=env,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        host = port = None
+        while time.monotonic() < deadline:
+            line = daemon.stdout.readline().strip()
+            if "shuffle daemon on " in line:
+                host, port = line.rsplit(" ", 1)[-1].split(":")
+                break
+        if host is None:
+            print("[terasort] FAIL: daemon did not report its address")
+            return 1
+        print(f"[terasort] daemon on {host}:{port}")
+
+        from sparkucx_tpu.shuffle.daemon import DaemonClient
+
+        ctl = DaemonClient((host, int(port)))
+        ctl.create_shuffle(SHUFFLE_ID, MAPPERS, REDUCERS)
+
+        mappers = []
+        for e in range(EXECUTORS):
+            mine = [str(m) for m in range(MAPPERS) if m % EXECUTORS == e]
+            if not mine:
+                continue
+            script = MAPPER_SCRIPT.format(root=ROOT, sid=SHUFFLE_ID, mix=MIX)
+            mappers.append(subprocess.Popen(
+                [sys.executable, "-c", script, host, port, ",".join(mine),
+                 str(REDUCERS), str(ROWS_PER_MAP)],
+                cwd=ROOT, env=env,
+            ))
+        for p in mappers:
+            if p.wait(timeout=600) != 0:
+                print("[terasort] FAIL: mapper exited nonzero")
+                return 1
+
+        ctl.run_exchange(SHUFFLE_ID)
+        print("[terasort] exchange complete")
+
+        per = -(-REDUCERS // EXECUTORS)
+        reducers = []
+        for e in range(EXECUTORS):
+            mine = [str(r) for r in range(e * per, min((e + 1) * per, REDUCERS))]
+            if not mine:
+                continue
+            script = REDUCER_SCRIPT.format(root=ROOT, sid=SHUFFLE_ID, mix=MIX)
+            reducers.append(subprocess.Popen(
+                [sys.executable, "-c", script, host, port, ",".join(mine),
+                 str(MAPPERS), str(REDUCERS)],
+                stdout=subprocess.PIPE, text=True, cwd=ROOT, env=env,
+            ))
+        got = {}
+        for p in reducers:
+            out, _ = p.communicate(timeout=600)
+            if p.returncode != 0:
+                print("[terasort] FAIL: reducer exited nonzero")
+                return 1
+            for line in out.splitlines():
+                if line.startswith("REDUCER_RESULT "):
+                    for r, rec in json.loads(line[len("REDUCER_RESULT "):]).items():
+                        got[int(r)] = rec
+
+        counts, checks = oracle()
+        total = 0
+        prev_hi = -1
+        for r in range(REDUCERS):
+            rec = got.get(r)
+            if rec is None:
+                print(f"[terasort] FAIL: no result for partition {r}")
+                return 1
+            if rec["count"] != counts[r] or rec["checksum"] != checks[r]:
+                print(f"[terasort] FAIL: partition {r} count/checksum mismatch "
+                      f"({rec['count']} vs {counts[r]})")
+                return 1
+            if rec["count"]:
+                if rec["lo"] <= prev_hi:
+                    print(f"[terasort] FAIL: boundary disorder at partition {r}")
+                    return 1
+                prev_hi = rec["hi"]
+            total += rec["count"]
+        if total != ROWS_PER_MAP * MAPPERS:
+            print(f"[terasort] FAIL: row loss ({total} vs {ROWS_PER_MAP * MAPPERS})")
+            return 1
+        print(f"[terasort] PASS: {total} rows sorted across {REDUCERS} ranges, "
+              f"{MAPPERS} maps, {EXECUTORS} executor processes, "
+              f"{time.monotonic() - t0:.1f}s wall")
+        ctl.remove_shuffle(SHUFFLE_ID)
+        ctl.shutdown()
+        return 0
+    finally:
+        daemon.terminate()
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

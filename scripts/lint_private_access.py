@@ -15,8 +15,15 @@ import sys
 
 ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "sparkucx_tpu")
 
-#: reviewed exceptions: (file suffix, attribute or imported name)
-ALLOWLIST = set()
+#: reviewed exceptions: (file suffix, attribute or imported name).
+#: hbm_store.py: MapWriter is a friend class defined in the SAME file as
+#: HbmBlockStore — allocation and epoch rollover must happen under the store's
+#: one lock, and exposing that lock publicly would invite misuse from outside
+#: the file.  Reviewed round 3; keep this list to same-file friends only.
+ALLOWLIST = {
+    ("store/hbm_store.py", "._lock"),
+    ("store/hbm_store.py", "._rollover"),
+}
 
 
 def check_file(path: str) -> list:

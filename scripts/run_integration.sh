@@ -4,9 +4,12 @@
 # The reference boots a standalone Spark cluster and runs GroupByTest twice
 # (small + big) plus SparkTC as the gate (test.sh:163-196).  Here the same
 # gate shape runs against this framework's real process topology: a shuffle
-# daemon + separate mapper/reducer processes over the wire protocol.
+# daemon + separate mapper/reducer processes over the wire protocol — plus
+# the BASELINE.json configs[0] 1M-row GroupByTest and a 1M-row TeraSort at
+# stated scale, the private-access layering lint, and (when a JDK is on the
+# PATH) the JVM shim compile + fixture + interop checks from ci.yml.
 #
-# Env knobs (test.sh style): EXECUTORS, MAPPERS, REDUCERS, PAIRS_PER_MAP.
+# Env knobs (test.sh style): EXECUTORS, MAPPERS, REDUCERS, PAIRS_PER_MAP, ROWS.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,6 +17,10 @@ cd "$(dirname "$0")/.."
 # (set SPARKUCX_INTEG_PLATFORM to run against real hardware).
 export JAX_PLATFORMS="${SPARKUCX_INTEG_PLATFORM:-cpu}"
 export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+run_lint() {          # layering gate (VERDICT r2 item 2)
+  python scripts/lint_private_access.py
+}
 
 run_groupby_test() {  # test.sh:163-167 (GroupByTest 100 100)
   EXECUTORS=2 MAPPERS=4 REDUCERS=8 PAIRS_PER_MAP=5000 \
@@ -25,14 +32,63 @@ run_big_test() {      # test.sh:169-173 (GroupByTest 200 5000 ...)
     python scripts/integration_groupby.py
 }
 
+run_baseline_test() { # BASELINE.json configs[0]: 1M-row GroupByTest
+  EXECUTORS=4 MAPPERS=16 REDUCERS=32 PAIRS_PER_MAP=62500 \
+    python scripts/integration_groupby.py
+}
+
+run_terasort_test() { # BASELINE.json configs[1] shape at 1M rows
+  EXECUTORS=4 MAPPERS=8 REDUCERS=16 ROWS=1000000 \
+    python scripts/integration_terasort.py
+}
+
 run_tc_test() {       # test.sh:175-179 (SparkTC; gate at :196)
   EXECUTORS=4 VERTICES=100 EDGES=200 python scripts/integration_tc.py
 }
 
+run_jvm_shim_check() { # ci.yml jvm-shim job, runnable anywhere a JDK exists
+  if ! command -v javac >/dev/null 2>&1; then
+    echo "JVM SHIM CHECK: SKIPPED (no javac on PATH — compile + FixtureCheck"
+    echo "  + InteropCheck need a JDK; see .github/workflows/ci.yml jvm-shim)"
+    return 0
+  fi
+  echo "-- jvm shim: compile against vendored SPI stubs"
+  rm -rf jvm/target
+  mkdir -p jvm/target/classes jvm/target/stub-classes
+  javac -d jvm/target/stub-classes $(find jvm/stubs -name '*.java')
+  javac -cp jvm/target/stub-classes -d jvm/target/classes \
+    $(find jvm/src -name '*.java')
+  echo "-- jvm shim: golden wire fixtures (Java side)"
+  java -cp jvm/target/classes:jvm/target/stub-classes \
+    org.apache.spark.shuffle.tpu.FixtureCheck jvm/fixtures
+  echo "-- jvm shim: fixture generator drift (Python side)"
+  python scripts/gen_shim_fixtures.py --check
+  echo "-- jvm shim: live Java<->Python interop cycle"
+  python -m sparkucx_tpu.shuffle.daemon --port 13438 &
+  local daemon_pid=$!
+  trap "kill $daemon_pid 2>/dev/null || true" RETURN
+  for _ in $(seq 1 50); do
+    python -c "import socket; socket.create_connection(('127.0.0.1', 13438), 1)" \
+      2>/dev/null && break
+    sleep 0.2
+  done
+  java -cp jvm/target/classes:jvm/target/stub-classes \
+    org.apache.spark.shuffle.tpu.InteropCheck 127.0.0.1 13438
+  kill $daemon_pid 2>/dev/null || true
+}
+
+echo "== private-access lint =="
+run_lint
 echo "== groupby test =="
 run_groupby_test
 echo "== big test =="
 run_big_test
+echo "== baseline test (1M records) =="
+run_baseline_test
+echo "== terasort test (1M rows) =="
+run_terasort_test
 echo "== tc test =="
 run_tc_test
+echo "== jvm shim check =="
+run_jvm_shim_check
 echo "ALL INTEGRATION TESTS PASSED"

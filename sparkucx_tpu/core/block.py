@@ -92,6 +92,9 @@ class MemoryBlock:
     data: object  # np.ndarray[uint8] | jax.Array | memoryview
     size: int
     is_host_memory: bool = True
+    #: opaque owning-allocator bookkeeping slot (e.g. the backing slab) —
+    #: reserved for the pool that created this block; never interpreted here
+    allocator_token: Optional[object] = field(default=None, repr=False)
     _on_close: Optional[callable] = field(default=None, repr=False)
     _closed: bool = field(default=False, repr=False)
 
@@ -112,6 +115,12 @@ class MemoryBlock:
         self._closed = True
         if self._on_close is not None:
             self._on_close(self)
+
+    def rearm(self) -> None:
+        """Allocator checkout hook: make ``close()`` live again after a pooled
+        block is handed back out.  Blocks parked in a free list stay closed so a
+        stale holder's second ``close()`` is a no-op, not a double-free."""
+        self._closed = False
 
 
 class TransportMemoryError(RuntimeError):

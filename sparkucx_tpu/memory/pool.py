@@ -117,7 +117,7 @@ class AllocatorStack:
                 self._free.append(mb)
 
         mb = MemoryBlock(data=view, size=self.size, is_host_memory=True, _on_close=recycle)
-        mb._slab = slab
+        mb.allocator_token = slab
         return mb
 
     def _allocate_more(self) -> None:
@@ -139,9 +139,10 @@ class AllocatorStack:
             if not self._free:
                 self._allocate_more()
             mb = self._free.pop()
-            with mb._slab.lock:
-                mb._slab.refcount += 1
-            mb._closed = False
+            slab = mb.allocator_token
+            with slab.lock:
+                slab.refcount += 1
+            mb.rearm()
         return mb
 
     def preallocate(self, count: int) -> None:

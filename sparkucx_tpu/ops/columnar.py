@@ -86,7 +86,7 @@ def _sort_and_sizes(spec: ColumnarSpec, rows: jnp.ndarray, owners: jnp.ndarray):
     return sorted_rows, sorted_owners, send_sizes, recv_sizes, output_offsets
 
 
-def _columnar_shard_ragged(spec: ColumnarSpec, payload, send_sizes, recv_sizes, output_offsets):
+def columnar_shard_ragged(spec: ColumnarSpec, payload, send_sizes, recv_sizes, output_offsets):
     input_offsets = exclusive_cumsum(send_sizes)
     out = jnp.zeros((spec.recv_capacity, payload.shape[1]), dtype=payload.dtype)
     out = jax.lax.ragged_all_to_all(
@@ -101,7 +101,7 @@ def _columnar_shard_ragged(spec: ColumnarSpec, payload, send_sizes, recv_sizes, 
     return out, recv_sizes
 
 
-def _columnar_shard_dense(spec: ColumnarSpec, payload, send_sizes, recv_sizes, output_offsets):
+def columnar_shard_dense(spec: ColumnarSpec, payload, send_sizes, recv_sizes, output_offsets):
     """Portable lowering: scatter sorted rows into fixed slots, tiled
     all_to_all, then compaction — same receive layout as the ragged path."""
     n = spec.num_executors
@@ -130,10 +130,10 @@ def _columnar_shard_dense(spec: ColumnarSpec, payload, send_sizes, recv_sizes, o
     return out, recv_sizes
 
 
-def _columnar_body(spec: ColumnarSpec, rows, owners):
+def columnar_body(spec: ColumnarSpec, rows, owners):
     """Shared body: sort once, then exchange the sorted payload."""
     sorted_rows, _, send_sizes, recv_sizes, output_offsets = _sort_and_sizes(spec, rows, owners)
-    body = _columnar_shard_ragged if spec.impl == "ragged" else _columnar_shard_dense
+    body = columnar_shard_ragged if spec.impl == "ragged" else columnar_shard_dense
     out, recv_sizes = body(spec, sorted_rows, send_sizes, recv_sizes, output_offsets)
     return out, recv_sizes[None, :]
 
@@ -158,7 +158,7 @@ def build_columnar_shuffle(mesh: Mesh, spec: ColumnarSpec):
     ax = spec.axis_name
 
     shard = jax.shard_map(
-        functools.partial(_columnar_body, spec),
+        functools.partial(columnar_body, spec),
         mesh=mesh,
         in_specs=(P(ax, None), P(ax)),
         out_specs=(P(ax, None), P(ax, None)),

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkucx_tpu.ops.columnar import ColumnarSpec, _columnar_body
+from sparkucx_tpu.ops.columnar import ColumnarSpec, columnar_body
 from sparkucx_tpu.ops.exchange import exclusive_cumsum
 
 #: Padding sort key (sorts last) — ops/sort.py's sentinel, same discipline:
@@ -59,12 +59,12 @@ def hash_owners(keys: jnp.ndarray, num_executors: int, valid: jnp.ndarray) -> jn
     return jnp.where(valid, owner, num_executors)
 
 
-def _padded_keys(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+def padded_keys(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """Force padding rows to the KEY_MAX sentinel so they sort last."""
     return jnp.where(valid, keys.astype(jnp.uint32), KEY_MAX)
 
 
-def _exchange_keyed_rows(spec: ColumnarSpec, keys, values, valid):
+def exchange_keyed_rows(spec: ColumnarSpec, keys, values, valid):
     """Hash-partition (key | values) rows through one columnar exchange.
 
     Returns (recv_keys uint32, recv_values, recv_valid, recv_total) with the
@@ -77,7 +77,7 @@ def _exchange_keyed_rows(spec: ColumnarSpec, keys, values, valid):
         axis=1,
     )
     owners = hash_owners(keys, spec.num_executors, valid)
-    recv, recv_sizes = _columnar_body(spec, rows, owners)
+    recv, recv_sizes = columnar_body(spec, rows, owners)
     total = recv_sizes.sum().astype(jnp.int32)
     ridx = jnp.arange(spec.recv_capacity, dtype=jnp.int32)
     recv_valid = ridx < total
@@ -150,11 +150,11 @@ def _aggregate_body(spec: AggregateSpec, keys, values, num_valid):
         axis_name=spec.axis_name,
         impl=spec.impl,
     )
-    rkeys, rvals, rvalid, rtotal = _exchange_keyed_rows(cspec, keys, values, valid)
+    rkeys, rvals, rvalid, rtotal = exchange_keyed_rows(cspec, keys, values, valid)
 
     # Local GROUP BY: stable sort with padding forced to KEY_MAX (valid
     # sentinel-keyed rows stay ahead of padding within the tie), segment-reduce.
-    order = jnp.argsort(_padded_keys(rkeys, rvalid), stable=True)
+    order = jnp.argsort(padded_keys(rkeys, rvalid), stable=True)
     skeys = rkeys[order]
     svals = rvals[order]
     svalid = rvalid[order]
@@ -246,7 +246,7 @@ def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
     return fn
 
 
-def _expand_matches(
+def expand_matches(
     out_capacity: int,
     sbk: jnp.ndarray,
     btotal: jnp.ndarray,
@@ -341,11 +341,11 @@ def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum):
     pvalid = jnp.arange(spec.probe_capacity, dtype=jnp.int32) < pnum[0]
 
     # Hash-partition both sides: equal keys co-locate.
-    rbk, rbv, rbvalid, rbtotal = _exchange_keyed_rows(
+    rbk, rbv, rbvalid, rbtotal = exchange_keyed_rows(
         cspec(spec.build_capacity, spec.build_recv_capacity, spec.build_width),
         bkeys, bvals, bvalid,
     )
-    rpk, rpv, rpvalid, rptotal = _exchange_keyed_rows(
+    rpk, rpv, rpvalid, rptotal = exchange_keyed_rows(
         cspec(spec.probe_capacity, spec.probe_recv_capacity, spec.probe_width),
         pkeys, pvals, pvalid,
     )
@@ -353,13 +353,13 @@ def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum):
     # Sort the build side; padding rows (forced KEY_MAX, stable) occupy exactly
     # the tail [btotal, cap), even when valid rows carry the sentinel key.
     btotal = rbvalid.sum().astype(jnp.int32)
-    border = jnp.argsort(_padded_keys(rbk, rbvalid), stable=True)
-    sbk = _padded_keys(rbk, rbvalid)[border]
+    border = jnp.argsort(padded_keys(rbk, rbvalid), stable=True)
+    sbk = padded_keys(rbk, rbvalid)[border]
     sbv = rbv[border]
 
     # Match range per probe row (hi clamped at btotal so a KEY_MAX probe key
     # never matches build padding), expanded into the static output.
-    j, li, ok, total = _expand_matches(
+    j, li, ok, total = expand_matches(
         spec.out_capacity, sbk, btotal, rpk, rpvalid,
         spec.probe_recv_capacity, spec.build_recv_capacity,
     )

@@ -41,8 +41,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkucx_tpu.ops.columnar import (
     ColumnarSpec,
-    _columnar_shard_dense,
-    _columnar_shard_ragged,
+    columnar_shard_dense,
+    columnar_shard_ragged,
     size_matrix_from_owners,
 )
 
@@ -151,7 +151,7 @@ def _sort_body(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, num_vali
         axis_name=spec.axis_name,
         impl=spec.impl,
     )
-    xchg = _columnar_shard_ragged if spec.impl == "ragged" else _columnar_shard_dense
+    xchg = columnar_shard_ragged if spec.impl == "ragged" else columnar_shard_dense
     recv, recv_sizes = xchg(cspec, rows, send_sizes, recv_sizes, output_offsets)
 
     # 4. Final local sort of the received range.

@@ -34,7 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkucx_tpu.ops.columnar import ColumnarSpec
-from sparkucx_tpu.ops.relational import _exchange_keyed_rows, _expand_matches, _padded_keys
+from sparkucx_tpu.ops.relational import exchange_keyed_rows, expand_matches, padded_keys
 from sparkucx_tpu.ops.sort import KEY_MAX
 
 _MIX_A = np.uint32(2654435761)  # Knuth multiplicative
@@ -93,7 +93,7 @@ def _lex_dedup(a: jnp.ndarray, b: jnp.ndarray, valid: jnp.ndarray, out_rows: int
     """Sort pairs lexicographically ((a, b), padding last) and keep one of each
     — the device DISTINCT.  Returns (a', b', count) with the distinct pairs as
     a tight ascending prefix."""
-    a = _padded_keys(a, valid)
+    a = padded_keys(a, valid)
     b = jnp.where(valid, b.astype(jnp.uint32), KEY_MAX)
     # two-pass stable sort = lexicographic (b minor, a major)
     order_b = jnp.argsort(b, stable=True)
@@ -127,12 +127,12 @@ def _tc_prep_body(spec: TcSpec, e_src, e_dst, e_num):
     and sort it — every iterated round reuses the result instead of repeating
     the exchange + sort (the edges never change)."""
     e_valid = jnp.arange(spec.edge_capacity, dtype=jnp.int32) < e_num[0]
-    rek, rev, revalid, re_total = _exchange_keyed_rows(
+    rek, rev, revalid, re_total = exchange_keyed_rows(
         _cspec(spec, spec.edge_capacity, spec.edge_recv, 1), e_src, _as_val(e_dst), e_valid
     )
     btotal = revalid.sum().astype(jnp.int32)
-    border = jnp.argsort(_padded_keys(rek, revalid), stable=True)
-    sbk = _padded_keys(rek, revalid)[border]
+    border = jnp.argsort(padded_keys(rek, revalid), stable=True)
+    sbk = padded_keys(rek, revalid)[border]
     sbc = jax.lax.bitcast_convert_type(rev[border][:, 0], jnp.uint32)
     return sbk, sbc, btotal[None], re_total[None]
 
@@ -141,13 +141,13 @@ def _tc_step_body(spec: TcSpec, tc_a, tc_b, tc_num, sbk, sbc, btotal):
     tc_valid = jnp.arange(spec.tc_capacity, dtype=jnp.int32) < tc_num[0]
 
     # 1. co-locate paths a->b (keyed by b) with the pre-sorted edges b->c
-    rtk, rtv, rtvalid, rt_total = _exchange_keyed_rows(
+    rtk, rtv, rtvalid, rt_total = exchange_keyed_rows(
         _cspec(spec, spec.tc_capacity, spec.tc_recv, 1), tc_b, _as_val(tc_a), tc_valid
     )
 
     # 2. sort-merge expansion (shared with the hash join): probe = tc rows,
     #    build = edges; each match emits the new path (a, c)
-    j, li, new_ok, new_total = _expand_matches(
+    j, li, new_ok, new_total = expand_matches(
         spec.join_capacity, sbk, btotal[0], rtk, rtvalid, spec.tc_recv, spec.edge_recv
     )
     new_a = jnp.where(
@@ -160,7 +160,7 @@ def _tc_step_body(spec: TcSpec, tc_a, tc_b, tc_num, sbk, sbc, btotal):
     u_b = jnp.concatenate([jnp.where(tc_valid, tc_b.astype(jnp.uint32), KEY_MAX), new_c])
     u_valid = jnp.concatenate([tc_valid, new_ok])
     u_cap = spec.tc_capacity + spec.join_capacity
-    ruk, ruv, ruvalid, ru_total = _exchange_keyed_rows(
+    ruk, ruv, ruvalid, ru_total = exchange_keyed_rows(
         _cspec(spec, u_cap, u_cap, 2),
         _pair_mix(u_a, u_b),
         jnp.concatenate([_as_val(u_a), _as_val(u_b)], axis=1),

@@ -41,7 +41,7 @@ import numpy as np
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.definitions import FRAME_HEADER_SIZE, AmId, pack_frame
 from sparkucx_tpu.shuffle.manager import TpuShuffleManager
-from sparkucx_tpu.transport.peer import _recv_exact, _recv_frame, pack_batch_fetch_req, unpack_batch_fetch_req
+from sparkucx_tpu.transport.peer import recv_exact, recv_frame, pack_batch_fetch_req, unpack_batch_fetch_req
 import struct
 
 _TAG = struct.Struct("<Q")
@@ -68,12 +68,12 @@ def _frame(op: int, header: dict, body: bytes = b"") -> bytes:
 
 
 def _read_frame(sock) -> Optional[Tuple[int, dict, bytes]]:
-    hdr = _recv_exact(sock, FRAME_HEADER_SIZE)
+    hdr = recv_exact(sock, FRAME_HEADER_SIZE)
     if hdr is None:
         return None
     op, hlen, blen = struct.unpack("<IQQ", hdr)
-    header = _recv_exact(sock, hlen) if hlen else b""
-    body = _recv_exact(sock, blen) if blen else b""
+    header = recv_exact(sock, hlen) if hlen else b""
+    body = recv_exact(sock, blen) if blen else b""
     if (hlen and header is None) or (blen and body is None):
         return None
     meta = json.loads(header) if header else {}
@@ -106,6 +106,11 @@ class ShuffleDaemon:
         self._thread.start()
 
     # ------------------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        """True until close() — the CLI main loop polls this."""
+        return self._running
 
     def _accept_loop(self) -> None:
         while self._running:
@@ -266,7 +271,7 @@ class DaemonClient:
                 struct.pack("<IQQ", int(AmId.FETCH_BLOCK_REQ), 0, len(pack_batch_fetch_req(0, block_ids)))
                 + pack_batch_fetch_req(0, block_ids)
             )
-            frame = _recv_frame(self._sock)
+            frame = recv_frame(self._sock)
         if frame is None:
             raise ConnectionError("daemon closed connection")
         _, header, body = frame
@@ -318,7 +323,7 @@ def main(argv=None) -> None:
     daemon = ShuffleDaemon(num_executors=args.executors, host=args.host, port=args.port)
     print(f"shuffle daemon on {daemon.address[0]}:{daemon.address[1]}", flush=True)
     try:
-        while daemon._running:
+        while daemon.running:
             import time
 
             time.sleep(0.5)

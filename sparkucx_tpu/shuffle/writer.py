@@ -36,7 +36,7 @@ class PartitionWriterStream:
     def write(self, data: bytes) -> int:
         if self._closed:
             raise TransportError("write to closed partition stream")
-        self._owner._map_writer.write(data)
+        self._owner.map_writer.write(data)
         self.count += len(data)
         return len(data)
 
@@ -44,8 +44,8 @@ class PartitionWriterStream:
         if self._closed:
             return
         self._closed = True
-        self._owner._map_writer.close_partition()
-        self._owner._partition_lengths[self.reduce_id] = self.count
+        self._owner.map_writer.close_partition()
+        self._owner.record_partition_length(self.reduce_id, self.count)
 
     def __enter__(self) -> "PartitionWriterStream":
         return self
@@ -65,7 +65,7 @@ class TpuShufflePartitionWriter:
 
     def open_stream(self) -> PartitionWriterStream:
         if self._stream is None:
-            self._owner._map_writer.open_partition(self.reduce_id)
+            self._owner.map_writer.open_partition(self.reduce_id)
             self._stream = PartitionWriterStream(self._owner, self.reduce_id)
         return self._stream
 
@@ -89,7 +89,8 @@ class TpuShuffleMapOutputWriter:
         self.map_id = map_id
         self.num_partitions = num_partitions
         self._transport = transport
-        self._map_writer: MapWriter = store.map_writer(shuffle_id, map_id)
+        #: public: the friend writer/stream classes above drive this handle
+        self.map_writer: MapWriter = store.map_writer(shuffle_id, map_id)
         self._partition_lengths = np.zeros(num_partitions, dtype=np.int64)
         self._committed = False
         self._last_partition = -1
@@ -107,12 +108,17 @@ class TpuShuffleMapOutputWriter:
         self._last_partition = reduce_id
         return TpuShufflePartitionWriter(self, reduce_id)
 
+    def record_partition_length(self, reduce_id: int, count: int) -> None:
+        """Called by PartitionWriterStream.close() with the partition's byte
+        count (the lengths array is Spark's MapOutputCommitMessage)."""
+        self._partition_lengths[reduce_id] = count
+
     def commit_all_partitions(self) -> np.ndarray:
         """Pack + ship the MapperInfo commit (NvkvShuffleMapOutputWriter.scala:116-148)
         and return per-partition lengths (Spark's MapOutputCommitMessage)."""
         if self._committed:
             raise TransportError("writer already committed")
-        info = self._map_writer.commit()
+        info = self.map_writer.commit()
         self._transport.commit_block(info.pack())
         self._committed = True
         return self._partition_lengths.copy()

@@ -153,6 +153,12 @@ class LoopbackTransport(ShuffleTransport):
             for k in [k for k in self._store if k[0] == shuffle_id]:
                 del self._store[k]
 
+    def staged_payload(self, shuffle_id: int, map_id: int, reduce_id: int):
+        """Peer-visible read of one staged block (the loopback analogue of a
+        served FetchBlockReq); returns None when the block is absent."""
+        with self._store_lock:
+            return self._store.get((shuffle_id, map_id, reduce_id))
+
     def registered_block(self, block_id: BlockId) -> Optional[Block]:
         with self._registry_lock:
             return self._registry.get(block_id)
@@ -251,8 +257,7 @@ class LoopbackTransport(ShuffleTransport):
         def serve() -> None:
             try:
                 peer = self.fabric.resolve(executor_id)
-                with peer._store_lock:
-                    payload = peer._store.get((shuffle_id, map_id, reduce_id))
+                payload = peer.staged_payload(shuffle_id, map_id, reduce_id)
                 if payload is None:
                     raise TransportError(
                         f"no staged block ({shuffle_id},{map_id},{reduce_id}) on executor {executor_id}"

@@ -63,7 +63,7 @@ _SIZE = struct.Struct("<q")
 _MAX_FRAME = 1 << 31
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     chunks = []
     got = 0
     while got < n:
@@ -75,15 +75,15 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
-def _recv_frame(sock: socket.socket) -> Optional[Tuple[AmId, bytes, bytes]]:
-    hdr = _recv_exact(sock, FRAME_HEADER_SIZE)
+def recv_frame(sock: socket.socket) -> Optional[Tuple[AmId, bytes, bytes]]:
+    hdr = recv_exact(sock, FRAME_HEADER_SIZE)
     if hdr is None:
         return None
     am_id, hlen, blen = unpack_frame_header(hdr)
     if hlen + blen > _MAX_FRAME:
         raise ValueError("frame too large")
-    header = _recv_exact(sock, hlen) if hlen else b""
-    body = _recv_exact(sock, blen) if blen else b""
+    header = recv_exact(sock, hlen) if hlen else b""
+    body = recv_exact(sock, blen) if blen else b""
     if (hlen and header is None) or (blen and body is None):
         return None
     return am_id, header, body
@@ -227,7 +227,7 @@ class BlockServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while self._running:
-                frame = _recv_frame(conn)
+                frame = recv_frame(conn)
                 if frame is None:
                     return
                 am_id, header, body = frame
@@ -356,20 +356,20 @@ class _PeerConnection:
                         raise OSError("peer closed mid-body")
                     mv = mv[n:]
             else:  # oversized/unknown: drain and let progress() report failure
-                if _recv_exact(self.sock, size) is None:
+                if recv_exact(self.sock, size) is None:
                     raise OSError("peer closed mid-body")
         return True
 
     def _recv_loop(self) -> None:
         try:
             while self.alive:
-                hdr = _recv_exact(self.sock, FRAME_HEADER_SIZE)
+                hdr = recv_exact(self.sock, FRAME_HEADER_SIZE)
                 if hdr is None:
                     break
                 am_id, hlen, blen = unpack_frame_header(hdr)
                 if hlen + blen > _MAX_FRAME:
                     raise ValueError("frame too large")
-                header = _recv_exact(self.sock, hlen) if hlen else b""
+                header = recv_exact(self.sock, hlen) if hlen else b""
                 if hlen and header is None:
                     break
                 scattered = False
@@ -381,7 +381,7 @@ class _PeerConnection:
                         if self.ack_done is not None:
                             self.ack_done(tag)
                 if not scattered:
-                    body = _recv_exact(self.sock, blen) if blen else b""
+                    body = recv_exact(self.sock, blen) if blen else b""
                     if blen and body is None:
                         break
                 else:

@@ -140,7 +140,7 @@ class TestRaggedParamsProperties:
 class TestCompactLayoutParams:
     """The compact-input-layout variant (``slot_rows=None``) — the parameter
     set the columnar shuffle and distributed sort pass to ragged_all_to_all
-    (ops/columnar.py size_matrix_from_owners / _columnar_shard_ragged)."""
+    (ops/columnar.py size_matrix_from_owners / columnar_shard_ragged)."""
 
     @pytest.mark.parametrize("trial", range(10))
     def test_compact_simulation_matches_sender_major_contract(self, trial):
