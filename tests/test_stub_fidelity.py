@@ -1,0 +1,111 @@
+"""The stub-fidelity lint (scripts/check_stub_fidelity.py) — the no-JDK
+surrogate for the javac gate (VERDICT r4 task 3): the real tree must pass, and
+seeded drift between ``jvm/src`` and ``jvm/stubs`` must be caught."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(ROOT, "scripts")
+sys.path.insert(0, SCRIPTS)
+
+import check_stub_fidelity as fidelity  # noqa: E402
+
+
+def run_on(stub_dir, src_dir):
+    """Run the checker against alternate trees; returns (rc, messages)."""
+    old = fidelity.STUB_DIR, fidelity.SRC_DIR
+    import io
+    from contextlib import redirect_stdout
+
+    fidelity.STUB_DIR, fidelity.SRC_DIR = str(stub_dir), str(src_dir)
+    buf = io.StringIO()
+    try:
+        with redirect_stdout(buf):
+            try:
+                rc = fidelity.main()
+            except SystemExit as e:  # load_stubs exits on stub-layout errors
+                rc = e.code
+    finally:
+        fidelity.STUB_DIR, fidelity.SRC_DIR = old
+    return rc, buf.getvalue()
+
+
+@pytest.fixture
+def fault_tree(tmp_path):
+    """A private copy of jvm/ to seed faults into."""
+    shutil.copytree(os.path.join(ROOT, "jvm", "stubs"), tmp_path / "stubs")
+    shutil.copytree(os.path.join(ROOT, "jvm", "src"), tmp_path / "src")
+    return tmp_path
+
+
+def _edit(path, old, new):
+    text = path.read_text()
+    assert old in text, f"fault seed {old!r} not found in {path}"
+    path.write_text(text.replace(old, new))
+
+
+MANAGER_STUB = "stubs/org/apache/spark/shuffle/ShuffleManager.java"
+MANAGER_SRC = "src/org/apache/spark/shuffle/tpu/TpuShuffleManager.java"
+
+
+class TestRealTreePasses:
+    def test_checked_in_tree_is_clean(self):
+        rc = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "check_stub_fidelity.py")],
+            capture_output=True, text=True, cwd=ROOT,
+        )
+        assert rc.returncode == 0, rc.stdout + rc.stderr
+        assert "STUB FIDELITY: OK" in rc.stdout
+
+
+class TestSeededFaultsAreCaught:
+    def test_renamed_spi_method_in_stub(self, fault_tree):
+        _edit(fault_tree / MANAGER_STUB,
+              "boolean unregisterShuffle(int shuffleId);",
+              "boolean unregisterShuffleX(int shuffleId);")
+        rc, out = run_on(fault_tree / "stubs", fault_tree / "src")
+        assert rc == 1
+        assert "lacks unregisterShuffleX" in out
+
+    def test_typoed_call_on_stub_receiver(self, fault_tree):
+        _edit(fault_tree / MANAGER_SRC,
+              "dependency.rdd().getNumPartitions(),",
+              "dependency.rddX().getNumPartitions(),")
+        rc, out = run_on(fault_tree / "stubs", fault_tree / "src")
+        assert rc == 1
+        assert "rddX() not declared by stub" in out
+
+    def test_wrong_call_arity(self, fault_tree):
+        _edit(fault_tree / MANAGER_SRC,
+              'conf.getInt("spark.shuffle.tpu.daemon.port", 1338)',
+              'conf.getInt("spark.shuffle.tpu.daemon.port")')
+        rc, out = run_on(fault_tree / "stubs", fault_tree / "src")
+        assert rc == 1
+        assert "getInt() called with 1 args" in out
+
+    def test_chain_hop_typo(self, fault_tree):
+        _edit(fault_tree / MANAGER_SRC,
+              "dependency.partitioner().numPartitions());",
+              "dependency.partitioner().numPartitionsX());")
+        rc, out = run_on(fault_tree / "stubs", fault_tree / "src")
+        assert rc == 1
+        assert "numPartitionsX" in out
+
+    def test_missing_stub_for_import(self, fault_tree):
+        os.unlink(fault_tree / "stubs/org/apache/spark/storage/BlockManagerId.java")
+        rc, out = run_on(fault_tree / "stubs", fault_tree / "src")
+        assert rc == 1
+        assert "import org.apache.spark.storage.BlockManagerId has no stub" in out
+
+    def test_stub_package_mismatch(self, fault_tree):
+        _edit(fault_tree / MANAGER_STUB,
+              "package org.apache.spark.shuffle;",
+              "package org.apache.spark.wrong;")
+        rc, out = run_on(fault_tree / "stubs", fault_tree / "src")
+        assert rc == 1
+        assert "package" in out
