@@ -55,7 +55,9 @@ SEND_ROWS_CANDIDATES = [
     int(s) for s in os.environ.get("BENCH_SEND_ROWS", "2097152,1048576").split(",")
 ]
 FILL = float(os.environ.get("BENCH_FILL", "0.9"))
-CHAIN = int(os.environ.get("BENCH_CHAIN", "64"))
+# 256-deep: through the axon tunnel, enqueue latency still throttles the chip
+# at 64-deep windows (x+0 copy measures 361 -> 565 GB/s r+w going 64 -> 256)
+CHAIN = int(os.environ.get("BENCH_CHAIN", "256"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 TCP_BYTES = int(os.environ.get("BENCH_TCP_BYTES", str(256 << 20)))
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "30"))
@@ -196,8 +198,9 @@ def integrity_gate():
     cluster.remove_shuffle(0)
 
 
-def device_superstep_gbps(send_rows: int) -> float:
-    """Chained shuffle supersteps over HBM-resident payloads."""
+def device_superstep_gbps(send_rows: int) -> tuple:
+    """Chained shuffle supersteps over HBM-resident payloads.
+    Returns (best GB/s, executed exchange impl)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -237,7 +240,7 @@ def device_superstep_gbps(send_rows: int) -> float:
         dt = time.perf_counter() - t0
         out = cur
         best = max(best, CHAIN * bytes_per_step / dt / 1e9)
-    return best
+    return best, fn.spec.impl
 
 
 def main():
@@ -270,8 +273,9 @@ def main():
         tpu = None
         for i, send_rows in enumerate(SEND_ROWS_CANDIDATES):
             try:
-                tpu = device_superstep_gbps(send_rows)
+                tpu, RESULT["superstep_impl"] = device_superstep_gbps(send_rows)
                 RESULT["send_rows"] = send_rows
+                RESULT["superstep_window"] = CHAIN
                 break
             except Exception as e:
                 if i + 1 == len(SEND_ROWS_CANDIDATES):
@@ -289,12 +293,33 @@ def main():
     if not SKIP_SUBMETRICS and RESULT["value"] is not None:
         from sparkucx_tpu.perf.benchmark import measure_gather, measure_sort
 
+        # Gather: the documented config (256 x 2 MiB blocks — docs/PERF.md) with
+        # the Pallas DMA lowering REQUESTED EXPLICITLY and the executed lowering
+        # recorded, plus the XLA fallback side by side — so this gate can never
+        # silently benchmark the fallback and call it the kernel.  A Mosaic
+        # lowering failure lands in gather_error, not in a wrong number.
+        # Window 64 amortizes tunnel dispatch (~2-18 ms/call here); deeper
+        # windows keep climbing (see PERF.md), this is the gate's time budget.
+        impls = []
+        rep = lambda it, dt, tot, impl: impls.append(impl)
+        gather_window = 64
         try:
             RESULT["gather_gbps"] = round(
-                measure_gather(64, 1 << 20, REPEATS, outstanding=8), 3
+                measure_gather(
+                    256, 2 << 20, REPEATS, outstanding=gather_window, impl="dma",
+                    report=rep,
+                ), 3,
             )
+            RESULT["gather_impl"] = impls[-1]
+            RESULT["gather_window"] = gather_window
         except Exception as e:
             RESULT["gather_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            RESULT["gather_xla_gbps"] = round(
+                measure_gather(256, 2 << 20, REPEATS, outstanding=8, impl="xla"), 3
+            )
+        except Exception as e:
+            RESULT["gather_xla_error"] = f"{type(e).__name__}: {e}"[:200]
         try:
             RESULT["sort_mrows_s"] = round(measure_sort(1, 1 << 21, REPEATS), 3)
         except Exception as e:

@@ -157,7 +157,7 @@ def load_stubs() -> Dict[str, JavaType]:
             path = os.path.join(dirpath, fn)
             types = parse_java(path)
             expect_pkg = os.path.relpath(dirpath, STUB_DIR).replace(os.sep, ".")
-            expect_name = fn[:-5].replace("$", "$")
+            expect_name = fn[:-5]
             if not types:
                 errors.append(f"{path}: no type declaration found")
                 continue

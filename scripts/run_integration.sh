@@ -46,6 +46,11 @@ run_tc_test() {       # test.sh:175-179 (SparkTC; gate at :196)
   EXECUTORS=4 VERTICES=100 EDGES=200 python scripts/integration_tc.py
 }
 
+run_fault_test() {    # OS-process fault injection: mapper SIGKILL mid-write
+  FAULTS=1 EXECUTORS=2 MAPPERS=4 REDUCERS=8 PAIRS_PER_MAP=5000 \
+    python scripts/integration_groupby.py   # + reducer SIGKILL mid-fetch
+}
+
 run_jvm_shim_check() { # ci.yml jvm-shim job, runnable anywhere a JDK exists
   if ! command -v javac >/dev/null 2>&1; then
     echo "JVM SHIM CHECK: javac SKIPPED (no javac on PATH, none installable —"
@@ -93,6 +98,8 @@ echo "== terasort test (1M rows) =="
 run_terasort_test
 echo "== tc test =="
 run_tc_test
+echo "== fault-injection test =="
+run_fault_test
 echo "== jvm shim check =="
 run_jvm_shim_check
 echo "ALL INTEGRATION TESTS PASSED"

@@ -33,9 +33,14 @@ Protocol (mirrors the reference's two-phase metadata+data design):
      row gather compacts the receive side into the same tight sender-major layout
      the ragged path produces.  This is also the path the driver's virtual-CPU
      ``dryrun_multichip`` executes.
+   * ``impl='local'`` (TPU, n=1 only): the degenerate single-executor superstep
+     is a device-local prefix copy, which the Pallas DMA gather streams ~3x
+     faster than ragged_all_to_all's single-device lowering (docs/PERF.md).
 
-   Both lowerings produce bit-identical receive buffers, so every layer above is
-   implementation-agnostic.
+   All lowerings produce identical receive buffers over the valid (sized)
+   prefix, so every layer above is implementation-agnostic; rows past the
+   received totals are zeros under the collective lowerings and unspecified
+   under 'local'.
 
 Everything is static-shaped: staging capacities are compile-time constants, sizes
 are runtime data.  No data-dependent Python control flow — the same compiled
@@ -88,17 +93,32 @@ class ExchangeSpec:
         return self.send_rows // self.num_executors
 
     def resolve_impl(self, platform: Optional[str] = None) -> "ExchangeSpec":
+        """'auto' -> the fastest lowering the backend executes:
+
+        * TPU, n == 1: ``'local'`` — the collective degenerates to a device-
+          local prefix copy, and ``ragged_all_to_all``'s single-device lowering
+          streams that copy at only ~175 GB/s HBM r+w where the Pallas DMA
+          gather sustains ~525 (docs/PERF.md roofline table), so the DMA kernel
+          IS the exchange here;
+        * TPU, n > 1: ``'ragged'`` (the ICI collective — network-bound, where
+          the local-copy inefficiency is irrelevant);
+        * CPU: ``'dense'`` (XLA:CPU has no ragged_all_to_all kernel).
+        """
         if self.impl != "auto":
             return self
         if platform is None:
             platform = jax.devices()[0].platform
-        return replace(self, impl="ragged" if platform == "tpu" else "dense")
+        if platform != "tpu":
+            return replace(self, impl="dense")
+        return replace(self, impl="local" if self.num_executors == 1 else "ragged")
 
     def validate(self) -> None:
         if self.send_rows % self.num_executors:
             raise ValueError("send_rows must be divisible by num_executors (slot layout)")
-        if self.impl not in ("ragged", "dense"):
+        if self.impl not in ("ragged", "dense", "local"):
             raise ValueError(f"unknown impl {self.impl!r}")
+        if self.impl == "local" and self.num_executors != 1:
+            raise ValueError("impl='local' is the n=1 degenerate exchange only")
         if self.lane <= 0:
             raise ValueError("lane must be positive")
 
@@ -195,6 +215,34 @@ def _exchange_shard_dense(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.n
     return out, recv_sizes[None, :]
 
 
+def _build_local_exchange(mesh: Mesh, spec: ExchangeSpec):
+    """The n=1 degenerate superstep: one Pallas DMA prefix copy.
+
+    Same contract as the collective lowerings EXCEPT rows past the received
+    total are UNSPECIFIED (the collective paths zero them; every consumer
+    slices by ``recv_sizes``, which the transports already do).  Roughly 3x
+    the single-device throughput of ragged_all_to_all's local-copy lowering
+    (~525 vs ~175 GB/s HBM r+w — docs/PERF.md)."""
+    from sparkucx_tpu.ops.pallas_kernels import build_block_gather
+
+    gather = build_block_gather(1, spec.recv_rows, impl="dma")
+
+    def local_fn(data, size_matrix):
+        zero = jnp.zeros(1, dtype=jnp.int32)
+        counts = size_matrix[0, :1].astype(jnp.int32)
+        recv = gather(zero, counts, zero, data)
+        return recv, size_matrix
+
+    sharding = NamedSharding(mesh, P(spec.axis_name, None))
+    fn = jax.jit(
+        local_fn,
+        in_shardings=(sharding, sharding),
+        out_shardings=(sharding, sharding),
+    )
+    fn.spec = spec
+    return fn
+
+
 def build_exchange(mesh: Mesh, spec: ExchangeSpec):
     """Compile the shuffle-superstep exchange for ``mesh``.
 
@@ -209,11 +257,17 @@ def build_exchange(mesh: Mesh, spec: ExchangeSpec):
       executor j received, tightly packed sender-major;
     * ``recv_sizes``: (n, n) int32 row-sharded — row j = rows j received from
       each sender i.
+
+    Rows of ``recv`` past each shard's received total are zeros under the
+    collective lowerings and UNSPECIFIED under ``'local'`` — consumers must
+    slice by ``recv_sizes`` (all in-tree consumers do).
     """
     if spec.num_executors != mesh.devices.size:
         raise ValueError(f"spec.num_executors={spec.num_executors} != mesh size {mesh.devices.size}")
     spec = spec.resolve_impl(platform=mesh.devices.reshape(-1)[0].platform)
     spec.validate()
+    if spec.impl == "local":
+        return _build_local_exchange(mesh, spec)
     ax = spec.axis_name
     body = _exchange_shard_ragged if spec.impl == "ragged" else _exchange_shard_dense
 

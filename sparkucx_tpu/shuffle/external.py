@@ -61,10 +61,12 @@ def _estimate(obj: Any, depth: int = _ESTIMATE_MAX_DEPTH) -> int:
     exactly that machinery): a shallow ``sys.getsizeof`` counts a list of 10k
     ints as ~56 B of pointer header, so nested-value workloads would blow
     through ``memory_budget`` without ever spilling.  Containers recurse to a
-    bounded depth, sampling ``_ESTIMATE_SAMPLE`` evenly spaced elements and
-    extrapolating, so cost per record stays O(sample * depth) regardless of
-    value size.  Scalars, numpy arrays, and ``__slots__``/``__dict__`` objects
-    are sized directly."""
+    bounded depth and sample ``_ESTIMATE_SAMPLE`` elements, extrapolating the
+    sample mean over ``len()``, so cost per record stays O(sample * depth)
+    regardless of value size: sequences are indexed at evenly spaced
+    positions; dict/set (not indexable) take the first ``sample`` entries — a
+    biased but O(sample) draw.  Scalars, numpy arrays, and
+    ``__slots__``/``__dict__`` objects are sized directly."""
     try:
         size = sys.getsizeof(obj)
     except TypeError:  # objects with broken __sizeof__
@@ -81,16 +83,21 @@ def _estimate(obj: Any, depth: int = _ESTIMATE_MAX_DEPTH) -> int:
         n = len(obj)
         if n == 0:
             return size
-        step = max(1, n // _ESTIMATE_SAMPLE)
-        sampled = list(itertools.islice(obj.items(), 0, None, step))[:_ESTIMATE_SAMPLE]
+        sampled = list(itertools.islice(obj.items(), _ESTIMATE_SAMPLE))
         per = sum(_estimate(k, depth - 1) + _estimate(v, depth - 1) for k, v in sampled)
         return size + per * n // len(sampled)
-    if isinstance(obj, (list, tuple, set, frozenset)):
+    if isinstance(obj, (list, tuple)):
         n = len(obj)
         if n == 0:
             return size
-        step = max(1, n // _ESTIMATE_SAMPLE)
-        sampled = list(itertools.islice(obj, 0, None, step))[:_ESTIMATE_SAMPLE]
+        k = min(n, _ESTIMATE_SAMPLE)
+        per = sum(_estimate(obj[(i * n) // k], depth - 1) for i in range(k))
+        return size + per * n // k
+    if isinstance(obj, (set, frozenset)):
+        n = len(obj)
+        if n == 0:
+            return size
+        sampled = list(itertools.islice(obj, _ESTIMATE_SAMPLE))
         per = sum(_estimate(e, depth - 1) for e in sampled)
         return size + per * n // len(sampled)
     # plain objects: their attribute dict / slots

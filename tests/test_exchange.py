@@ -136,6 +136,29 @@ class TestRaggedLowering:
         assert fn.spec.impl == "dense"
 
 
+class TestLocalLowering:
+    """The n=1 degenerate exchange lowers to the Pallas DMA prefix copy on
+    TPU ('local'); its resolve/validate logic is platform-independent and the
+    kernel itself is exercised by bench.py's integrity gate on hardware."""
+
+    def test_auto_resolves_local_on_tpu_n1(self):
+        spec = ExchangeSpec(num_executors=1, send_rows=64, recv_rows=64)
+        assert spec.resolve_impl(platform="tpu").impl == "local"
+
+    def test_auto_resolves_ragged_on_tpu_n_gt_1(self):
+        spec = ExchangeSpec(num_executors=4, send_rows=64, recv_rows=64)
+        assert spec.resolve_impl(platform="tpu").impl == "ragged"
+
+    def test_auto_resolves_dense_on_cpu_n1(self):
+        spec = ExchangeSpec(num_executors=1, send_rows=64, recv_rows=64)
+        assert spec.resolve_impl(platform="cpu").impl == "dense"
+
+    def test_local_rejected_for_multi_executor(self):
+        spec = ExchangeSpec(num_executors=2, send_rows=64, recv_rows=64, impl="local")
+        with pytest.raises(ValueError, match="n=1 degenerate"):
+            spec.validate()
+
+
 class TestPacking:
     def test_slot_packing_offsets(self):
         buf, sizes = pack_chunks_slots([b"a" * 100, b"b" * 300], slot_rows=8, row_bytes=128)

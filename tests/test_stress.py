@@ -188,13 +188,16 @@ class TestDiskTierConcurrency:
 
         def reader():
             rng = np.random.default_rng(threading.get_ident() % (1 << 32))
-            while not stop.is_set() or committed:
-                if not committed:
-                    time.sleep(0.0005)
-                    continue
-                m = committed[int(rng.integers(0, len(committed)))]
-                expect = self._pattern(m) * region
-                try:
+            # any exception is a failure — committed blocks must stay readable
+            # through rollovers; a non-TransportError crash must not pass
+            # silently as a dead thread
+            try:
+                while not stop.is_set() or committed:
+                    if not committed:
+                        time.sleep(0.0005)
+                        continue
+                    m = committed[int(rng.integers(0, len(committed)))]
+                    expect = self._pattern(m) * region
                     got = s.read_block(0, m, 0)
                     if got != expect:
                         failures.append(f"torn read_block map={m}")
@@ -205,11 +208,10 @@ class TestDiskTierConcurrency:
                         if bytes(arr[off : off + ln]) != expect:
                             failures.append(f"torn staging_view map={m}")
                             return
-                except TransportError as e:
-                    failures.append(f"read failed for committed map {m}: {e}")
-                    return
-                if stop.is_set():
-                    return
+                    if stop.is_set():
+                        return
+            except BaseException as e:
+                failures.append(f"reader crashed: {type(e).__name__}: {e}")
 
         readers = [threading.Thread(target=reader) for _ in range(4)]
         for th in readers:
@@ -248,15 +250,18 @@ class TestDiskTierConcurrency:
         def reader():
             rng = np.random.default_rng(threading.get_ident() % (1 << 32))
             started.wait()
-            for _ in range(400):
-                m = int(rng.integers(0, ROUNDS))
-                try:
-                    got = s.read_block(0, m, 0)
-                except TransportError:
-                    return  # shuffle removed underneath us — clean refusal
-                if got != self._pattern(m) * region:
-                    failures.append(f"torn read after remove map={m}")
-                    return
+            try:
+                for _ in range(400):
+                    m = int(rng.integers(0, ROUNDS))
+                    try:
+                        got = s.read_block(0, m, 0)
+                    except TransportError:
+                        return  # shuffle removed underneath us — clean refusal
+                    if got != self._pattern(m) * region:
+                        failures.append(f"torn read after remove map={m}")
+                        return
+            except BaseException as e:  # anything else = dirty failure, not clean refusal
+                failures.append(f"reader crashed: {type(e).__name__}: {e}")
 
         readers = [threading.Thread(target=reader) for _ in range(4)]
         for th in readers:
@@ -293,19 +298,22 @@ class TestDiskTierConcurrency:
         def reader():
             rng = np.random.default_rng(threading.get_ident() % (1 << 32))
             started.wait()
-            for _ in range(300):
-                m = int(rng.integers(0, M))
-                try:
-                    view = s.block_staging_view(0, m, 0)
-                    if view is None:
-                        return  # removed — staging gone, clean refusal
-                    arr, off, ln = view
-                    got = bytes(arr[off : off + ln])
-                except TransportError:
-                    return
-                if got != self._pattern(m) * payload_len:
-                    failures.append(f"torn shm read map={m}")
-                    return
+            try:
+                for _ in range(300):
+                    m = int(rng.integers(0, M))
+                    try:
+                        view = s.block_staging_view(0, m, 0)
+                        if view is None:
+                            return  # removed — staging gone, clean refusal
+                        arr, off, ln = view
+                        got = bytes(arr[off : off + ln])
+                    except TransportError:
+                        return
+                    if got != self._pattern(m) * payload_len:
+                        failures.append(f"torn shm read map={m}")
+                        return
+            except BaseException as e:  # e.g. SIGSEGV-adjacent munmap errors surface here
+                failures.append(f"reader crashed: {type(e).__name__}: {e}")
 
         readers = [threading.Thread(target=reader) for _ in range(4)]
         for th in readers:
@@ -330,6 +338,8 @@ class TestDiskTierConcurrency:
         cap_hits = []
         ok = []
 
+        unexpected = []
+
         def writer(m):
             try:
                 w = s.map_writer(0, m)
@@ -337,14 +347,19 @@ class TestDiskTierConcurrency:
                 w.commit()
                 ok.append(m)
             except TransportError as e:
-                assert "spill cap" in str(e)
-                cap_hits.append(m)
+                if "spill cap" in str(e):
+                    cap_hits.append(m)
+                else:
+                    unexpected.append(f"map {m}: {e}")
+            except BaseException as e:
+                unexpected.append(f"map {m} crashed: {type(e).__name__}: {e}")
 
         threads = [threading.Thread(target=writer, args=(m,)) for m in range(M)]
         for th in threads:
             th.start()
         for th in threads:
             th.join()
+        assert not unexpected, unexpected
         assert cap_hits, "cap never enforced despite 10 full rounds vs a 2-round cap"
         assert 0 < s._spill_bytes <= cap, f"spilled {s._spill_bytes} B past cap {cap}"
         # committed rounds still read back exactly
