@@ -171,6 +171,49 @@ class TestDistributedSort:
         np.testing.assert_array_equal(np.asarray(po)[:, 0], np.arange(64, dtype=np.int32))
         assert int(np.asarray(cnt)[0]) == 64
 
+    def test_single_lowering_auto_resolution(self):
+        # n=1 resolves to 'single' on ANY platform (pure XLA: no collective)
+        spec = SortSpec(num_executors=1, capacity=64, recv_capacity=64, width=1)
+        assert spec.resolve_impl(platform="cpu").impl == "single"
+        assert spec.resolve_impl(platform="tpu").impl == "single"
+        multi = SortSpec(num_executors=2, capacity=64, recv_capacity=128, width=1)
+        assert multi.resolve_impl(platform="cpu").impl == "dense"
+        # single demands n=1 and recv headroom >= capacity
+        bad = SortSpec(num_executors=2, capacity=64, recv_capacity=128, width=1, impl="single")
+        with pytest.raises(ValueError, match="single"):
+            bad.validate()
+
+    def test_single_lowering_vs_oracle_with_padding(self):
+        """impl='single' (what n=1 'auto' now runs, incl. the PERF headline):
+        nv < capacity padding, a VALID KEY_MAX key, and the recv_capacity >
+        capacity pad branch — output must match the other lowerings' contract
+        (sorted prefix, zeroed payload tail, KEY_MAX key tail)."""
+        mesh1 = make_mesh(1)
+        CAP, RECV, NV = 64, 96, 40
+        spec = SortSpec(num_executors=1, capacity=CAP, recv_capacity=RECV, width=2, impl="auto")
+        f = build_distributed_sort(mesh1, spec)
+        assert f.spec.impl == "single"
+        rng = np.random.default_rng(7)
+        keys = np.full(CAP, 12345, np.uint32)  # padding region deliberately NOT KEY_MAX
+        keys[:NV] = rng.integers(0, 1 << 32, size=NV, dtype=np.uint64).astype(np.uint32)
+        keys[3] = KEY_MAX  # a genuinely valid max-key row must survive
+        payload = np.full((CAP, 2), -7, np.int32)  # garbage padding payload
+        payload[:NV] = rng.integers(-100, 100, size=(NV, 2)).astype(np.int32)
+        ko, po, cnt = f(
+            jax.device_put(keys, NamedSharding(mesh1, P("ex"))),
+            jax.device_put(payload, NamedSharding(mesh1, P("ex", None))),
+            jax.device_put(np.array([NV], np.int32), NamedSharding(mesh1, P("ex"))),
+        )
+        ko, po, cnt = np.asarray(ko), np.asarray(po), np.asarray(cnt)
+        assert cnt.tolist() == [NV]
+        ek, ep = oracle_sort(keys[:NV], payload[:NV])
+        np.testing.assert_array_equal(ko[:NV], ek)
+        np.testing.assert_array_equal(po[:NV], ep)
+        # contract parity with the collective lowerings: zero payload tail,
+        # KEY_MAX key tail — caller padding must NOT leak through
+        np.testing.assert_array_equal(ko[NV:], np.full(RECV - NV, KEY_MAX, np.uint32))
+        np.testing.assert_array_equal(po[NV:], np.zeros((RECV - NV, 2), np.int32))
+
     def test_spec_validation(self, mesh):
         with pytest.raises(ValueError, match="mesh size"):
             build_distributed_sort(mesh, SortSpec(num_executors=4, capacity=8, recv_capacity=8))
