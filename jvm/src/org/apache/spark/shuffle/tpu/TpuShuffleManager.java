@@ -87,8 +87,16 @@ public class TpuShuffleManager implements ShuffleManager {
       ShuffleHandle handle, long mapId, TaskContext context,
       ShuffleWriteMetricsReporter metrics) {
     TpuShuffleHandle<K, V, ?> h = (TpuShuffleHandle<K, V, ?>) handle;
+    // Spark 2.4 passes the map partition index here; Spark 3.x passes the
+    // globally unique long task attempt id. The daemon's map slot is the
+    // 0..numMaps-1 INDEX, which in both generations is context.partitionId()
+    // — the same re-keying the reference applies to survive the 2.4->3.0
+    // mapId change (compat/spark_3_0/UcxShuffleBlockResolver.scala:28-39
+    // registers by partitionId, "not Spark 3's unique mapId"). The long mapId
+    // still travels to MapStatus, which 3.x keys on (jvm/README.md compat
+    // section).
     try {
-      return new TpuShuffleWriter<>(daemon(), h, (int) mapId, metrics);
+      return new TpuShuffleWriter<>(daemon(), h, context.partitionId(), mapId, metrics);
     } catch (IOException e) {
       throw new RuntimeException(e);
     }

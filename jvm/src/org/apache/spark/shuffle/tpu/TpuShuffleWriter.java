@@ -27,16 +27,21 @@ import scala.collection.JavaConverters;
 public class TpuShuffleWriter<K, V> extends ShuffleWriter<K, V> {
   private final DaemonClient daemon;
   private final TpuShuffleManager.TpuShuffleHandle<K, V, ?> handle;
-  private final int mapId;
+  /** Daemon map slot: the map task's 0..numMaps-1 partition index. */
+  private final int mapIndex;
+  /** Spark's mapId as handed to getWriter — the long task attempt id on 3.x,
+   * the map index on 2.4; MapStatus is keyed by it either way. */
+  private final long mapId;
   private final ShuffleWriteMetricsReporter metrics;
   private long[] partitionLengths;
   private boolean stopped = false;
 
   public TpuShuffleWriter(
       DaemonClient daemon, TpuShuffleManager.TpuShuffleHandle<K, V, ?> handle,
-      int mapId, ShuffleWriteMetricsReporter metrics) {
+      int mapIndex, long mapId, ShuffleWriteMetricsReporter metrics) {
     this.daemon = daemon;
     this.handle = handle;
+    this.mapIndex = mapIndex;
     this.mapId = mapId;
     this.metrics = metrics;
   }
@@ -62,7 +67,7 @@ public class TpuShuffleWriter<K, V> extends ShuffleWriter<K, V> {
       metrics.incRecordsWritten(1);
     }
 
-    int writer = daemon.openMapWriter(handle.shuffleId(), mapId);
+    int writer = daemon.openMapWriter(handle.shuffleId(), mapIndex);
     for (int p = 0; p < numPartitions; p++) {
       if (buckets[p] == null) continue;
       streams[p].close();

@@ -3,4 +3,8 @@ package org.apache.spark;
 /** Compile-only stub (see SparkConf stub header). */
 public abstract class TaskContext {
   public static TaskContext get() { throw new UnsupportedOperationException("stub"); }
+  /** The map task's partition index within its stage (0..numMaps-1). */
+  public abstract int partitionId();
+  /** Globally unique task attempt id — what Spark 3.x passes as getWriter's mapId. */
+  public abstract long taskAttemptId();
 }

@@ -38,9 +38,18 @@ FETCH_MAPS, FETCH_REDUCES = (0, 3), (5, 5)
 WRITE_BODY = bytes(range(256))
 
 
-def fetch_frame() -> bytes:
-    body = struct.pack("<QI", FETCH_TAG, len(FETCH_MAPS))
-    for m, r in zip(FETCH_MAPS, FETCH_REDUCES):
+#: 08: a Spark-3.x AQE partial-map read (startMapIndex=1, endMapIndex=3 over
+#: one reduce partition).  Spark 2.4 (no AQE) always reads the full map range;
+#: both generations land on the SAME wire shape — explicit (shuffle, mapIndex,
+#: reduce) triples, the client enumerating its range — so the fixture pins
+#: that the protocol is compat-generation-agnostic (jvm/README.md, "Spark 2.4
+#: vs 3.x").
+AQE_MAPS, AQE_REDUCES = (1, 2), (REDUCE_ID, REDUCE_ID)
+
+
+def fetch_frame(maps=FETCH_MAPS, reduces=FETCH_REDUCES) -> bytes:
+    body = struct.pack("<QI", FETCH_TAG, len(maps))
+    for m, r in zip(maps, reduces):
         body += struct.pack("<iii", SHUFFLE_ID, m, r)
     return struct.pack("<IQQ", int(AmId.FETCH_BLOCK_REQ), 0, len(body)) + body
 
@@ -61,6 +70,7 @@ def fixtures() -> dict:
         "05_run_exchange.bin": _frame(DaemonOp.RUN_EXCHANGE, {"shuffle_id": SHUFFLE_ID}),
         "06_fetch.bin": fetch_frame(),
         "07_remove_shuffle.bin": _frame(DaemonOp.REMOVE_SHUFFLE, {"shuffle_id": SHUFFLE_ID}),
+        "08_fetch_aqe_maprange.bin": fetch_frame(AQE_MAPS, AQE_REDUCES),
     }
 
 

@@ -183,26 +183,38 @@ class TestGoldenWireFixtures:
 
             send_fixture("05_run_exchange.bin")
 
+            def raw_fetch(name):
+                raw.sendall(fx[name])
+                hdr = b""
+                while len(hdr) < 20:
+                    hdr += raw.recv(20 - len(hdr))
+                _, hlen, blen = struct.unpack("<IQQ", hdr)
+                reply_hdr = b""
+                while len(reply_hdr) < hlen:
+                    reply_hdr += raw.recv(hlen - len(reply_hdr))
+                body = b""
+                while len(body) < blen:
+                    body += raw.recv(blen - len(body))
+                tag, count = struct.unpack_from("<QI", reply_hdr)
+                sizes = [
+                    struct.unpack_from("<q", reply_hdr, 12 + 8 * i)[0] for i in range(count)
+                ]
+                return tag, count, sizes, body
+
             # batched fetch exactly as the Java client frames it
-            raw.sendall(fx["06_fetch.bin"])
-            hdr = b""
-            while len(hdr) < 20:
-                hdr += raw.recv(20 - len(hdr))
-            _, hlen, blen = struct.unpack("<IQQ", hdr)
-            reply_hdr = b""
-            while len(reply_hdr) < hlen:
-                reply_hdr += raw.recv(hlen - len(reply_hdr))
-            body = b""
-            while len(body) < blen:
-                body += raw.recv(blen - len(body))
-            tag, count = struct.unpack_from("<QI", reply_hdr)
+            tag, count, sizes, body = raw_fetch("06_fetch.bin")
             assert tag == gen.FETCH_TAG and count == len(gen.FETCH_MAPS)
-            sizes = [
-                struct.unpack_from("<q", reply_hdr, 12 + 8 * i)[0] for i in range(count)
-            ]
             assert sizes == [len(payload_m0), len(payload_m3)]
             assert body[: sizes[0]] == payload_m0
             assert body[sizes[0] :] == payload_m3
+
+            # the AQE partial-map read (Spark 3.x startMapIndex/endMapIndex):
+            # maps [1, 3) x reduce 5 — map 1 committed nothing there (empty
+            # block, size 0), map 2 holds the fixture's 256-byte write
+            tag, count, sizes, body = raw_fetch("08_fetch_aqe_maprange.bin")
+            assert tag == gen.FETCH_TAG and count == len(gen.AQE_MAPS)
+            assert sizes == [0, len(gen.WRITE_BODY)]
+            assert body == gen.WRITE_BODY
 
             send_fixture("07_remove_shuffle.bin")
             with pytest.raises(RuntimeError):
