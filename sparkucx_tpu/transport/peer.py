@@ -162,6 +162,10 @@ class BlockServer:
             try:
                 conn, _ = self._srv.accept()
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:  # deep send window: one reply batch is tens of MiB
+                    conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
+                except OSError:
+                    pass
             except OSError:
                 return
             with self._accepted_lock:
@@ -169,13 +173,19 @@ class BlockServer:
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _resolve_one(self, bid: ShuffleBlockId):
-        """Resolve to ``bytes`` (registry blocks — may hit files) or a
-        zero-copy ``(staging, offset, length)`` view (store blocks) or None."""
+        """Resolve to a ``(buffer, offset, length)`` view or None.
+
+        Registry blocks (may hit files) materialize into a fresh buffer under
+        the block lock; store blocks serve a zero-copy view of host staging.
+        Either way the reply path sends the view without another copy."""
         if self.registry_lookup is not None:
             blk = self.registry_lookup(bid)
             if blk is not None:
                 with blk.lock:
-                    return blk.get_memory_block().to_bytes()
+                    mb = blk.get_memory_block()
+                # hand back the materialized buffer as a view, not bytes — the
+                # reply path then sends it without a second copy
+                return mb.host_view(), 0, int(mb.size)
         if self.store is not None:
             try:
                 return self.store.block_staging_view(
@@ -186,11 +196,12 @@ class BlockServer:
         return None
 
     def _assemble_reply(self, entries) -> Tuple[bytes, "np.ndarray"]:
-        """Build ``(sizes blob, one contiguous body)`` from resolved entries —
-        the reference's single pooled reply buffer (UcxWorkerWrapper.scala:397-448).
-        Store-backed views gather through the native threaded batch copy
-        (ts_batch_copy, the ForkJoin ioThreadPool analogue); only registry
-        blocks take the per-block bytes path."""
+        """Build ``(sizes blob, one contiguous body)`` from resolved views —
+        the reference's single pooled reply buffer (UcxWorkerWrapper.scala:397-448),
+        gathered through the native threaded batch copy (ts_batch_copy, the
+        ForkJoin ioThreadPool analogue).  Fallback for platforms without
+        ``socket.sendmsg``; the primary reply path is the vectored
+        ``_reply_parts`` + ``_sendmsg_all``, which skips this copy."""
         from sparkucx_tpu import native
 
         sizes, total = [], 0
@@ -198,33 +209,63 @@ class BlockServer:
             if e is None:
                 sizes.append(-1)
             else:
-                ln = len(e) if isinstance(e, bytes) else e[2]
-                sizes.append(ln)
-                total += ln
+                sizes.append(e[2])
+                total += e[2]
         body = np.empty(total, dtype=np.uint8)
         by_staging: Dict[int, Tuple[np.ndarray, list]] = {}
         pos = 0
         for e in entries:
             if e is None:
                 continue
-            if isinstance(e, bytes):
-                if e:
-                    body[pos : pos + len(e)] = np.frombuffer(e, dtype=np.uint8)
-                pos += len(e)
-            else:
-                staging, off, ln = e
-                if ln:
-                    key = id(staging)
-                    if key not in by_staging:
-                        by_staging[key] = (staging.reshape(-1).view(np.uint8), [])
-                    by_staging[key][1].append((pos, off, ln))
-                pos += ln
+            staging, off, ln = e
+            if ln:
+                key = id(staging)
+                if key not in by_staging:
+                    by_staging[key] = (staging.reshape(-1).view(np.uint8), [])
+                by_staging[key][1].append((pos, off, ln))
+            pos += ln
         for src, segs in by_staging.values():
             native.batch_copy(body, src, segs, max_threads=self.conf.num_io_threads)
         blob = b"".join(_SIZE.pack(s) for s in sizes)
         return blob, body
 
+    def _reply_parts(self, entries) -> Tuple[bytes, list, int]:
+        """(sizes blob, zero-copy body views in order, total bytes) — the
+        scatter-gather form of ``_assemble_reply``: store-backed views go to
+        the wire as memoryviews of the staging buffer itself, no intermediate
+        contiguous body is built (the kernel gathers via sendmsg iovecs —
+        the single-pooled-buffer copy of UcxWorkerWrapper.scala:397-448
+        replaced by vectored IO)."""
+        sizes, parts, total = [], [], 0
+        for e in entries:
+            if e is None:
+                sizes.append(-1)
+                continue
+            staging, off, ln = e
+            if ln:
+                parts.append(memoryview(staging.reshape(-1).view(np.uint8))[off : off + ln])
+            sizes.append(ln)
+            total += ln
+        return b"".join(_SIZE.pack(s) for s in sizes), parts, total
+
+    @staticmethod
+    def _sendmsg_all(conn: socket.socket, parts: list) -> None:
+        """sendall over an iovec list, handling partial sends and the
+        IOV_MAX window (1024 on Linux)."""
+        bufs = [memoryview(p) for p in parts if len(p)]
+        i = 0
+        while i < len(bufs):
+            sent = conn.sendmsg(bufs[i : i + 1024])
+            while sent > 0:
+                if sent >= bufs[i].nbytes:
+                    sent -= bufs[i].nbytes
+                    i += 1
+                else:
+                    bufs[i] = bufs[i][sent:]
+                    sent = 0
+
     def _serve_conn(self, conn: socket.socket) -> None:
+        use_sendmsg = hasattr(conn, "sendmsg")
         try:
             while self._running:
                 frame = recv_frame(conn)
@@ -237,6 +278,12 @@ class BlockServer:
                         entries = list(self._io.map(self._resolve_one, bids))
                     else:
                         entries = [self._resolve_one(b) for b in bids]
+                    if use_sendmsg:
+                        sizes, parts, total = self._reply_parts(entries)
+                        reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
+                        prefix = pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, total)
+                        self._sendmsg_all(conn, [prefix] + parts)
+                        continue
                     sizes, body = self._assemble_reply(entries)
                     reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
                     conn.sendall(
@@ -309,6 +356,10 @@ class _PeerConnection:
     ) -> None:
         self.sock = socket.create_connection(address, timeout=30)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:  # deep recv window to keep the scatter recv fed between polls
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
+        except OSError:
+            pass
         self.pending: Dict[int, Callable[[bytes, bytes], None]] = {}
         self.lock = threading.Lock()
         #: parked (am_id, header, body, scattered) frames; ``scattered`` marks

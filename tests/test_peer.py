@@ -226,9 +226,10 @@ class TestMultiProcess:
 
 
 class TestNativeReplyAssembly:
-    """Zero-copy reply assembly (_assemble_reply + block_staging_view): store
-    blocks gather through ts_batch_copy from host staging; registry blocks and
-    failures keep the bytes path."""
+    """Reply construction from zero-copy views (block_staging_view +
+    registry-materialized buffers): the vectored sendmsg parts (primary) and
+    the ts_batch_copy contiguous assembly (no-sendmsg fallback) must produce
+    identical bytes for mixed store/registry/empty/missing batches."""
 
     def test_mixed_sources_roundtrip(self):
         import numpy as np
@@ -268,6 +269,12 @@ class TestNativeReplyAssembly:
             assert sizes == (999, len(reg_payload), 0, -1, 300)
             got = bytes(body)
             assert got == p0 + reg_payload + b"z" * 300
+            # the vectored (sendmsg) form must be byte-identical to the
+            # assembled fallback
+            sizes_blob2, parts, total = srv._reply_parts(entries)
+            assert sizes_blob2 == sizes_blob
+            assert total == len(got)
+            assert b"".join(bytes(p) for p in parts) == got
         finally:
             srv.close()
 
