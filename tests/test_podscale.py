@@ -1,0 +1,125 @@
+"""Pod-scale compile/execute checks: the exchange and the full shuffle stack
+at 16 and 64 virtual executors (BASELINE.md north star: "scaling efficiency
+4→64 chips" — no multi-chip hardware exists here, so what CAN be validated is
+that the sharded programs compile and run correctly at pod device counts,
+including the 4-slice hierarchical route at 16).
+
+Each case runs in a subprocess because XLA_FLAGS' virtual device count is
+parsed once per process (the suite's conftest pins 8)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRELUDE = textwrap.dedent(
+    """
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count={n}"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sys; sys.path.insert(0, {root!r})
+    import numpy as np
+    """
+)
+
+
+def _run(n, body, timeout=240):
+    code = PRELUDE.format(n=n, root=ROOT) + textwrap.dedent(body)
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout, cwd=ROOT,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
+    assert "PODSCALE OK" in r.stdout, r.stdout
+
+
+class TestPodScale:
+    def test_flat_exchange_64_executors(self):
+        """One collective over a 64-executor mesh, skewed sizes vs oracle."""
+        _run(64, """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
+
+    n, slot = 64, 4
+    spec = ExchangeSpec(num_executors=n, send_rows=n * slot, recv_rows=n * slot, lane=128)
+    mesh = make_mesh(n)
+    fn = build_exchange(mesh, spec)
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(0, slot + 1, size=(n, n)).astype(np.int32)
+    data = rng.integers(-100, 100, size=(n * n * slot, 128), dtype=np.int32)
+    sh = NamedSharding(mesh, P("ex", None))
+    recv, rs = fn(jax.device_put(data, sh), jax.device_put(sizes, sh))
+    recv_h, rs_h = np.asarray(recv), np.asarray(rs)
+    assert (rs_h == sizes.T).all(), "receive-size matrix mismatch"
+    # oracle: receiver j gets, sender-major, each sender i's slot-j prefix
+    shards = data.reshape(n, n, slot, 128)
+    for j in range(0, n, 13):
+        expect = np.concatenate(
+            [shards[i, j, : sizes[i, j]] for i in range(n)]
+            + [np.zeros((n * slot - sizes[:, j].sum(), 128), np.int32)]
+        )
+        got = recv_h.reshape(n, n * slot, 128)[j]
+        assert (got == expect).all(), f"receiver {j} mismatch"
+    print("PODSCALE OK")
+    """)
+
+    def test_full_stack_16_executors_4_slices(self):
+        """The whole cluster stack (staging -> commit -> hierarchical 4x4
+        two-phase exchange -> fetch) at 16 executors vs oracle."""
+        _run(16, """
+    from jax.sharding import Mesh
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+    n = 16
+    mesh = Mesh(np.array(jax.devices()[:n]), ("ex",))
+    conf = TpuShuffleConf(
+        staging_capacity_per_executor=n * 2048, block_alignment=128,
+        num_executors=n, num_slices=4,
+    )
+    cluster = TpuShuffleCluster(conf, mesh=mesh)
+    M, R = n, 2 * n
+    meta = cluster.create_shuffle(0, M, R)
+    rng = np.random.default_rng(1)
+    oracle = {}
+    for m in range(M):
+        t = cluster.transport(meta.map_owner[m])
+        w = t.store.map_writer(0, m)
+        for r in range(R):
+            payload = rng.integers(0, 256, size=int(rng.integers(1, 300)), dtype=np.uint8).tobytes()
+            oracle[(m, r)] = payload
+            w.write_partition(r, payload)
+        t.commit_block(w.commit().pack())
+    cluster.run_exchange(0)
+    for (m, r), expect in oracle.items():
+        consumer = meta.owner_of_reduce(r)
+        view, ln = cluster.locate_received_block(consumer, 0, m, r)
+        assert view.tobytes() == expect, f"mismatch map={m} reduce={r}"
+    cluster.remove_shuffle(0)
+    print("PODSCALE OK")
+    """)
+
+    def test_distributed_sort_32_executors(self):
+        """Sample sort over 32 executors vs the host oracle."""
+        _run(32, """
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.sort import SortSpec, oracle_sort, run_distributed_sort
+
+    n, cap = 32, 64
+    mesh = make_mesh(n)
+    spec = SortSpec(num_executors=n, capacity=cap, recv_capacity=3 * cap, width=2,
+                    samples_per_shard=n)
+    rng = np.random.default_rng(2)
+    total = n * cap - 37  # uneven fill
+    keys = rng.integers(0, 1 << 32, size=total, dtype=np.uint64).astype(np.uint32)
+    payload = rng.integers(-50, 50, size=(total, 2)).astype(np.int32)
+    sk, sp = run_distributed_sort(mesh, spec, keys, payload)
+    ek, ep = oracle_sort(keys, payload)
+    assert (sk == ek).all()
+    assert (sp == ep).all()
+    print("PODSCALE OK")
+    """)
