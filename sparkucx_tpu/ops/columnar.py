@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkucx_tpu.ops.exchange import exclusive_cumsum, ragged_params
+from sparkucx_tpu.ops.exchange import exclusive_cumsum, gather_rows, ragged_params
 
 
 @dataclass(frozen=True)
@@ -78,7 +78,7 @@ def size_matrix_from_owners(axis_name: str, num_executors: int, owners: jnp.ndar
 def _sort_and_sizes(spec: ColumnarSpec, rows: jnp.ndarray, owners: jnp.ndarray):
     """Sort rows by destination executor; gather the global size matrix."""
     order = jnp.argsort(owners, stable=True)  # padding (owner == n) sorts last
-    sorted_rows = rows[order]
+    sorted_rows = gather_rows(rows, order)
     sorted_owners = owners[order]
     _, send_sizes, recv_sizes, output_offsets = size_matrix_from_owners(
         spec.axis_name, spec.num_executors, owners
@@ -125,7 +125,7 @@ def columnar_shard_dense(spec: ColumnarSpec, payload, send_sizes, recv_sizes, ou
     sender = jnp.clip(jnp.searchsorted(cum, pos, side="right").astype(jnp.int32), 0, n - 1)
     gsrc = sender * slot + (pos - rstarts[sender])
     ok = pos < total
-    gathered = flat[jnp.clip(gsrc, 0, n * slot - 1)]
+    gathered = gather_rows(flat, jnp.clip(gsrc, 0, n * slot - 1))
     out = jnp.where(ok[:, None], gathered, jnp.zeros((), dtype=payload.dtype))
     return out, recv_sizes
 

@@ -63,6 +63,35 @@ def exclusive_cumsum(x, axis: int = -1, xp=jnp):
     return xp.cumsum(x, axis=axis) - x
 
 
+#: Lane-width band where XLA:TPU lowers a row gather ~4x slower than adjacent
+#: widths (mapped empirically on v5e: 8/16/24 lanes and >=100 are fast,
+#: 25..32 fall off a tiling cliff — docs/PERF.md).  Gathers whose width lands
+#: in the band are chunked into <=24-lane column slices, each of which lowers
+#: on the fast path; chunking a fast width makes it WORSE (W=100 chunked
+#: measured 3x slower), hence the band guard rather than chunking everything.
+SLOW_GATHER_LANES = (25, 32)
+_GATHER_CHUNK = 24
+
+
+def gather_rows(rows: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``rows[idx]`` (1-D row index) with the TPU slow-band lane chunking.
+
+    The cliff is an XLA:TPU artifact, so non-TPU backends always take the
+    plain gather.  ``jax.default_backend()`` is a trace-time proxy for the
+    mesh platform — exact for every in-tree caller (meshes are built over the
+    default backend's devices)."""
+    w = rows.shape[1]
+    if (
+        SLOW_GATHER_LANES[0] <= w <= SLOW_GATHER_LANES[1]
+        and jax.default_backend() == "tpu"
+    ):
+        return jnp.concatenate(
+            [rows[:, i : i + _GATHER_CHUNK][idx] for i in range(0, w, _GATHER_CHUNK)],
+            axis=1,
+        )
+    return rows[idx]
+
+
 @dataclass(frozen=True)
 class ExchangeSpec:
     """Static description of one compiled exchange.

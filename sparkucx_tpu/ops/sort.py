@@ -45,6 +45,7 @@ from sparkucx_tpu.ops.columnar import (
     columnar_shard_ragged,
     size_matrix_from_owners,
 )
+from sparkucx_tpu.ops.exchange import gather_rows
 
 KEY_MAX = np.uint32(0xFFFFFFFF)  # padding sentinel; sorts last
 
@@ -140,7 +141,7 @@ def _sort_body(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, num_vali
     keys = jnp.where(idx < nv, keys, KEY_MAX)
     order = jnp.argsort(keys)
     skeys = keys[order]
-    spay = payload[order]
+    spay = gather_rows(payload, order)
 
     # 2. Splitters -> per-row destination executor (padding rows -> n, never sent).
     splitters = _global_splitters(spec, skeys, nv)
@@ -172,7 +173,7 @@ def _sort_body(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, num_vali
     rkeys = jnp.where(ridx < total, rkeys, KEY_MAX)
     rorder = jnp.argsort(rkeys)
     out_keys = rkeys[rorder]
-    out_pay = recv[:, 1:][rorder]
+    out_pay = gather_rows(recv[:, 1:], rorder)
     return out_keys, out_pay, total[None]
 
 
@@ -191,7 +192,7 @@ def _sort_body_single(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, n
     # valid rows sort to the front (stable argsort, padding keys KEY_MAX), so
     # zeroing the tail matches the collective lowerings' output contract —
     # the caller's padding payload must not leak through the permutation
-    out_pay = jnp.where((idx < nv)[:, None], payload[order], 0)
+    out_pay = jnp.where((idx < nv)[:, None], gather_rows(payload, order), 0)
     pad = spec.recv_capacity - spec.capacity
     if pad:
         out_keys = jnp.concatenate([out_keys, jnp.full(pad, KEY_MAX, jnp.uint32)])

@@ -35,6 +35,9 @@ Modes:
   100 B (uint32 key + 24 int32 lanes) through sample-sort over ``--executors``
   devices; prints M rows/s.  The on-device analogue of the reference harness's
   TeraSort workload (BASELINE.json configs[1]).
+* ``columnar`` — time the device-resident columnar shuffle (ops/columnar.py,
+  the GpuColumnarExchange analogue; BASELINE.json columnar config): -n rows of
+  -s bytes repartitioned in HBM by a random owner vector; prints GB/s.
 """
 
 from __future__ import annotations
@@ -55,7 +58,9 @@ from sparkucx_tpu.transport.peer import PeerTransport
 
 def _parse_args(argv):
     p = argparse.ArgumentParser(prog="sparkucx-tpu-perf", description=__doc__.split("\n")[0])
-    p.add_argument("mode", choices=["server", "client", "superstep", "gather", "sort"])
+    p.add_argument(
+        "mode", choices=["server", "client", "superstep", "gather", "sort", "columnar"]
+    )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
     p.add_argument("-f", "--file", default=None, help="file to serve blocks from (server)")
     p.add_argument("-n", "--num-blocks", type=int, default=8)
@@ -328,6 +333,78 @@ def measure_sort(
     return best
 
 
+def measure_columnar(
+    executors: int, total_rows: int, width: int, iterations: int,
+    outstanding: int = 8, report=None,
+) -> float:
+    """Measurement core of the ``columnar`` mode — the device-resident columnar
+    shuffle (the GpuColumnarExchange analogue, ops/columnar.py): rows already
+    in HBM are repartitioned by a random owner vector, no host round-trip.
+    Returns best GB/s of rows moved; ``report(it, seconds, bytes, impl)`` per
+    iteration."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.columnar import ColumnarSpec, build_columnar_shuffle
+    from sparkucx_tpu.ops.exchange import make_mesh
+
+    n = executors
+    cap = -(-total_rows // n)
+    # worst-case skew headroom: all rows could land on one executor only when
+    # n == 1; for n > 1 use 2x balanced (random owners stay well inside it)
+    spec = ColumnarSpec(
+        num_executors=n, capacity=cap,
+        recv_capacity=cap if n == 1 else 2 * cap, width=width,
+    )
+    mesh = make_mesh(n)
+    fn = build_columnar_shuffle(mesh, spec)
+    rng = np.random.default_rng(0)
+    rows = jax.device_put(
+        rng.normal(size=(n * cap, width)).astype(np.float32),
+        NamedSharding(mesh, P("ex", None)),
+    )
+    owners = jax.device_put(
+        rng.integers(0, n, size=n * cap).astype(np.int32),
+        NamedSharding(mesh, P("ex")),
+    )
+    recv, counts = fn(rows, owners)
+    jax.block_until_ready(recv)  # compile
+    assert int(np.asarray(counts).sum()) == n * cap, "columnar shuffle dropped rows"
+    moved = n * cap * width * 4
+    best = 0.0
+    for it in range(iterations):
+        t0 = time.perf_counter()
+        for _ in range(outstanding):
+            recv, counts = fn(rows, owners)
+        jax.block_until_ready(recv)
+        np.asarray(recv[0, :1])  # force completion through async tunnels
+        dt = time.perf_counter() - t0
+        tot = moved * outstanding
+        best = max(best, tot / dt / 1e9)
+        if report is not None:
+            report(it, dt, tot, fn.spec.impl)
+    return best
+
+
+def run_columnar(args) -> None:
+    width = max(1, parse_size(args.block_size) // 4)  # -s = row bytes
+
+    def report(it, dt, tot, impl):
+        print(
+            f"iter {it}: {tot} bytes of {width * 4} B rows in {dt*1e3:.1f} ms = "
+            f"{tot / dt / 1e9:.2f} GB/s [impl={impl}]",
+            flush=True,
+        )
+
+    measure_columnar(
+        args.executors, args.num_blocks, width, args.iterations,
+        outstanding=args.outstanding, report=report,
+    )
+
+
 def run_sort(args) -> None:
     def report(it, dt, rows, impl):
         print(
@@ -353,6 +430,8 @@ def main(argv=None) -> None:
         run_gather(args)
     elif args.mode == "sort":
         run_sort(args)
+    elif args.mode == "columnar":
+        run_columnar(args)
     else:
         run_superstep(args)
 

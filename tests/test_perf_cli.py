@@ -86,6 +86,18 @@ def test_sort_mode(capsys):
     assert "rows/s" in out and out.count("iter") == 2
 
 
+def test_columnar_mode(capsys):
+    benchmark.run_columnar(
+        benchmark._parse_args(
+            ["columnar", "-n", "4096", "-s", "128", "-i", "2", "-o", "2",
+             "--executors", "4"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "impl=dense" in out  # CPU resolves to the portable lowering
+    assert out.count("GB/s") == 2
+
+
 def test_superstep_hierarchical_mode(capsys):
     benchmark.run_superstep(
         benchmark._parse_args(
