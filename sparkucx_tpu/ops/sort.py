@@ -212,7 +212,12 @@ def build_distributed_sort(mesh: Mesh, spec: SortSpec):
     * ``keys_out``: (n * recv_capacity,) uint32 — shard j = j-th global key
       range, ascending; concatenating valid prefixes in mesh order yields the
       fully sorted keys.  Padding tail is KEY_MAX.
-    * ``payload_out``: rows permuted identically to ``keys_out``;
+    * ``payload_out``: rows permuted identically to ``keys_out``.  The sort is
+      **stable**: rows with equal keys keep their global input order (this is
+      a contract, not an accident — the n=1 lowering's padding handling
+      already requires stable argsort, the exchange lands senders in rank
+      order, and the differential fuzz asserts row-exact agreement with
+      ``np.argsort(kind='stable')`` under heavy duplication);
     * ``counts``: (n,) int32 — valid rows per output shard.  Any value >
       ``recv_capacity`` means splitter skew overflowed the headroom; re-run
       with a larger ``recv_capacity``.

@@ -74,3 +74,38 @@ def _run_case(seed: int) -> None:
 @pytest.mark.parametrize("seed", range(20))
 def test_random_shuffle_shapes(seed):
     _run_case(seed)
+
+
+def _run_sort_case(seed: int) -> None:
+    """Differential fuzz for the distributed sort: random executor counts,
+    fills, widths (crossing the 25-32-lane gather band), and key skew (down
+    to single-valued keys, which exercises the recv_capacity doubling retry
+    in run_distributed_sort)."""
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.sort import SortSpec, oracle_sort, run_distributed_sort
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.choice([1, 2, 4, 8]))
+    cap = int(rng.integers(8, 200))
+    width = int(rng.choice([1, 4, 24, 25, 32]))
+    total = int(rng.integers(1, n * cap + 1))
+    distinct = int(rng.choice([1, 2, 50, 1 << 32]))
+    spec = SortSpec(
+        num_executors=n,
+        capacity=cap,
+        recv_capacity=int(cap * rng.choice([1, 2, 3])) if n == 1 else 2 * cap,
+        width=width,
+        samples_per_shard=max(n, int(rng.choice([8, 64]))),
+    )
+    keys = rng.integers(0, distinct, size=total, dtype=np.uint64).astype(np.uint32)
+    payload = rng.integers(-100, 100, size=(total, width)).astype(np.int32)
+    mesh = make_mesh(n)
+    sk, sp = run_distributed_sort(mesh, spec, keys, payload, max_attempts=6)
+    ek, ep = oracle_sort(keys, payload)
+    assert (sk == ek).all(), f"seed={seed} n={n} cap={cap} w={width} distinct={distinct}"
+    assert (sp == ep).all(), f"seed={seed} payload rows diverged"
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_sort_shapes(seed):
+    _run_sort_case(seed)
