@@ -38,6 +38,10 @@ class AmId(enum.IntEnum):
 _FRAME = struct.Struct("<IQQ")
 FRAME_HEADER_SIZE = _FRAME.size
 
+#: Frame size ceiling shared by every frame-reading loop (peer plane + daemon):
+#: a corrupt/hostile header claiming a huge length is dropped, never streamed.
+MAX_FRAME_BYTES = 1 << 31
+
 #: FetchBlockReq header: (shuffleId, mapId, reduceId) — 12 bytes, matching the
 #: reference's header layout (UcxWorkerWrapper.scala:96-126).
 _FETCH_REQ = struct.Struct("<iii")

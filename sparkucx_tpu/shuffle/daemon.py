@@ -39,7 +39,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf
-from sparkucx_tpu.core.definitions import FRAME_HEADER_SIZE, AmId, pack_frame
+from sparkucx_tpu.core.definitions import FRAME_HEADER_SIZE, MAX_FRAME_BYTES, AmId, pack_frame
 from sparkucx_tpu.shuffle.manager import TpuShuffleManager
 from sparkucx_tpu.transport.peer import recv_exact, recv_frame, pack_batch_fetch_req, unpack_batch_fetch_req
 import struct
@@ -72,6 +72,8 @@ def _read_frame(sock) -> Optional[Tuple[int, dict, bytes]]:
     if hdr is None:
         return None
     op, hlen, blen = struct.unpack("<IQQ", hdr)
+    if hlen + blen > MAX_FRAME_BYTES:
+        raise ValueError(f"frame too large ({hlen + blen} B)")
     header = recv_exact(sock, hlen) if hlen else b""
     body = recv_exact(sock, blen) if blen else b""
     if (hlen and header is None) or (blen and body is None):
@@ -135,7 +137,10 @@ class ShuffleDaemon:
                     self._dispatch(conn, op, meta, body)
                 except Exception as e:
                     self._ack(conn, False, error=f"{type(e).__name__}: {e}")
-        except OSError:
+        except (OSError, ValueError):
+            # dead socket or an unparseable/oversized frame: drop THIS
+            # connection, keep serving others (the endpoint-eviction policy,
+            # UcxWorkerWrapper.scala:248-253)
             pass
         finally:
             conn.close()

@@ -35,6 +35,7 @@ from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import Block, BlockId, MemoryBlock, ShuffleBlockId
 from sparkucx_tpu.core.definitions import (
     FRAME_HEADER_SIZE,
+    MAX_FRAME_BYTES,
     AmId,
     MapperInfo,
     pack_frame,
@@ -60,7 +61,7 @@ _TAG = struct.Struct("<Q")
 _COUNT = struct.Struct("<I")
 _TRIPLE = struct.Struct("<iii")
 _SIZE = struct.Struct("<q")
-_MAX_FRAME = 1 << 31
+_MAX_FRAME = MAX_FRAME_BYTES  # shared frame ceiling (core/definitions.py)
 
 
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -5,7 +5,7 @@ import pytest
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import ShuffleBlockId
-from sparkucx_tpu.shuffle.daemon import DaemonClient, ShuffleDaemon
+from sparkucx_tpu.shuffle.daemon import DaemonClient, DaemonOp, ShuffleDaemon
 
 
 @pytest.fixture(scope="module")
@@ -101,6 +101,59 @@ class TestDaemonFlow:
             payload += s.recv(hlen - len(payload))
         assert b'"ok": false' in payload
         s.close()
+
+    def test_hostile_frames_cannot_take_the_daemon_down(self, daemon):
+        """Protocol fuzz at the Spark-facing boundary: oversized length
+        claims, truncated frames, garbage headers, and random byte storms
+        each cost at most their own connection — the daemon keeps serving
+        well-formed clients afterwards (the endpoint-eviction policy,
+        UcxWorkerWrapper.scala:248-253)."""
+        import socket
+        import struct
+
+        rng = np.random.default_rng(0)
+        hostile = [
+            # oversized header+body claim (the _MAX_FRAME guard): must be
+            # dropped without streaming terabytes
+            struct.pack("<IQQ", DaemonOp.CREATE_SHUFFLE, 1 << 60, 1 << 60),
+            # truncated: header promises more bytes than ever arrive
+            struct.pack("<IQQ", DaemonOp.CREATE_SHUFFLE, 64, 0) + b"{\"x\"",
+            # valid frame layout, unparseable JSON header
+            struct.pack("<IQQ", DaemonOp.CREATE_SHUFFLE, 7, 0) + b"not-js}",
+            # random byte storm (may parse as a huge claim or garbage op)
+            rng.integers(0, 256, size=333, dtype=np.uint8).tobytes(),
+            # shorter than one frame header
+            b"\x01\x02\x03",
+        ]
+        for i, frame in enumerate(hostile):
+            s = socket.create_connection(daemon.address, timeout=5)
+            try:
+                s.settimeout(5)
+                # the daemon may RST mid-send/shutdown when it drops the
+                # connection — that reset IS the expected eviction behavior
+                try:
+                    s.sendall(frame)
+                    s.shutdown(socket.SHUT_WR)
+                    while s.recv(4096):  # drain any reply, bounded
+                        pass
+                except (socket.timeout, OSError):
+                    pass
+            finally:
+                s.close()
+            # after each hostile connection, a fresh well-formed client works
+            probe = DaemonClient(daemon.address)
+            try:
+                sid = 900 + i
+                probe.create_shuffle(sid, 1, 1)
+                w = probe.open_map_writer(sid, 0)
+                probe.write_partition(w, 0, b"still-alive")
+                probe.commit_map(w)
+                probe.run_exchange(sid)
+                [blk] = probe.fetch_blocks([ShuffleBlockId(sid, 0, 0)])
+                assert blk == b"still-alive"
+                probe.remove_shuffle(sid)
+            finally:
+                probe.close()
 
 
 class TestGoldenWireFixtures:
