@@ -61,7 +61,10 @@ CHAIN = int(os.environ.get("BENCH_CHAIN", "256"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 TCP_BYTES = int(os.environ.get("BENCH_TCP_BYTES", str(256 << 20)))
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "30"))
-PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
+# 4 attempts with backoff (~2.5 min worst case, well inside DEADLINE): the
+# tunnel flaps for minutes at a time and a single-probe failure would record
+# a round with no TPU number at all
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "4"))
 DEADLINE = float(os.environ.get("BENCH_DEADLINE", "720"))
 SKIP_SUBMETRICS = os.environ.get("BENCH_SKIP_SUBMETRICS", "") == "1"
 

@@ -14,6 +14,7 @@ from sparkucx_tpu.ops.columnar import (
 from sparkucx_tpu.ops.exchange import (
     ExchangeSpec,
     build_exchange,
+    gather_rows,
     make_mesh,
     oracle_exchange,
     pack_chunks_slots,
@@ -51,6 +52,7 @@ __all__ = [
     "run_columnar_shuffle",
     "ExchangeSpec",
     "build_exchange",
+    "gather_rows",
     "make_mesh",
     "oracle_exchange",
     "pack_chunks_slots",
