@@ -324,7 +324,15 @@ def main():
         except Exception as e:
             RESULT["gather_xla_error"] = f"{type(e).__name__}: {e}"[:200]
         try:
-            RESULT["sort_mrows_s"] = round(measure_sort(1, 1 << 21, REPEATS), 3)
+            sort_impls = []
+            RESULT["sort_mrows_s"] = round(
+                measure_sort(
+                    1, 1 << 21, REPEATS,
+                    report=lambda it, dt, rows, impl: sort_impls.append(impl),
+                ), 3,
+            )
+            if sort_impls:  # report never fires when BENCH_REPEATS=0
+                RESULT["sort_impl"] = sort_impls[-1]
         except Exception as e:
             RESULT["sort_error"] = f"{type(e).__name__}: {e}"[:200]
 

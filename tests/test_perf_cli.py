@@ -106,3 +106,21 @@ def test_superstep_hierarchical_mode(capsys):
     )
     out = capsys.readouterr().out
     assert out.count("GB/s") == 1
+
+
+def test_tpu_smoke_script():
+    """The hardware acceptance smoke must pass on the CI mesh (dense/xla
+    lowerings) — the same script gates real-chip deployments."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "tpu_smoke.py")],
+        capture_output=True, text=True, timeout=300, cwd=root,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all 6 drives passed" in r.stdout
