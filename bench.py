@@ -262,6 +262,12 @@ def main():
     if platform is None:
         RESULT["tpu"] = None
         RESULT["error"] = f"backend unreachable: {probe_err}"
+        # honest provenance for a null round: where the last in-session
+        # hardware measurements live (the tunnel drops for hours at a time)
+        RESULT["note"] = (
+            "chip tunnel down at bench time; in-session measured numbers and "
+            "their configs are recorded in docs/PERF.md"
+        )
         emit_once()
         return
     RESULT["platform"] = platform
