@@ -300,7 +300,11 @@ def main():
         RESULT["error"] = f"{type(e).__name__}: {e}"[:300]
 
     if not SKIP_SUBMETRICS and RESULT["value"] is not None:
-        from sparkucx_tpu.perf.benchmark import measure_gather, measure_sort
+        from sparkucx_tpu.perf.benchmark import (
+            measure_gather,
+            measure_groupby,
+            measure_sort,
+        )
 
         # Gather: the documented config (256 x 2 MiB blocks — docs/PERF.md) with
         # the Pallas DMA lowering REQUESTED EXPLICITLY and the executed lowering
@@ -341,6 +345,22 @@ def main():
                 RESULT["sort_impl"] = sort_impls[-1]
         except Exception as e:
             RESULT["sort_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            # GROUP BY — the reference's gate workload (GroupByTest,
+            # buildlib/test.sh:163-173) as one on-device hash-exchange +
+            # segment-reduce step; 2M x 100 B rows, 100-key keyspace like the
+            # small gate's.
+            gb_impls = []
+            RESULT["groupby_mrows_s"] = round(
+                measure_groupby(
+                    1, 1 << 21, REPEATS,
+                    report=lambda it, dt, rows, impl: gb_impls.append(impl),
+                ), 3,
+            )
+            if gb_impls:
+                RESULT["groupby_impl"] = gb_impls[-1]
+        except Exception as e:
+            RESULT["groupby_error"] = f"{type(e).__name__}: {e}"[:200]
 
     emit_once()
 

@@ -38,6 +38,12 @@ Modes:
 * ``columnar`` — time the device-resident columnar shuffle (ops/columnar.py,
   the GpuColumnarExchange analogue; BASELINE.json columnar config): -n rows of
   -s bytes repartitioned in HBM by a random owner vector; prints GB/s.
+* ``groupby`` — time the device-resident GROUP BY (ops/relational.py): -n rows
+  of 100 B (uint32 key from ``--keys`` distinct values + 24 summed int32
+  lanes) through hash exchange + segment reduction over ``--executors``
+  devices; prints M rows/s.  The on-device analogue of the workload the
+  reference gates on — ``GroupByTest`` generates random (key, value) pairs and
+  groups them by key (buildlib/test.sh:163-173, BASELINE.json configs[0]).
 """
 
 from __future__ import annotations
@@ -59,7 +65,8 @@ from sparkucx_tpu.transport.peer import PeerTransport
 def _parse_args(argv):
     p = argparse.ArgumentParser(prog="sparkucx-tpu-perf", description=__doc__.split("\n")[0])
     p.add_argument(
-        "mode", choices=["server", "client", "superstep", "gather", "sort", "columnar"]
+        "mode",
+        choices=["server", "client", "superstep", "gather", "sort", "columnar", "groupby"],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
     p.add_argument("-f", "--file", default=None, help="file to serve blocks from (server)")
@@ -77,6 +84,10 @@ def _parse_args(argv):
     p.add_argument(
         "--impl", default="auto", choices=["auto", "dma", "tiled", "xla"],
         help="block-gather lowering (gather mode)",
+    )
+    p.add_argument(
+        "--keys", type=int, default=100,
+        help="distinct group keys (groupby mode; GroupByTest's numKVPairs keyspace)",
     )
     return p.parse_args(argv)
 
@@ -389,6 +400,92 @@ def measure_columnar(
     return best
 
 
+def measure_groupby(
+    executors: int, total_rows: int, iterations: int,
+    outstanding: int = 8, num_keys: int = 100, report=None,
+) -> float:
+    """Measurement core of the ``groupby`` mode — the device-resident GROUP BY
+    (100 B rows: uint32 key + 24 summed int32 lanes; the GroupByTest workload
+    shape, BASELINE.json configs[0]).  Returns best M input rows/s;
+    ``report(it, seconds, rows, impl)`` per iteration.  Shared by the CLI and
+    bench.py like measure_sort."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.relational import AggregateSpec, build_grouped_aggregate
+
+    n = executors
+    cap = -(-total_rows // n)
+    # hash placement headroom: rows land ~total/n per shard for a uniform
+    # keyspace; 2x absorbs key skew when n > 1 (n == 1 receives everything)
+    spec = AggregateSpec(
+        num_executors=n, capacity=cap, recv_capacity=cap if n == 1 else 2 * cap,
+        aggs=("sum",) * 24,
+    )
+    mesh = make_mesh(n)
+    fn = build_grouped_aggregate(mesh, spec)
+    rng = np.random.default_rng(0)
+    host_keys = rng.integers(0, num_keys, size=n * cap).astype(np.uint32)
+    keys = jax.device_put(host_keys, NamedSharding(mesh, P("ex")))
+    # zeros like measure_sort's payload: the aggregation cost is value-
+    # independent, and 200 MB of random host data would crawl through remote
+    # device tunnels (the keys, which steer the exchange, stay random)
+    values = jax.device_put(
+        np.zeros((n * cap, 24), np.int32), NamedSharding(mesh, P("ex", None))
+    )
+    nv = jax.device_put(np.full(n, cap, np.int32), NamedSharding(mesh, P("ex")))
+    out = jax.block_until_ready(fn(keys, values, nv))  # compile
+    # overflow guard first (measure_sort's "dropped rows" check): hash skew
+    # past the 2x headroom truncates shards — and can drop whole keys, which
+    # would otherwise fire the group-count assert with a misleading message
+    recv_totals = np.asarray(out[4])
+    assert (recv_totals <= spec.recv_capacity).all(), (
+        f"hash skew overflowed recv_capacity ({recv_totals.max()} > "
+        f"{spec.recv_capacity}): use more --keys or fewer executors"
+    )
+    rows_aggregated = int(np.asarray(out[2]).sum())
+    assert rows_aggregated == n * cap, (
+        f"groupby dropped rows ({rows_aggregated} != {n * cap})"
+    )
+    got_groups = int(np.asarray(out[3]).sum())
+    want_groups = len(np.unique(host_keys))
+    assert got_groups == want_groups, (
+        f"groupby produced {got_groups} groups, expected {want_groups}"
+    )
+    best = 0.0
+    for it in range(iterations):
+        t0 = time.perf_counter()
+        for _ in range(outstanding):
+            out = fn(keys, values, nv)
+        jax.block_until_ready(out)
+        np.asarray(out[0][:4])  # force completion through async tunnels
+        dt = time.perf_counter() - t0
+        rows = outstanding * n * cap
+        best = max(best, rows / dt / 1e6)
+        if report is not None:
+            report(it, dt, rows, fn.spec.impl)
+    return best
+
+
+def run_groupby(args) -> None:
+    def report(it, dt, rows, impl):
+        print(
+            f"iter {it}: grouped {rows} x 100 B rows in {dt*1e3:.1f} ms = "
+            f"{rows / dt / 1e6:.2f} M rows/s ({rows * 100 / dt / 1e9:.2f} GB/s) "
+            f"[impl={impl}]",
+            flush=True,
+        )
+
+    measure_groupby(
+        args.executors, args.num_blocks, args.iterations,
+        outstanding=args.outstanding, num_keys=args.keys, report=report,
+    )
+
+
 def run_columnar(args) -> None:
     width = max(1, parse_size(args.block_size) // 4)  # -s = row bytes
 
@@ -432,6 +529,8 @@ def main(argv=None) -> None:
         run_sort(args)
     elif args.mode == "columnar":
         run_columnar(args)
+    elif args.mode == "groupby":
+        run_groupby(args)
     else:
         run_superstep(args)
 

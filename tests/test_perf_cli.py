@@ -86,6 +86,17 @@ def test_sort_mode(capsys):
     assert "rows/s" in out and out.count("iter") == 2
 
 
+def test_groupby_mode(capsys):
+    benchmark.run_groupby(
+        benchmark._parse_args(
+            ["groupby", "-n", "4096", "-i", "2", "-o", "2", "--executors", "4",
+             "--keys", "64"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "rows/s" in out and out.count("iter") == 2
+
+
 def test_columnar_mode(capsys):
     benchmark.run_columnar(
         benchmark._parse_args(
