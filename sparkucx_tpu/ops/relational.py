@@ -59,6 +59,14 @@ def hash_owners(keys: jnp.ndarray, num_executors: int, valid: jnp.ndarray) -> jn
     return jnp.where(valid, owner, num_executors)
 
 
+def hash_owners_host(keys: "np.ndarray", num_executors: int) -> "np.ndarray":
+    """Host-side twin of :func:`hash_owners` (bit-identical placement, numpy
+    uint32 wraparound) — lets drivers plan receive capacities from the actual
+    key distribution instead of guessing skew headroom."""
+    mixed = (keys.astype(np.uint32) * _HASH_MULT) >> np.uint32(16)
+    return (mixed % np.uint32(num_executors)).astype(np.int32)
+
+
 def padded_keys(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """Force padding rows to the KEY_MAX sentinel so they sort last."""
     return jnp.where(valid, keys.astype(jnp.uint32), KEY_MAX)

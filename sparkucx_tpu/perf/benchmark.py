@@ -44,6 +44,10 @@ Modes:
   devices; prints M rows/s.  The on-device analogue of the workload the
   reference gates on — ``GroupByTest`` generates random (key, value) pairs and
   groups them by key (buildlib/test.sh:163-173, BASELINE.json configs[0]).
+* ``join`` — time the device-resident hash join (ops/relational.py): a PK-FK
+  inner join in the TPC-H shape (BASELINE.json configs[2]) — ``--build-rows``
+  dimension rows (unique keys, 8 int32 lanes) probed by -n fact rows (16
+  lanes), both sides hash-exchanged then matched; prints M probe rows/s.
 """
 
 from __future__ import annotations
@@ -66,7 +70,10 @@ def _parse_args(argv):
     p = argparse.ArgumentParser(prog="sparkucx-tpu-perf", description=__doc__.split("\n")[0])
     p.add_argument(
         "mode",
-        choices=["server", "client", "superstep", "gather", "sort", "columnar", "groupby"],
+        choices=[
+            "server", "client", "superstep", "gather", "sort", "columnar",
+            "groupby", "join",
+        ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
     p.add_argument("-f", "--file", default=None, help="file to serve blocks from (server)")
@@ -88,6 +95,10 @@ def _parse_args(argv):
     p.add_argument(
         "--keys", type=int, default=100,
         help="distinct group keys (groupby mode; GroupByTest's numKVPairs keyspace)",
+    )
+    p.add_argument(
+        "--build-rows", type=int, default=0,
+        help="dimension-side rows (join mode); 0 means -n // 4",
     )
     return p.parse_args(argv)
 
@@ -486,6 +497,103 @@ def run_groupby(args) -> None:
     )
 
 
+def measure_join(
+    executors: int, probe_rows: int, build_rows: int, iterations: int,
+    outstanding: int = 8, report=None,
+) -> float:
+    """Measurement core of the ``join`` mode — the device-resident PK-FK hash
+    join (TPC-H's plan shape, BASELINE.json configs[2]): ``build_rows``
+    dimension rows with globally unique keys, ``probe_rows`` fact rows each
+    referencing one of them, so every probe row matches exactly once and the
+    oracle check is just the row count.  Returns best M probe rows/s;
+    ``report(it, seconds, rows, impl)`` per iteration."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.relational import JoinSpec, build_hash_join
+
+    from sparkucx_tpu.ops.relational import hash_owners_host
+
+    n = executors
+    build_rows = build_rows or probe_rows // 4  # the CLI's documented default
+    pcap = -(-probe_rows // n)
+    bcap = -(-max(build_rows, n) // n)
+    rng = np.random.default_rng(0)
+    nb = n * bcap
+    bkeys_h = rng.permutation(nb).astype(np.uint32)  # unique PKs, shuffled
+    pkeys_h = bkeys_h[rng.integers(0, nb, size=n * pcap)]  # FKs into them
+    # Size receive buffers from the ACTUAL hash placement (host twin of the
+    # device hash): per-shard key granularity can concentrate rows well past
+    # any fixed headroom when the build keyspace is small relative to n.
+    # The asserts below then guard host/device placement agreement, not luck.
+    brecv = int(np.bincount(hash_owners_host(bkeys_h, n), minlength=n).max())
+    precv = int(np.bincount(hash_owners_host(pkeys_h, n), minlength=n).max())
+    spec = JoinSpec(
+        num_executors=n,
+        build_capacity=bcap, build_recv_capacity=brecv, build_width=8,
+        probe_capacity=pcap, probe_recv_capacity=precv, probe_width=16,
+        out_capacity=precv,
+    )
+    mesh = make_mesh(n)
+    fn = build_hash_join(mesh, spec)
+    key_sh = NamedSharding(mesh, P("ex"))
+    row_sh = NamedSharding(mesh, P("ex", None))
+    bkeys = jax.device_put(bkeys_h, key_sh)
+    bvals = jax.device_put(np.zeros((nb, 8), np.int32), row_sh)
+    bnum = jax.device_put(np.full(n, bcap, np.int32), key_sh)
+    pkeys = jax.device_put(pkeys_h, key_sh)
+    pvals = jax.device_put(np.zeros((n * pcap, 16), np.int32), row_sh)
+    pnum = jax.device_put(np.full(n, pcap, np.int32), key_sh)
+    out = jax.block_until_ready(fn(bkeys, bvals, bnum, pkeys, pvals, pnum))
+    recv_totals = np.asarray(out[4])  # (n, 2) true (build, probe) per shard
+    assert (recv_totals[:, 0] <= spec.build_recv_capacity).all() and (
+        recv_totals[:, 1] <= spec.probe_recv_capacity
+    ).all(), (
+        f"hash skew overflowed a receive buffer (max build "
+        f"{recv_totals[:, 0].max()}/{spec.build_recv_capacity}, probe "
+        f"{recv_totals[:, 1].max()}/{spec.probe_recv_capacity})"
+    )
+    counts = np.asarray(out[3])
+    assert (counts <= spec.out_capacity).all(), (
+        f"join output overflowed out_capacity ({counts.max()} > {spec.out_capacity})"
+    )
+    matches = int(counts.sum())
+    assert matches == n * pcap, (
+        f"PK-FK join matched {matches} rows, expected {n * pcap}"
+    )
+    best = 0.0
+    for it in range(iterations):
+        t0 = time.perf_counter()
+        for _ in range(outstanding):
+            out = fn(bkeys, bvals, bnum, pkeys, pvals, pnum)
+        jax.block_until_ready(out)
+        np.asarray(out[0][:4])  # force completion through async tunnels
+        dt = time.perf_counter() - t0
+        rows = outstanding * n * pcap
+        best = max(best, rows / dt / 1e6)
+        if report is not None:
+            report(it, dt, rows, fn.spec.impl)
+    return best
+
+
+def run_join(args) -> None:
+    def report(it, dt, rows, impl):
+        print(
+            f"iter {it}: joined {rows} probe rows in {dt*1e3:.1f} ms = "
+            f"{rows / dt / 1e6:.2f} M rows/s [impl={impl}]",
+            flush=True,
+        )
+
+    measure_join(
+        args.executors, args.num_blocks, args.build_rows, args.iterations,
+        outstanding=args.outstanding, report=report,
+    )
+
+
 def run_columnar(args) -> None:
     width = max(1, parse_size(args.block_size) // 4)  # -s = row bytes
 
@@ -531,6 +639,8 @@ def main(argv=None) -> None:
         run_columnar(args)
     elif args.mode == "groupby":
         run_groupby(args)
+    elif args.mode == "join":
+        run_join(args)
     else:
         run_superstep(args)
 

@@ -97,6 +97,16 @@ def test_groupby_mode(capsys):
     assert "rows/s" in out and out.count("iter") == 2
 
 
+def test_join_mode(capsys):
+    benchmark.run_join(
+        benchmark._parse_args(
+            ["join", "-n", "4096", "-i", "2", "-o", "2", "--executors", "4"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "rows/s" in out and out.count("iter") == 2
+
+
 def test_columnar_mode(capsys):
     benchmark.run_columnar(
         benchmark._parse_args(
