@@ -210,6 +210,39 @@ def run_columnar_shuffle(
     )
 
 
+def shard_rows_host(
+    keys: np.ndarray,
+    values: np.ndarray,
+    num_shards: int,
+    capacity: int,
+    key_fill: int = 0,
+    value_dtype=None,
+):
+    """Deal host (keys, value-rows) into the padded per-shard layout every
+    mesh-op driver feeds ``device_put``: contiguous near-equal shares, shard s
+    padded to ``capacity`` with ``key_fill`` keys / zero rows.  Returns
+    (padded_keys (n*cap,) uint32, padded_values (n*cap, width), num_valid
+    (n,) int32).  Shared by run_distributed_sort, run_grouped_aggregate, and
+    tests — one definition of the sharding convention."""
+    n, cap = num_shards, capacity
+    total = len(keys)
+    if total > n * cap:
+        raise ValueError(f"{total} rows exceed {n} x {cap} capacity")
+    width = values.shape[1]
+    pk = np.full(n * cap, key_fill, np.uint32)
+    pv = np.zeros((n * cap, width), value_dtype or values.dtype)
+    nv = np.zeros(n, np.int32)
+    base, rem = divmod(total, n)
+    start = 0
+    for s in range(n):
+        take = base + (1 if s < rem else 0)
+        pk[s * cap : s * cap + take] = keys[start : start + take]
+        pv[s * cap : s * cap + take] = values[start : start + take]
+        nv[s] = take
+        start += take
+    return pk, pv, nv
+
+
 def owners_from_partitions(
     partition_ids: jnp.ndarray, num_partitions: int, num_executors: int
 ) -> jnp.ndarray:

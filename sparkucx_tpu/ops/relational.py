@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkucx_tpu.ops.columnar import ColumnarSpec, columnar_body
+from sparkucx_tpu.ops.columnar import ColumnarSpec, columnar_body, shard_rows_host
 from sparkucx_tpu.ops.exchange import exclusive_cumsum
 
 #: Padding sort key (sorts last) — ops/sort.py's sentinel, same discipline:
@@ -442,17 +442,7 @@ def run_grouped_aggregate(
     if mesh.devices.size != n:
         raise ValueError(f"mesh size {mesh.devices.size} != num_executors {n}")
 
-    pk = np.zeros(n * cap, np.uint32)
-    pv = np.zeros((n * cap, spec.width), spec.dtype)
-    nv = np.zeros(n, np.int32)
-    base, rem = divmod(total, n)
-    start = 0
-    for s in range(n):
-        take = base + (1 if s < rem else 0)
-        pk[s * cap : s * cap + take] = keys[start : start + take]
-        pv[s * cap : s * cap + take] = values[start : start + take]
-        nv[s] = take
-        start += take
+    pk, pv, nv = shard_rows_host(keys, values, n, cap, value_dtype=spec.dtype)
 
     key_sh = NamedSharding(mesh, P(spec.axis_name))
     row_sh = NamedSharding(mesh, P(spec.axis_name, None))

@@ -43,6 +43,7 @@ from sparkucx_tpu.ops.columnar import (
     ColumnarSpec,
     columnar_shard_dense,
     columnar_shard_ragged,
+    shard_rows_host,
     size_matrix_from_owners,
 )
 from sparkucx_tpu.ops.exchange import gather_rows
@@ -285,17 +286,9 @@ def run_distributed_sort(
     if mesh.devices.size != n:
         raise ValueError(f"mesh size {mesh.devices.size} != num_executors {n}")
 
-    pk = np.full(n * cap, KEY_MAX, np.uint32)
-    pv = np.zeros((n * cap, spec.width), spec.dtype)
-    nv = np.zeros(n, np.int32)
-    base, rem = divmod(total, n)
-    start = 0
-    for s in range(n):
-        take = base + (1 if s < rem else 0)
-        pk[s * cap : s * cap + take] = keys[start : start + take]
-        pv[s * cap : s * cap + take] = payload[start : start + take]
-        nv[s] = take
-        start += take
+    pk, pv, nv = shard_rows_host(
+        keys, payload, n, cap, key_fill=int(KEY_MAX), value_dtype=spec.dtype
+    )
 
     key_sh = NamedSharding(mesh, P(spec.axis_name))
     row_sh = NamedSharding(mesh, P(spec.axis_name, None))
