@@ -35,8 +35,10 @@ from sparkucx_tpu.ops.relational import (
 from sparkucx_tpu.ops.sort import (
     SortSpec,
     build_distributed_sort,
+    merge_sorted_runs,
     oracle_sort,
     run_distributed_sort,
+    run_external_sort,
 )
 from sparkucx_tpu.ops.tc import (
     TcSpec,
@@ -68,8 +70,10 @@ __all__ = [
     "run_grouped_aggregate",
     "SortSpec",
     "build_distributed_sort",
+    "merge_sorted_runs",
     "oracle_sort",
     "run_distributed_sort",
+    "run_external_sort",
     "TcSpec",
     "build_tc_prep",
     "build_tc_step",
