@@ -401,6 +401,7 @@ def run_external_sort(
     keys: np.ndarray,
     payload: np.ndarray,
     max_attempts: int = 3,
+    fns: Optional[dict] = None,
 ):
     """Out-of-core TeraSort driver: datasets past device capacity are sorted
     in device batches of ``num_executors * capacity`` rows (one compiled sort
@@ -413,16 +414,19 @@ def run_external_sort(
     Peak host memory is ~2.5x the dataset (input + runs being merged).
 
     Same contract as :func:`run_distributed_sort` (stable, oracle-exact),
-    same skew-retry behavior per batch."""
+    same skew-retry behavior per batch.  Pass a dict as ``fns`` to keep the
+    compiled sorts across calls (repeat-measurement loops would otherwise
+    re-trace every call and time compilation)."""
     n = spec.num_executors
     batch = n * spec.capacity
     total = keys.shape[0]
-    if total <= batch:
-        return run_distributed_sort(mesh, spec, keys, payload, max_attempts)
     if mesh.devices.size != n:
         raise ValueError(f"mesh size {mesh.devices.size} != num_executors {n}")
+    if fns is None:
+        fns = {}  # recv_capacity -> compiled sort, reused across batches
+    if total <= batch:
+        return _sort_one_batch(mesh, spec, keys, payload, max_attempts, fns)
 
-    fns = {}  # recv_capacity -> compiled sort, reused across batches
     run_keys, run_payloads = [], []
     for start in range(0, total, batch):
         sk, sp = _sort_one_batch(

@@ -97,6 +97,16 @@ def test_groupby_mode(capsys):
     assert "rows/s" in out and out.count("iter") == 2
 
 
+def test_sort_external_mode(capsys):
+    benchmark.run_sort(
+        benchmark._parse_args(
+            ["sort", "-n", "8192", "-i", "1", "--executors", "2", "--batches", "4"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "external-sorted" in out and "4 device batches" in out
+
+
 def test_join_mode(capsys):
     benchmark.run_join(
         benchmark._parse_args(
