@@ -270,7 +270,7 @@ def _sort_one_batch(
 ):
     """One <=``n*capacity``-row chunk through the compiled sort: shard, run,
     retry with doubled ``recv_capacity`` on splitter-skew overflow, unpack the
-    valid prefixes.  ``fns`` caches compiled sorts by recv_capacity so callers
+    valid prefixes.  ``fns`` caches compiled sorts by full spec so callers
     looping over batches (run_external_sort) compile once per capacity."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -287,9 +287,9 @@ def _sort_one_batch(
     attempt_spec = spec
     for _ in range(max_attempts):
         rc = attempt_spec.recv_capacity
-        fn = fns.get(rc)
-        if fn is None:
-            fn = fns[rc] = build_distributed_sort(mesh, attempt_spec)
+        fn = fns.get(attempt_spec)  # keyed by the full spec: a reused cache
+        if fn is None:              # with a different spec must recompile
+            fn = fns[attempt_spec] = build_distributed_sort(mesh, attempt_spec)
         out_keys, out_pay, counts = fn(gk, gv, gn)
         counts_h = np.asarray(counts)
         if (counts_h <= rc).all():
@@ -423,7 +423,7 @@ def run_external_sort(
     if mesh.devices.size != n:
         raise ValueError(f"mesh size {mesh.devices.size} != num_executors {n}")
     if fns is None:
-        fns = {}  # recv_capacity -> compiled sort, reused across batches
+        fns = {}  # SortSpec -> compiled sort, reused across batches
     if total <= batch:
         return _sort_one_batch(mesh, spec, keys, payload, max_attempts, fns)
 
