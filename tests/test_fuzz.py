@@ -198,3 +198,40 @@ def _run_join_case(seed: int) -> None:
 @pytest.mark.parametrize("seed", range(12))
 def test_random_join_shapes(seed):
     _run_join_case(seed)
+
+
+def _run_groupby_case(seed: int) -> None:
+    """Differential fuzz for the grouped aggregation: random executor counts,
+    fills, agg mixes, and key skew (single-key through all-distinct) vs the
+    numpy oracle, through the retry-on-skew host driver."""
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.relational import (
+        AggregateSpec,
+        oracle_aggregate,
+        run_grouped_aggregate,
+    )
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.choice([1, 2, 4, 8]))
+    cap = int(rng.integers(4, 120))
+    total = int(rng.integers(0, n * cap + 1))
+    distinct = int(rng.choice([1, 2, 16, 1 << 32]))  # full uint32: KEY_MAX keys too
+    n_aggs = int(rng.integers(0, 4))
+    aggs = tuple(rng.choice(["sum", "min", "max"]) for _ in range(n_aggs))
+    spec = AggregateSpec(
+        num_executors=n, capacity=cap,
+        recv_capacity=max(8, 2 * cap), aggs=aggs, impl="dense",
+    )
+    keys = rng.integers(0, distinct, size=total, dtype=np.uint64).astype(np.uint32)
+    values = rng.integers(-1000, 1000, size=(total, n_aggs)).astype(np.int32)
+    mesh = make_mesh(n)
+    gk, gv, gc = run_grouped_aggregate(mesh, spec, keys, values, max_attempts=6)
+    wk, wv, wc = oracle_aggregate(keys, values, aggs)
+    assert np.array_equal(gk, wk), f"seed={seed} n={n} cap={cap} distinct={distinct}"
+    assert np.array_equal(gv, wv), f"seed={seed} aggregated columns diverged"
+    assert np.array_equal(gc, wc), f"seed={seed} group counts diverged"
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_groupby_shapes(seed):
+    _run_groupby_case(seed)
