@@ -4,8 +4,9 @@
 The reference validates hardware with live-cluster Spark jobs (buildlib/
 test.sh); this is the TPU-native equivalent for a single chip (or any backend):
 small-shape oracle drives of the exchange, the Pallas gather, the distributed
-sort, the columnar shuffle, the hierarchical route, and the full store →
-commit → exchange → fetch stack.  Exit 0 = every drive passed.
+sort, the columnar shuffle, the hierarchical route, the full store →
+commit → exchange → fetch stack, the relational operators (GROUP BY + hash
+join), and the transitive closure.  Exit 0 = every drive passed.
 
 Run on the real chip (default) or any backend:
 
@@ -226,7 +227,106 @@ def drive_hierarchy():
     return "two-phase"
 
 
-DRIVES = [drive_exchange, drive_gather, drive_sort, drive_columnar, drive_stack, drive_hierarchy]
+@_drive("grouped aggregate + hash join vs oracle")
+def drive_relational():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.columnar import shard_rows_host
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.relational import (
+        AggregateSpec,
+        JoinSpec,
+        build_hash_join,
+        hash_owners_host,
+        oracle_aggregate,
+        oracle_join,
+        run_grouped_aggregate,
+    )
+
+    n = min(4, len(jax.devices()))
+    mesh = make_mesh(n)
+    rng = np.random.default_rng(21)
+    total = 6000
+    keys = rng.integers(0, 64, size=total).astype(np.uint32)
+    values = rng.integers(-1000, 1000, size=(total, 2)).astype(np.int32)
+    spec = AggregateSpec(
+        num_executors=n, capacity=-(-total // n), recv_capacity=4 * -(-total // n),
+        aggs=("sum", "max"),
+    )
+    gk, gv, gc = run_grouped_aggregate(mesh, spec, keys, values)
+    wk, wv, wc = oracle_aggregate(keys, values, spec.aggs)
+    assert np.array_equal(gk, wk) and np.array_equal(gv, wv) and np.array_equal(gc, wc)
+
+    # PK-FK join, capacities planned from the real placement hash
+    nb, nprobe = 512, 2048
+    bkeys = rng.permutation(nb).astype(np.uint32)
+    pkeys = bkeys[rng.integers(0, nb, size=nprobe)]
+    bvals = rng.integers(-50, 50, size=(nb, 1)).astype(np.int32)
+    pvals = rng.integers(-50, 50, size=(nprobe, 1)).astype(np.int32)
+    brecv = max(1, int(np.bincount(hash_owners_host(bkeys, n), minlength=n).max()))
+    precv = max(1, int(np.bincount(hash_owners_host(pkeys, n), minlength=n).max()))
+    jspec = JoinSpec(
+        num_executors=n,
+        build_capacity=-(-nb // n), build_recv_capacity=brecv, build_width=1,
+        probe_capacity=-(-nprobe // n), probe_recv_capacity=precv, probe_width=1,
+        out_capacity=precv,
+    )
+    fn = build_hash_join(mesh, jspec)
+    bk, bv, bn = shard_rows_host(bkeys, bvals, n, jspec.build_capacity)
+    pk, pv, pn = shard_rows_host(pkeys, pvals, n, jspec.probe_capacity)
+    key_sh = NamedSharding(mesh, P("ex"))
+    row_sh = NamedSharding(mesh, P("ex", None))
+    ok, ob, op_, oc, rt = fn(
+        jax.device_put(bk, key_sh), jax.device_put(bv, row_sh), jax.device_put(bn, key_sh),
+        jax.device_put(pk, key_sh), jax.device_put(pv, row_sh), jax.device_put(pn, key_sh),
+    )
+    # precise diagnosis if the DEVICE placement hash ever diverges from the
+    # host twin that sized these buffers (what a hardware smoke exists to catch)
+    rt = np.asarray(rt)
+    assert (rt[:, 0] <= brecv).all() and (rt[:, 1] <= precv).all(), (
+        f"device hash placement diverged from host plan (build {rt[:, 0].max()}"
+        f"/{brecv}, probe {rt[:, 1].max()}/{precv})"
+    )
+    oc = np.asarray(oc)
+    assert (oc <= jspec.out_capacity).all(), (
+        f"join output overflowed the exact host plan ({oc.max()} > {jspec.out_capacity})"
+    )
+    ok, ob, op_ = np.asarray(ok), np.asarray(ob), np.asarray(op_)
+    got = sorted(
+        (int(ok[i]), int(ob[i, 0]), int(op_[i, 0]))
+        for shard in range(n)
+        for i in range(shard * jspec.out_capacity, shard * jspec.out_capacity + int(oc[shard]))
+    )
+    jk, jb, jp = oracle_join(bkeys, bvals, pkeys, pvals)
+    want = sorted(zip(jk.tolist(), jb[:, 0].tolist(), jp[:, 0].tolist()))
+    assert got == want, f"join rows diverged ({len(got)} vs {len(want)})"
+    return fn.spec.impl
+
+
+@_drive("transitive closure vs oracle")
+def drive_tc():
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.tc import TcSpec, oracle_tc, run_transitive_closure
+
+    import jax
+
+    n = min(4, len(jax.devices()))
+    mesh = make_mesh(n)
+    rng = np.random.default_rng(22)
+    edges = rng.integers(0, 48, size=(120, 2)).astype(np.uint32)
+    want = oracle_tc(edges)
+    cap = max(4096 // n, 512)
+    spec = TcSpec(num_executors=n, edge_capacity=cap, tc_capacity=cap, join_capacity=4 * cap)
+    pairs, rounds = run_transitive_closure(mesh, spec, edges)
+    assert np.array_equal(np.unique(pairs, axis=0), want), "closure pairs diverged"
+    return spec.resolve_impl(mesh.devices.reshape(-1)[0].platform).impl
+
+
+DRIVES = [
+    drive_exchange, drive_gather, drive_sort, drive_columnar, drive_stack,
+    drive_hierarchy, drive_relational, drive_tc,
+]
 
 
 def main() -> int:

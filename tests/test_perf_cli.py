@@ -154,4 +154,4 @@ def test_tpu_smoke_script():
              "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
     )
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "all 6 drives passed" in r.stdout
+    assert "all 8 drives passed" in r.stdout
