@@ -15,11 +15,9 @@ import numpy as np
 from sparkucx_tpu.ops.exchange import make_mesh
 from sparkucx_tpu.ops.relational import (
     AggregateSpec,
-    JoinSpec,
-    build_hash_join,
-    hash_owners_host,
     oracle_aggregate,
     run_grouped_aggregate,
+    run_hash_join,
 )
 from sparkucx_tpu.ops.tc import TcSpec, oracle_tc, run_transitive_closure
 
@@ -43,11 +41,9 @@ def groupby(mesh, n: int) -> None:
 
 def join(mesh, n: int) -> None:
     # PK-FK inner join (TPC-H's plan shape): unique dimension keys, fact rows
-    # referencing them.  Receive capacities planned from the real placement
-    # hash (hash_owners_host) — what any production driver should do.
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+    # referencing them.  run_hash_join plans receive/output capacities from
+    # the real placement hash and raises precise diagnostics on divergence —
+    # use it instead of hand-sizing JoinSpec buffers.
     nb, np_rows = 1_000 * n, 4_000 * n
     rng = np.random.default_rng(6)
     bkeys = rng.permutation(nb).astype(np.uint32)
@@ -56,38 +52,15 @@ def join(mesh, n: int) -> None:
     # probe values derive from the key so the output check can verify the
     # probe side per-row (equal-key fact rows are otherwise interchangeable)
     pvals = (pkeys.astype(np.int64) * 3 + 1).astype(np.int32)[:, None]
-    brecv = int(np.bincount(hash_owners_host(bkeys, n), minlength=n).max())
-    precv = int(np.bincount(hash_owners_host(pkeys, n), minlength=n).max())
-    spec = JoinSpec(
-        num_executors=n,
-        build_capacity=nb // n, build_recv_capacity=brecv, build_width=1,
-        probe_capacity=np_rows // n, probe_recv_capacity=precv, probe_width=1,
-        out_capacity=precv,
-    )
-    fn = build_hash_join(mesh, spec)
-    key_sh, row_sh = NamedSharding(mesh, P("ex")), NamedSharding(mesh, P("ex", None))
-    full = np.full(n, nb // n, np.int32), np.full(n, np_rows // n, np.int32)
-    out = fn(
-        jax.device_put(bkeys, key_sh), jax.device_put(bvals, row_sh),
-        jax.device_put(full[0], key_sh),
-        jax.device_put(pkeys, key_sh), jax.device_put(pvals, row_sh),
-        jax.device_put(full[1], key_sh),
-    )
-    matches = int(np.asarray(out[3]).sum())
-    assert matches == np_rows, f"PK-FK join must match every fact row ({matches} != {np_rows})"
+    jk, jb, jp = run_hash_join(mesh, bkeys, bvals, pkeys, pvals)
+    assert len(jk) == np_rows, f"PK-FK join must match every fact row ({len(jk)} != {np_rows})"
     # value alignment: every emitted (key, build, probe) triple must carry the
     # build table's value for that key AND the key-derived probe value
     build_of = dict(zip(bkeys.tolist(), bvals[:, 0].tolist()))
-    ok, oc = np.asarray(out[0]), np.asarray(out[3])
-    ob, op_ = np.asarray(out[1]), np.asarray(out[2])
-    for shard in range(n):
-        c = int(oc[shard])
-        base = shard * spec.out_capacity
-        for i in range(base, base + c):
-            k = int(ok[i])
-            assert build_of[k] == int(ob[i, 0])
-            assert int(op_[i, 0]) == k * 3 + 1
-    print(f"OK: PK-FK join matched {matches} fact rows, values aligned both sides")
+    for k, b, p in zip(jk.tolist(), jb[:, 0].tolist(), jp[:, 0].tolist()):
+        assert build_of[k] == b
+        assert p == k * 3 + 1
+    print(f"OK: PK-FK join matched {len(jk)} fact rows, values aligned both sides")
 
 
 def transitive_closure(mesh, n: int) -> None:
@@ -100,7 +73,7 @@ def transitive_closure(mesh, n: int) -> None:
         num_executors=n, edge_capacity=cap, tc_capacity=cap, join_capacity=4 * cap
     )
     pairs, rounds = run_transitive_closure(mesh, spec, edges)
-    assert np.array_equal(np.unique(pairs, axis=0), want)
+    assert np.array_equal(pairs, want)  # driver returns ascending-unique
     print(f"OK: transitive closure {len(want)} pairs in {rounds} rounds")
 
 

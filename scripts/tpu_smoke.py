@@ -230,18 +230,14 @@ def drive_hierarchy():
 @_drive("grouped aggregate + hash join vs oracle")
 def drive_relational():
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from sparkucx_tpu.ops.columnar import shard_rows_host
     from sparkucx_tpu.ops.exchange import make_mesh
     from sparkucx_tpu.ops.relational import (
         AggregateSpec,
-        JoinSpec,
-        build_hash_join,
-        hash_owners_host,
         oracle_aggregate,
         oracle_join,
         run_grouped_aggregate,
+        run_hash_join,
     )
 
     n = min(4, len(jax.devices()))
@@ -258,50 +254,19 @@ def drive_relational():
     wk, wv, wc = oracle_aggregate(keys, values, spec.aggs)
     assert np.array_equal(gk, wk) and np.array_equal(gv, wv) and np.array_equal(gc, wc)
 
-    # PK-FK join, capacities planned from the real placement hash
+    # PK-FK join through the capacity-planning host driver (raises its own
+    # precise diagnostics if the device placement diverges from the host plan)
     nb, nprobe = 512, 2048
     bkeys = rng.permutation(nb).astype(np.uint32)
     pkeys = bkeys[rng.integers(0, nb, size=nprobe)]
     bvals = rng.integers(-50, 50, size=(nb, 1)).astype(np.int32)
     pvals = rng.integers(-50, 50, size=(nprobe, 1)).astype(np.int32)
-    brecv = max(1, int(np.bincount(hash_owners_host(bkeys, n), minlength=n).max()))
-    precv = max(1, int(np.bincount(hash_owners_host(pkeys, n), minlength=n).max()))
-    jspec = JoinSpec(
-        num_executors=n,
-        build_capacity=-(-nb // n), build_recv_capacity=brecv, build_width=1,
-        probe_capacity=-(-nprobe // n), probe_recv_capacity=precv, probe_width=1,
-        out_capacity=precv,
-    )
-    fn = build_hash_join(mesh, jspec)
-    bk, bv, bn = shard_rows_host(bkeys, bvals, n, jspec.build_capacity)
-    pk, pv, pn = shard_rows_host(pkeys, pvals, n, jspec.probe_capacity)
-    key_sh = NamedSharding(mesh, P("ex"))
-    row_sh = NamedSharding(mesh, P("ex", None))
-    ok, ob, op_, oc, rt = fn(
-        jax.device_put(bk, key_sh), jax.device_put(bv, row_sh), jax.device_put(bn, key_sh),
-        jax.device_put(pk, key_sh), jax.device_put(pv, row_sh), jax.device_put(pn, key_sh),
-    )
-    # precise diagnosis if the DEVICE placement hash ever diverges from the
-    # host twin that sized these buffers (what a hardware smoke exists to catch)
-    rt = np.asarray(rt)
-    assert (rt[:, 0] <= brecv).all() and (rt[:, 1] <= precv).all(), (
-        f"device hash placement diverged from host plan (build {rt[:, 0].max()}"
-        f"/{brecv}, probe {rt[:, 1].max()}/{precv})"
-    )
-    oc = np.asarray(oc)
-    assert (oc <= jspec.out_capacity).all(), (
-        f"join output overflowed the exact host plan ({oc.max()} > {jspec.out_capacity})"
-    )
-    ok, ob, op_ = np.asarray(ok), np.asarray(ob), np.asarray(op_)
-    got = sorted(
-        (int(ok[i]), int(ob[i, 0]), int(op_[i, 0]))
-        for shard in range(n)
-        for i in range(shard * jspec.out_capacity, shard * jspec.out_capacity + int(oc[shard]))
-    )
-    jk, jb, jp = oracle_join(bkeys, bvals, pkeys, pvals)
-    want = sorted(zip(jk.tolist(), jb[:, 0].tolist(), jp[:, 0].tolist()))
+    jk, jb, jp = run_hash_join(mesh, bkeys, bvals, pkeys, pvals)
+    got = sorted(zip(jk.tolist(), jb[:, 0].tolist(), jp[:, 0].tolist()))
+    wk_, wb, wp = oracle_join(bkeys, bvals, pkeys, pvals)
+    want = sorted(zip(wk_.tolist(), wb[:, 0].tolist(), wp[:, 0].tolist()))
     assert got == want, f"join rows diverged ({len(got)} vs {len(want)})"
-    return fn.spec.impl
+    return spec.resolve_impl(mesh.devices.reshape(-1)[0].platform).impl
 
 
 @_drive("transitive closure vs oracle")
@@ -319,7 +284,9 @@ def drive_tc():
     cap = max(4096 // n, 512)
     spec = TcSpec(num_executors=n, edge_capacity=cap, tc_capacity=cap, join_capacity=4 * cap)
     pairs, rounds = run_transitive_closure(mesh, spec, edges)
-    assert np.array_equal(np.unique(pairs, axis=0), want), "closure pairs diverged"
+    # the driver's contract is ascending-unique — compare directly, no
+    # np.unique laundering of a dedup/order regression
+    assert np.array_equal(pairs, want), "closure pairs diverged"
     return spec.resolve_impl(mesh.devices.reshape(-1)[0].platform).impl
 
 

@@ -30,7 +30,9 @@ from sparkucx_tpu.ops.relational import (
     JoinSpec,
     build_grouped_aggregate,
     build_hash_join,
+    plan_join_capacities,
     run_grouped_aggregate,
+    run_hash_join,
 )
 from sparkucx_tpu.ops.sort import (
     SortSpec,
@@ -67,7 +69,9 @@ __all__ = [
     "JoinSpec",
     "build_grouped_aggregate",
     "build_hash_join",
+    "plan_join_capacities",
     "run_grouped_aggregate",
+    "run_hash_join",
     "SortSpec",
     "build_distributed_sort",
     "merge_sorted_runs",

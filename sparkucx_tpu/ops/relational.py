@@ -504,6 +504,100 @@ def oracle_aggregate(
     return uniq, out, counts.astype(np.int32)
 
 
+def plan_join_capacities(
+    build_keys: np.ndarray, probe_keys: np.ndarray, num_executors: int
+) -> Tuple[int, int, int]:
+    """Exact per-shard (build_recv, probe_recv, out) capacities for a hash
+    join of these keys, from the host twin of the device placement hash —
+    what any driver should do instead of guessing skew headroom.  Matches for
+    key k land on k's owner shard, bcount(k) * pcount(k) of them."""
+    n = num_executors
+    brecv = max(1, int(np.bincount(hash_owners_host(build_keys, n), minlength=n).max()))
+    precv = max(1, int(np.bincount(hash_owners_host(probe_keys, n), minlength=n).max()))
+    uk_b, cb = np.unique(build_keys, return_counts=True)
+    uk_p, cp = np.unique(probe_keys, return_counts=True)
+    pos = np.searchsorted(uk_p, uk_b)
+    pos_c = np.clip(pos, 0, max(len(uk_p) - 1, 0))
+    present = (pos < len(uk_p)) & (len(uk_p) > 0)
+    if len(uk_p):
+        present &= uk_p[pos_c] == uk_b
+    matches = np.where(present, cp[pos_c] if len(uk_p) else 0, 0).astype(np.int64) * cb
+    per_shard = np.zeros(n, np.int64)
+    if len(uk_b):
+        np.add.at(per_shard, hash_owners_host(uk_b, n), matches)
+    return brecv, precv, max(1, int(per_shard.max()))
+
+
+def run_hash_join(
+    mesh: Mesh,
+    build_keys: np.ndarray,
+    build_vals: np.ndarray,
+    probe_keys: np.ndarray,
+    probe_vals: np.ndarray,
+    axis_name: str = "ex",
+    impl: str = "auto",
+    build_capacity: Optional[int] = None,
+    probe_capacity: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host driver for the inner equi-join: plan receive/output capacities
+    exactly from the placement hash (:func:`plan_join_capacities`), shard both
+    sides, run the compiled join, and verify the device placement agreed with
+    the host plan.  Returns flat (keys, build_rows, probe_rows) in
+    shard-concatenated order — compare as a multiset (``oracle_join`` returns
+    one).  The capacity-planning + unpack half every join caller needs, like
+    run_grouped_aggregate is for GROUP BY.  ``build_capacity``/
+    ``probe_capacity`` override the tight per-shard input capacities (callers
+    that over-provision exercise the padding paths; tests do)."""
+    if build_vals.dtype != probe_vals.dtype:
+        raise ValueError(
+            f"build/probe value dtypes must match (keys bitcast through them): "
+            f"{build_vals.dtype} != {probe_vals.dtype}"
+        )
+    n = int(mesh.devices.size)
+    bcap = build_capacity or max(1, -(-len(build_keys) // n))
+    pcap = probe_capacity or max(1, -(-len(probe_keys) // n))
+    brecv, precv, out_cap = plan_join_capacities(build_keys, probe_keys, n)
+    spec = JoinSpec(
+        num_executors=n,
+        build_capacity=bcap, build_recv_capacity=brecv,
+        build_width=build_vals.shape[1],
+        probe_capacity=pcap, probe_recv_capacity=precv,
+        probe_width=probe_vals.shape[1],
+        out_capacity=out_cap,
+        dtype=build_vals.dtype,
+        axis_name=axis_name,
+        impl=impl,
+    )
+    fn = build_hash_join(mesh, spec)
+    bk, bv, bn = shard_rows_host(build_keys, build_vals, n, bcap, value_dtype=spec.dtype)
+    pk, pv, pn = shard_rows_host(probe_keys, probe_vals, n, pcap, value_dtype=spec.dtype)
+    key_sh = NamedSharding(mesh, P(axis_name))
+    row_sh = NamedSharding(mesh, P(axis_name, None))
+    ok, ob, op_, oc, rt = fn(
+        jax.device_put(bk, key_sh), jax.device_put(bv, row_sh), jax.device_put(bn, key_sh),
+        jax.device_put(pk, key_sh), jax.device_put(pv, row_sh), jax.device_put(pn, key_sh),
+    )
+    rt = np.asarray(rt)
+    if not ((rt[:, 0] <= brecv).all() and (rt[:, 1] <= precv).all()):
+        raise RuntimeError(
+            f"device hash placement diverged from the host plan (build "
+            f"{rt[:, 0].max()}/{brecv}, probe {rt[:, 1].max()}/{precv})"
+        )
+    oc = np.asarray(oc)
+    if not (oc <= out_cap).all():
+        raise RuntimeError(
+            f"join output overflowed the exact host plan ({oc.max()} > {out_cap})"
+        )
+    ok, ob, op_ = np.asarray(ok), np.asarray(ob), np.asarray(op_)
+    ka = ok.reshape(n, out_cap)
+    ba = ob.reshape(n, out_cap, -1)
+    pa = op_.reshape(n, out_cap, -1)
+    keys = np.concatenate([ka[s, : oc[s]] for s in range(n)])
+    brows = np.concatenate([ba[s, : oc[s]] for s in range(n)])
+    prows = np.concatenate([pa[s, : oc[s]] for s in range(n)])
+    return keys, brows, prows
+
+
 def oracle_join(
     build_keys: np.ndarray,
     build_vals: np.ndarray,
