@@ -243,6 +243,20 @@ def shard_rows_host(
     return pk, pv, nv
 
 
+def unpack_shard_prefixes(arrays, counts, capacity: int):
+    """Inverse of :func:`shard_rows_host`: concatenate each shard's valid
+    prefix from per-shard padded layouts.  ``arrays``: host arrays shaped
+    (n * capacity, ...); ``counts``: (n,) valid rows per shard.  Returns the
+    unpacked arrays in shard order — with shard_rows_host, the one definition
+    of the sharding convention's pack/unpack pair."""
+    n = len(counts)
+    outs = []
+    for a in arrays:
+        a2 = np.asarray(a).reshape(n, capacity, *np.asarray(a).shape[1:])
+        outs.append(np.concatenate([a2[s, : counts[s]] for s in range(n)]))
+    return outs
+
+
 def owners_from_partitions(
     partition_ids: jnp.ndarray, num_partitions: int, num_executors: int
 ) -> jnp.ndarray:

@@ -34,7 +34,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkucx_tpu.ops.columnar import ColumnarSpec, columnar_body, shard_rows_host
+from sparkucx_tpu.ops.columnar import (
+    ColumnarSpec,
+    columnar_body,
+    shard_rows_host,
+    unpack_shard_prefixes,
+)
 from sparkucx_tpu.ops.exchange import exclusive_cumsum
 
 #: Padding sort key (sorts last) — ops/sort.py's sentinel, same discipline:
@@ -455,14 +460,10 @@ def run_grouped_aggregate(
         fn = build_grouped_aggregate(mesh, attempt_spec)
         out_k, out_v, out_c, num_groups, recv_totals = fn(gk, gv, gn)
         if (np.asarray(recv_totals) <= attempt_spec.recv_capacity).all():
-            rc = attempt_spec.recv_capacity
-            ka = np.asarray(out_k).reshape(n, rc)
-            va = np.asarray(out_v).reshape(n, rc, spec.width)
-            ca = np.asarray(out_c).reshape(n, rc)
-            ng = np.asarray(num_groups)
-            keys_h = np.concatenate([ka[s, : ng[s]] for s in range(n)])
-            vals_h = np.concatenate([va[s, : ng[s]] for s in range(n)])
-            cnts_h = np.concatenate([ca[s, : ng[s]] for s in range(n)])
+            keys_h, vals_h, cnts_h = unpack_shard_prefixes(
+                (out_k, out_v, out_c), np.asarray(num_groups),
+                attempt_spec.recv_capacity,
+            )
             order = np.argsort(keys_h)
             return keys_h[order], vals_h[order], cnts_h[order]
         attempt_spec = replace(
@@ -516,12 +517,10 @@ def plan_join_capacities(
     precv = max(1, int(np.bincount(hash_owners_host(probe_keys, n), minlength=n).max()))
     uk_b, cb = np.unique(build_keys, return_counts=True)
     uk_p, cp = np.unique(probe_keys, return_counts=True)
-    pos = np.searchsorted(uk_p, uk_b)
-    pos_c = np.clip(pos, 0, max(len(uk_p) - 1, 0))
-    present = (pos < len(uk_p)) & (len(uk_p) > 0)
-    if len(uk_p):
-        present &= uk_p[pos_c] == uk_b
-    matches = np.where(present, cp[pos_c] if len(uk_p) else 0, 0).astype(np.int64) * cb
+    present = np.isin(uk_b, uk_p)
+    matches = np.zeros(len(uk_b), np.int64)
+    matches[present] = cp[np.searchsorted(uk_p, uk_b[present])]
+    matches *= cb
     per_shard = np.zeros(n, np.int64)
     if len(uk_b):
         np.add.at(per_shard, hash_owners_host(uk_b, n), matches)
@@ -588,13 +587,7 @@ def run_hash_join(
         raise RuntimeError(
             f"join output overflowed the exact host plan ({oc.max()} > {out_cap})"
         )
-    ok, ob, op_ = np.asarray(ok), np.asarray(ob), np.asarray(op_)
-    ka = ok.reshape(n, out_cap)
-    ba = ob.reshape(n, out_cap, -1)
-    pa = op_.reshape(n, out_cap, -1)
-    keys = np.concatenate([ka[s, : oc[s]] for s in range(n)])
-    brows = np.concatenate([ba[s, : oc[s]] for s in range(n)])
-    prows = np.concatenate([pa[s, : oc[s]] for s in range(n)])
+    keys, brows, prows = unpack_shard_prefixes((ok, ob, op_), oc, out_cap)
     return keys, brows, prows
 
 

@@ -45,6 +45,7 @@ from sparkucx_tpu.ops.columnar import (
     columnar_shard_ragged,
     shard_rows_host,
     size_matrix_from_owners,
+    unpack_shard_prefixes,
 )
 from sparkucx_tpu.ops.exchange import gather_rows
 
@@ -293,10 +294,7 @@ def _sort_one_batch(
         out_keys, out_pay, counts = fn(gk, gv, gn)
         counts_h = np.asarray(counts)
         if (counts_h <= rc).all():
-            ka = np.asarray(out_keys).reshape(n, rc)
-            pa = np.asarray(out_pay).reshape(n, rc, spec.width)
-            sk = np.concatenate([ka[s, : counts_h[s]] for s in range(n)])
-            sp = np.concatenate([pa[s, : counts_h[s]] for s in range(n)])
+            sk, sp = unpack_shard_prefixes((out_keys, out_pay), counts_h, rc)
             return sk, sp
         attempt_spec = replace(attempt_spec, recv_capacity=2 * rc)
     raise RuntimeError(

@@ -201,3 +201,34 @@ def _run_groupby_case(seed: int) -> None:
 @pytest.mark.parametrize("seed", range(12))
 def test_random_groupby_shapes(seed):
     _run_groupby_case(seed)
+
+
+def _run_external_sort_case(seed: int) -> None:
+    """Differential fuzz for the out-of-core sort: random batch counts (1-7
+    runs incl. ragged tails), widths, and key duplication — the device-batch +
+    host-merge composite must stay stable and oracle-exact."""
+    from sparkucx_tpu.ops.exchange import make_mesh
+    from sparkucx_tpu.ops.sort import SortSpec, oracle_sort, run_external_sort
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.choice([1, 2, 4]))
+    cap = int(rng.integers(8, 80))
+    width = int(rng.choice([1, 4, 24]))
+    total = int(rng.integers(1, 7 * n * cap + 1))
+    distinct = int(rng.choice([1, 4, 1 << 32]))
+    spec = SortSpec(
+        num_executors=n, capacity=cap,
+        recv_capacity=cap if n == 1 else 2 * cap, width=width, impl="dense",
+    )
+    keys = rng.integers(0, distinct, size=total, dtype=np.uint64).astype(np.uint32)
+    payload = rng.integers(-100, 100, size=(total, width)).astype(np.int32)
+    mesh = make_mesh(n)
+    sk, sp = run_external_sort(mesh, spec, keys, payload, max_attempts=6)
+    ek, ep = oracle_sort(keys, payload)
+    assert np.array_equal(sk, ek), f"seed={seed} n={n} cap={cap} total={total}"
+    assert np.array_equal(sp, ep), f"seed={seed} payload rows diverged (stability)"
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_random_external_sort_shapes(seed):
+    _run_external_sort_case(seed)
