@@ -10,6 +10,8 @@ from sparkucx_tpu.ops.columnar import (
     ColumnarSpec,
     build_columnar_shuffle,
     run_columnar_shuffle,
+    shard_rows_host,
+    unpack_shard_prefixes,
 )
 from sparkucx_tpu.ops.exchange import (
     ExchangeSpec,
@@ -30,6 +32,9 @@ from sparkucx_tpu.ops.relational import (
     JoinSpec,
     build_grouped_aggregate,
     build_hash_join,
+    hash_owners_host,
+    oracle_aggregate,
+    oracle_join,
     plan_join_capacities,
     run_grouped_aggregate,
     run_hash_join,
@@ -54,6 +59,8 @@ __all__ = [
     "ColumnarSpec",
     "build_columnar_shuffle",
     "run_columnar_shuffle",
+    "shard_rows_host",
+    "unpack_shard_prefixes",
     "ExchangeSpec",
     "build_exchange",
     "gather_rows",
@@ -69,6 +76,9 @@ __all__ = [
     "JoinSpec",
     "build_grouped_aggregate",
     "build_hash_join",
+    "hash_owners_host",
+    "oracle_aggregate",
+    "oracle_join",
     "plan_join_capacities",
     "run_grouped_aggregate",
     "run_hash_join",
