@@ -9,6 +9,11 @@ fabric is the unit-test backend the reference never had (SURVEY.md §4).
 Run: python examples/01_transport_loopback.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf

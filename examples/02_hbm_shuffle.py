@@ -10,6 +10,11 @@ which every fetch is a local HBM read.
 Run: python examples/02_hbm_shuffle.py            (any backend; 2 executors)
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf

@@ -9,6 +9,11 @@ by re-running with doubled receive headroom.
 Run: python examples/03_terasort.py               (any backend; up to 4 executors)
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from sparkucx_tpu.ops.exchange import make_mesh

@@ -10,6 +10,11 @@ sides + sort-merge match (join), and an iterated join/union/distinct step
 Run: python examples/04_workloads.py              (any backend; up to 4 executors)
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from sparkucx_tpu.ops.exchange import make_mesh
