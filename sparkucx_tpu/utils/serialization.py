@@ -5,6 +5,11 @@ wraps direct ByteBuffers for Java serialization (:20-48) and codes
 ``InetSocketAddress`` as ``{int port, utf8 host}`` (:71-88).  Python needs no
 direct-buffer wrapper (bytes are picklable/sendable as-is); the address codec is
 kept wire-compatible in spirit: little-endian port then utf-8 host.
+
+The in-tree control planes deliberately use self-describing encodings instead
+(JSON frames in parallel/bootstrap.py, ``b"host:port"`` transport addresses) —
+this codec is the InetSocketAddress-shaped twin for engines that want the
+reference's byte layout, contract-tested in tests/test_aux.py.
 """
 
 from __future__ import annotations

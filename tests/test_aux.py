@@ -185,3 +185,29 @@ class TestLogging:
 
         log = get_logger("test.module")
         assert log.name == "sparkucx_tpu.test.module"
+
+
+class TestAddressCodec:
+    """pack/unpack_address — the SerializableDirectBuffer.scala:71-88 twin."""
+
+    def test_roundtrip(self):
+        from sparkucx_tpu.utils.serialization import pack_address, unpack_address
+
+        for host, port in [
+            ("127.0.0.1", 13337),
+            ("::1", 0),                       # IPv6 textual form
+            ("worker-0.pod.svc.local", 65535),
+            ("bücher.example", 1338),         # non-ASCII utf-8 host
+            ("", 42),                         # host-less (port-only) address
+        ]:
+            blob = pack_address(host, port)
+            assert unpack_address(blob) == (host, port)
+
+    def test_wire_layout_is_port_then_utf8_host(self):
+        import struct
+
+        from sparkucx_tpu.utils.serialization import pack_address
+
+        blob = pack_address("abc", 258)
+        assert struct.unpack_from("<i", blob)[0] == 258
+        assert blob[4:] == b"abc"
