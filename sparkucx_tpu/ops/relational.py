@@ -120,6 +120,11 @@ class AggregateSpec:
     dtype: np.dtype = np.dtype(np.int32)
     axis_name: str = "ex"
     impl: str = "auto"
+    #: True compiles the WHERE-pushdown variant: the jitted fn takes a fourth
+    #: per-row bool input and filtered rows never enter the exchange (their
+    #: owner is the never-sent n) — Spark SQL's Filter below the Exchange,
+    #: on device instead of pre-filtered host tables.
+    with_filter: bool = False
 
     @property
     def width(self) -> int:
@@ -149,10 +154,15 @@ def _agg_identity(agg: str, dtype) -> jnp.ndarray:
     return jnp.array(info.max if agg == "min" else info.min, dtype)
 
 
-def _aggregate_body(spec: AggregateSpec, keys, values, num_valid):
+def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
     cap = spec.capacity
     idx = jnp.arange(cap, dtype=jnp.int32)
     valid = idx < num_valid[0]
+    if mask is not None:
+        # WHERE pushdown: filtered rows are simply never-sent (owner n), so
+        # invalidity may be scattered — everything downstream sees only the
+        # compacted received prefix and is agnostic to the input pattern
+        valid &= mask
 
     cspec = ColumnarSpec(
         num_executors=spec.num_executors,
@@ -212,7 +222,10 @@ def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
     """Compile the distributed GROUP BY for ``mesh``.
 
     Returns jitted ``fn(keys, values, num_valid) ->
-    (group_keys, group_values, group_counts, num_groups, recv_totals)``:
+    (group_keys, group_values, group_counts, num_groups, recv_totals)`` —
+    with ``spec.with_filter`` the signature gains a trailing per-row bool
+    ``mask`` (n * capacity,): False rows are dropped before the exchange
+    (WHERE pushdown; they count in neither recv_totals nor any group):
 
     * ``keys``: (n * capacity,) uint32, sharded over ``axis_name``;
     * ``values``: (n * capacity, len(aggs)) of ``dtype``, row-sharded;
@@ -236,24 +249,16 @@ def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
     shard = jax.shard_map(
         functools.partial(_aggregate_body, spec),
         mesh=mesh,
-        in_specs=(P(ax), P(ax, None), P(ax)),
+        in_specs=((P(ax), P(ax, None), P(ax)) + ((P(ax),) if spec.with_filter else ())),
         out_specs=(P(ax), P(ax, None), P(ax), P(ax), P(ax)),
         check_vma=False,
     )
+    key_sh = NamedSharding(mesh, P(ax))
+    row_sh = NamedSharding(mesh, P(ax, None))
     fn = jax.jit(
         shard,
-        in_shardings=(
-            NamedSharding(mesh, P(ax)),
-            NamedSharding(mesh, P(ax, None)),
-            NamedSharding(mesh, P(ax)),
-        ),
-        out_shardings=(
-            NamedSharding(mesh, P(ax)),
-            NamedSharding(mesh, P(ax, None)),
-            NamedSharding(mesh, P(ax)),
-            NamedSharding(mesh, P(ax)),
-            NamedSharding(mesh, P(ax)),
-        ),
+        in_shardings=(key_sh, row_sh, key_sh) + ((key_sh,) if spec.with_filter else ()),
+        out_shardings=(key_sh, row_sh, key_sh, key_sh, key_sh),
     )
     fn.spec = spec
     return fn
@@ -321,6 +326,10 @@ class JoinSpec:
     dtype: np.dtype = np.dtype(np.int32)
     axis_name: str = "ex"
     impl: str = "auto"
+    #: True compiles the WHERE-pushdown variant: the jitted fn takes two extra
+    #: per-row bool inputs (build_mask, probe_mask) and filtered rows never
+    #: enter either exchange — the filtered-join shape of TPC-H q3/q5.
+    with_filters: bool = False
 
     def resolve_impl(self, platform: Optional[str] = None) -> "JoinSpec":
         if self.impl != "auto":
@@ -336,7 +345,8 @@ class JoinSpec:
             raise ValueError("value dtype must be 32-bit (keys bitcast through it)")
 
 
-def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum):
+def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum,
+               bmask=None, pmask=None):
     n = spec.num_executors
 
     def cspec(cap, recv_cap, width):
@@ -352,6 +362,9 @@ def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum):
 
     bvalid = jnp.arange(spec.build_capacity, dtype=jnp.int32) < bnum[0]
     pvalid = jnp.arange(spec.probe_capacity, dtype=jnp.int32) < pnum[0]
+    if bmask is not None:  # WHERE pushdown (see AggregateSpec.with_filter)
+        bvalid &= bmask
+        pvalid &= pmask
 
     # Hash-partition both sides: equal keys co-locate.
     rbk, rbv, rbvalid, rbtotal = exchange_keyed_rows(
@@ -388,7 +401,10 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
 
     Returns jitted ``fn(build_keys, build_values, build_num, probe_keys,
     probe_values, probe_num) ->
-    (out_keys, out_build, out_probe, out_counts, recv_totals)``:
+    (out_keys, out_build, out_probe, out_counts, recv_totals)`` — with
+    ``spec.with_filters`` the signature gains trailing per-row bool
+    ``(build_mask, probe_mask)``: False rows never enter either exchange
+    (the filtered-join WHERE pushdown):
 
     * inputs are sharded like build_grouped_aggregate's (keys uint32, values
       (rows, width) of ``dtype``, num (n,) int32);
@@ -407,10 +423,11 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
     spec.validate()
     ax = spec.axis_name
 
+    extra = (P(ax), P(ax)) if spec.with_filters else ()
     shard = jax.shard_map(
         functools.partial(_join_body, spec),
         mesh=mesh,
-        in_specs=(P(ax), P(ax, None), P(ax)) * 2,
+        in_specs=(P(ax), P(ax, None), P(ax)) * 2 + extra,
         out_specs=(P(ax), P(ax, None), P(ax, None), P(ax), P(ax, None)),
         check_vma=False,
     )
@@ -418,7 +435,8 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
     row_sh = NamedSharding(mesh, P(ax, None))
     fn = jax.jit(
         shard,
-        in_shardings=(key_sh, row_sh, key_sh) * 2,
+        in_shardings=(key_sh, row_sh, key_sh) * 2
+        + ((key_sh, key_sh) if spec.with_filters else ()),
         out_shardings=(key_sh, row_sh, row_sh, key_sh, row_sh),
     )
     fn.spec = spec
@@ -431,13 +449,16 @@ def run_grouped_aggregate(
     keys: np.ndarray,
     values: np.ndarray,
     max_attempts: int = 3,
+    mask: Optional[np.ndarray] = None,
 ):
     """Host driver: shard, run the compiled GROUP BY, retry with doubled
     ``recv_capacity`` when hash skew overflows a shard — the GroupByTest job
     surface (run_distributed_sort's contract for aggregation).
 
-    ``keys``: (T,) uint32; ``values``: (T, len(aggs)).  Returns (group keys
-    ascending, aggregated columns, counts) as host arrays.
+    ``keys``: (T,) uint32; ``values``: (T, len(aggs)).  With a
+    ``spec.with_filter`` spec, ``mask`` (T,) bool is required: False rows are
+    dropped on device before the exchange.  Returns (group keys ascending,
+    aggregated columns, counts) as host arrays.
     """
     n = spec.num_executors
     total = keys.shape[0]
@@ -446,6 +467,11 @@ def run_grouped_aggregate(
         raise ValueError(f"{total} rows exceed {n} x {cap} capacity")
     if mesh.devices.size != n:
         raise ValueError(f"mesh size {mesh.devices.size} != num_executors {n}")
+    if spec.with_filter != (mask is not None):
+        raise ValueError(
+            "spec.with_filter=True needs a mask argument (and a mask needs "
+            "with_filter=True): the compiled signatures differ"
+        )
 
     pk, pv, nv = shard_rows_host(keys, values, n, cap, value_dtype=spec.dtype)
 
@@ -454,11 +480,18 @@ def run_grouped_aggregate(
     gk = jax.device_put(pk, key_sh)
     gv = jax.device_put(pv, row_sh)
     gn = jax.device_put(nv, key_sh)
+    extra = ()
+    if mask is not None:
+        # the mask rides the same contiguous deal as its rows; padding = False
+        pm, _, _ = shard_rows_host(
+            mask.astype(np.uint32), np.zeros((total, 0), np.int32), n, cap
+        )
+        extra = (jax.device_put(pm.astype(bool), key_sh),)
 
     attempt_spec = spec
     for _ in range(max_attempts):
         fn = build_grouped_aggregate(mesh, attempt_spec)
-        out_k, out_v, out_c, num_groups, recv_totals = fn(gk, gv, gn)
+        out_k, out_v, out_c, num_groups, recv_totals = fn(gk, gv, gn, *extra)
         if (np.asarray(recv_totals) <= attempt_spec.recv_capacity).all():
             keys_h, vals_h, cnts_h = unpack_shard_prefixes(
                 (out_k, out_v, out_c), np.asarray(num_groups),

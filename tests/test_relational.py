@@ -1,5 +1,7 @@
 """Tests for the device-resident relational operators (GROUP BY, hash join)."""
 
+from dataclasses import replace
+
 import jax
 import numpy as np
 import pytest
@@ -14,6 +16,7 @@ from sparkucx_tpu.ops.relational import (
     build_hash_join,
     oracle_aggregate,
     oracle_join,
+    run_grouped_aggregate,
 )
 
 N = 8
@@ -314,3 +317,108 @@ class TestRunGroupedAggregate:
         gk, gv, gc = run_grouped_aggregate(make_mesh(n), spec, keys, values)
         assert gk.tolist() == [42]
         assert gv[0, 0] == values.sum() and gc[0] == total
+
+
+class TestFilterPushdown:
+    """with_filter / with_filters: WHERE below the exchange, on device."""
+
+    def test_aggregate_scattered_mask_vs_masked_oracle(self, mesh, rng):
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=4 * CAP,
+            aggs=("sum", "min"), impl="dense", with_filter=True,
+        )
+        fn = build_grouped_aggregate(mesh, spec)
+        keys = rng.integers(0, 12, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        values = rng.integers(-100, 100, size=(N * CAP, 2)).astype(np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        mask = rng.random(N * CAP) < 0.4  # scattered, not a prefix
+        gk, gv, gc, ng, rt = fn(
+            _keys_sh(mesh, keys), _rows_sh(mesh, values), _keys_sh(mesh, nvalid),
+            _keys_sh(mesh, mask),
+        )
+        assert int(np.asarray(rt).sum()) == int(mask.sum())
+        gk = np.asarray(gk).reshape(N, -1)
+        gv = np.asarray(gv).reshape(N, gk.shape[1], -1)
+        gc = np.asarray(gc).reshape(N, -1)
+        ng = np.asarray(ng)
+        rows = [
+            (int(gk[j, g]), (int(gv[j, g, 0]), int(gv[j, g, 1])), int(gc[j, g]))
+            for j in range(N)
+            for g in range(ng[j])
+        ]
+        wk, wv, wc = oracle_aggregate(keys[mask], values[mask], spec.aggs)
+        assert sorted(rows) == sorted(
+            (int(k), (int(v[0]), int(v[1])), int(c)) for k, v, c in zip(wk, wv, wc)
+        )
+
+    def test_all_rows_filtered_zero_groups(self, mesh, rng):
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=CAP,
+            aggs=(), impl="dense", with_filter=True,
+        )
+        fn = build_grouped_aggregate(mesh, spec)
+        keys = rng.integers(0, 5, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        values = np.zeros((N * CAP, 0), np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        mask = np.zeros(N * CAP, bool)
+        _, _, _, ng, rt = fn(
+            _keys_sh(mesh, keys), _rows_sh(mesh, values), _keys_sh(mesh, nvalid),
+            _keys_sh(mesh, mask),
+        )
+        assert int(np.asarray(ng).sum()) == 0
+        assert int(np.asarray(rt).sum()) == 0
+
+    def test_filtered_join_vs_masked_oracle(self, mesh, rng):
+        bcap = pcap = 32
+        bkeys = rng.integers(0, 20, size=N * bcap, dtype=np.uint64).astype(np.uint32)
+        pkeys = rng.integers(0, 20, size=N * pcap, dtype=np.uint64).astype(np.uint32)
+        bvals = rng.integers(-50, 50, size=(N * bcap, 1)).astype(np.int32)
+        pvals = rng.integers(-50, 50, size=(N * pcap, 1)).astype(np.int32)
+        bmask = rng.random(N * bcap) < 0.5
+        pmask = rng.random(N * pcap) < 0.5
+        spec = JoinSpec(
+            num_executors=N,
+            build_capacity=bcap, build_recv_capacity=N * bcap, build_width=1,
+            probe_capacity=pcap, probe_recv_capacity=N * pcap, probe_width=1,
+            out_capacity=4 * N * pcap,
+            impl="dense", with_filters=True,
+        )
+        fn = build_hash_join(mesh, spec)
+        ok, ob, op_, oc, rt = fn(
+            _keys_sh(mesh, bkeys), _rows_sh(mesh, bvals),
+            _keys_sh(mesh, np.full(N, bcap, np.int32)),
+            _keys_sh(mesh, pkeys), _rows_sh(mesh, pvals),
+            _keys_sh(mesh, np.full(N, pcap, np.int32)),
+            _keys_sh(mesh, bmask), _keys_sh(mesh, pmask),
+        )
+        rt = np.asarray(rt)
+        assert rt[:, 0].sum() == bmask.sum() and rt[:, 1].sum() == pmask.sum()
+        oc = np.asarray(oc)
+        ok, ob, op_ = np.asarray(ok), np.asarray(ob), np.asarray(op_)
+        got = sorted(
+            (int(ok[i]), int(ob[i, 0]), int(op_[i, 0]))
+            for s in range(N)
+            for i in range(s * spec.out_capacity, s * spec.out_capacity + int(oc[s]))
+        )
+        wk, wb, wp = oracle_join(bkeys[bmask], bvals[bmask], pkeys[pmask], pvals[pmask])
+        assert got == sorted(zip(wk.tolist(), wb[:, 0].tolist(), wp[:, 0].tolist()))
+
+    def test_driver_with_filter_and_mismatch_raise(self, mesh, rng):
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=4 * CAP,
+            aggs=("sum",), impl="dense", with_filter=True,
+        )
+        total = 500
+        keys = rng.integers(0, 10, size=total, dtype=np.uint64).astype(np.uint32)
+        values = rng.integers(-100, 100, size=(total, 1)).astype(np.int32)
+        mask = rng.random(total) < 0.3
+        gk, gv, gc = run_grouped_aggregate(mesh, spec, keys, values, mask=mask)
+        wk, wv, wc = oracle_aggregate(keys[mask], values[mask], spec.aggs)
+        assert np.array_equal(gk, wk) and np.array_equal(gv, wv) and np.array_equal(gc, wc)
+        # signature mismatches fail with a clear message, not a pjit error
+        with pytest.raises(ValueError, match="with_filter"):
+            run_grouped_aggregate(mesh, spec, keys, values)
+        with pytest.raises(ValueError, match="with_filter"):
+            run_grouped_aggregate(
+                mesh, replace(spec, with_filter=False), keys, values, mask=mask
+            )

@@ -284,3 +284,41 @@ def test_q3_join_group_topk(mesh, rng):
     want_sorted = sorted(zip(o_rev, o_keys), reverse=True)[:5]
     want = {(int(k), int(r)) for r, k in want_sorted}
     assert got == want
+
+
+def test_q6_forecast_revenue_filtered_aggregate(mesh, rng):
+    """q6 shape: scan -> FILTER -> global aggregate, no join — the WHERE
+    clause (shipdate/discount/quantity band) pushed down on device via
+    ``AggregateSpec.with_filter`` instead of pre-filtering the host table."""
+    rows = 700
+    qty = rng.integers(1, 60, size=rows).astype(np.int32)
+    disc = rng.integers(0, 11, size=rows).astype(np.int32)
+    price = rng.integers(100, 10000, size=rows).astype(np.int32)
+    revenue = price * disc  # the summed expression, precomputed as a lane
+    values = np.stack([revenue], axis=1)
+    keys = np.zeros(rows, np.uint32)  # global aggregate: one group
+
+    spec = AggregateSpec(
+        num_executors=N, capacity=CAP, recv_capacity=4 * CAP,
+        aggs=("sum",), with_filter=True,
+    )
+    fn = build_grouped_aggregate(mesh, spec)
+    k, v, nv = _pad_table(keys, values, CAP)
+    predicate = (qty < 24) & (disc >= 5) & (disc <= 7)  # the q6 band
+    # mask rows land where _pad_table dealt them: row i -> shard i % N, slot i // N
+    m = np.zeros(N * CAP, bool)
+    idx = np.arange(rows)
+    m[(idx % N) * CAP + idx // N] = predicate
+    gk, gv, gc, ng, rt = fn(
+        *_shard(mesh, k, v, nv),
+        jax.device_put(m, NamedSharding(mesh, P("ex"))),
+    )
+    keys_h, vals_h, cnts_h = _groups_to_host(gk, gv, gc, ng, rt, spec.recv_capacity)
+    if predicate.any():
+        assert len(keys_h) == 1 and keys_h[0] == 0
+        assert vals_h[0, 0] == revenue[predicate].sum()
+        assert cnts_h[0] == predicate.sum()
+    else:  # pragma: no cover - rng never produces this at rows=700
+        assert len(keys_h) == 0
+    # recv totals count only unfiltered rows: the filter saved exchange traffic
+    assert np.asarray(rt).sum() == predicate.sum()
