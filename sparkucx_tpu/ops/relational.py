@@ -272,20 +272,27 @@ def expand_matches(
     probe_valid: jnp.ndarray,
     probe_cap: int,
     build_cap: int,
+    left_outer: bool = False,
 ):
     """Sort-merge match expansion shared by the hash join and the transitive
     closure: given the build side's sorted (padded) keys ``sbk`` with
     ``btotal`` valid rows and the probe keys, emit per output row p its probe
     index ``j[p]`` and build index ``li[p]``.
 
-    Returns (j, li, ok, total): ``ok`` masks rows past the true match count;
-    ``total`` is wrap-guarded — int32 cumsum wraps at ~2.1e9 matches, so a
-    float32 shadow sum (exact enough for detection) saturates the reported
-    total at int32 max so a caller's ``total > out_capacity`` overflow check
-    cannot pass silently."""
+    Returns (j, li, ok, unmatched, total): ``ok`` masks rows past the true
+    emission count; ``unmatched`` marks left-outer null-extension rows (always
+    all-False for inner); ``total`` is wrap-guarded — int32 cumsum wraps at
+    ~2.1e9 matches, so a float32 shadow sum (exact enough for detection)
+    saturates the reported total at int32 max so a caller's ``total >
+    out_capacity`` overflow check cannot pass silently.
+
+    ``left_outer=True`` emits exactly one row for each valid probe row with NO
+    build match (SQL LEFT OUTER JOIN): its ``li`` is meaningless and
+    ``unmatched`` is True — the caller substitutes nulls for build lanes."""
     lo = jnp.searchsorted(sbk, probe_keys, side="left").astype(jnp.int32)
     hi = jnp.minimum(jnp.searchsorted(sbk, probe_keys, side="right").astype(jnp.int32), btotal)
-    cnt = jnp.where(probe_valid, jnp.maximum(hi - lo, 0), 0)
+    matched = jnp.where(probe_valid, jnp.maximum(hi - lo, 0), 0)
+    cnt = jnp.where(probe_valid, jnp.maximum(matched, 1), 0) if left_outer else matched
     offs = exclusive_cumsum(cnt)
     cum = jnp.cumsum(cnt)
     total = jnp.where(
@@ -299,7 +306,8 @@ def expand_matches(
     )
     li = jnp.clip(lo[j] + (pos - offs[j]), 0, build_cap - 1)
     ok = pos < total
-    return j, li, ok, total
+    unmatched = ok & (matched[j] == 0) if left_outer else jnp.zeros_like(ok)
+    return j, li, ok, unmatched, total
 
 
 # ----------------------------------------------------------------------------
@@ -309,9 +317,15 @@ def expand_matches(
 
 @dataclass(frozen=True)
 class JoinSpec:
-    """Static description of one compiled inner equi-join.
+    """Static description of one compiled equi-join.
 
-    ``build_*`` is the left/build side, ``probe_*`` the right/probe side.
+    ``build_*`` is the hash-table (dimension) side, ``probe_*`` the streamed
+    (fact) side.  In SQL terms the probe side is the LEFT operand:
+    ``SELECT ... FROM probe [LEFT OUTER] JOIN build ON key`` — so
+    ``join_type='left_outer'`` preserves every valid PROBE row, emitting one
+    null-extended output (zeroed build lanes, flagged False in the extra
+    ``out_matched`` output) when it has no build match; TPC-H q13
+    (customer LEFT OUTER JOIN orders) puts customer on the probe side.
     ``out_capacity``: per-executor output rows — bound the many-to-many
     expansion (for PK-FK joins like TPC-H's, probe_recv_capacity is enough)."""
 
@@ -330,6 +344,7 @@ class JoinSpec:
     #: per-row bool inputs (build_mask, probe_mask) and filtered rows never
     #: enter either exchange — the filtered-join shape of TPC-H q3/q5.
     with_filters: bool = False
+    join_type: str = "inner"
 
     def resolve_impl(self, platform: Optional[str] = None) -> "JoinSpec":
         if self.impl != "auto":
@@ -343,6 +358,8 @@ class JoinSpec:
             raise ValueError(f"unknown impl {self.impl!r}")
         if np.dtype(self.dtype).itemsize != 4:
             raise ValueError("value dtype must be 32-bit (keys bitcast through it)")
+        if self.join_type not in ("inner", "left_outer"):
+            raise ValueError(f"unknown join_type {self.join_type!r}")
 
 
 def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum,
@@ -385,32 +402,40 @@ def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum,
 
     # Match range per probe row (hi clamped at btotal so a KEY_MAX probe key
     # never matches build padding), expanded into the static output.
-    j, li, ok, total = expand_matches(
+    left_outer = spec.join_type == "left_outer"
+    j, li, ok, unmatched, total = expand_matches(
         spec.out_capacity, sbk, btotal, rpk, rpvalid,
         spec.probe_recv_capacity, spec.build_recv_capacity,
+        left_outer=left_outer,
     )
     zero = jnp.zeros((), spec.dtype)
     out_keys = jnp.where(ok, rpk[j], jnp.uint32(0))
-    out_build = jnp.where(ok[:, None], sbv[li], zero)
+    out_build = jnp.where((ok & ~unmatched)[:, None], sbv[li], zero)
     out_probe = jnp.where(ok[:, None], rpv[j], zero)
-    return out_keys, out_build, out_probe, total[None], jnp.stack([rbtotal, rptotal])[None, :]
+    outs = (out_keys, out_build, out_probe, total[None], jnp.stack([rbtotal, rptotal])[None, :])
+    if left_outer:
+        outs += (ok & ~unmatched,)  # out_matched: False = null-extended row
+    return outs
 
 
 def build_hash_join(mesh: Mesh, spec: JoinSpec):
-    """Compile the distributed inner equi-join for ``mesh``.
+    """Compile the distributed equi-join (``spec.join_type``) for ``mesh``.
 
     Returns jitted ``fn(build_keys, build_values, build_num, probe_keys,
     probe_values, probe_num) ->
     (out_keys, out_build, out_probe, out_counts, recv_totals)`` — with
     ``spec.with_filters`` the signature gains trailing per-row bool
     ``(build_mask, probe_mask)``: False rows never enter either exchange
-    (the filtered-join WHERE pushdown):
+    (the filtered-join WHERE pushdown); with ``spec.join_type='left_outer'``
+    the outputs gain a sixth ``out_matched`` (n * out_capacity,) bool —
+    False marks a null-extended row (its out_build lanes are zeros, its
+    out_keys/out_probe are the unmatched probe row's):
 
     * inputs are sharded like build_grouped_aggregate's (keys uint32, values
       (rows, width) of ``dtype``, num (n,) int32);
     * ``out_keys``: (n * out_capacity,) uint32 — join key per output row;
     * ``out_build`` / ``out_probe``: matched value rows, aligned;
-    * ``out_counts``: (n,) int32 — matches on each shard.  A count >
+    * ``out_counts``: (n,) int32 — emitted rows on each shard.  A count >
       ``out_capacity`` means the emitted prefix was truncated: re-run with a
       larger ``out_capacity`` (same overflow contract as SortSpec);
     * ``recv_totals``: (n, 2) int32 — TRUE (build, probe) rows hashed to each
@@ -423,12 +448,13 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
     spec.validate()
     ax = spec.axis_name
 
-    extra = (P(ax), P(ax)) if spec.with_filters else ()
+    extra_in = (P(ax), P(ax)) if spec.with_filters else ()
+    extra_out = (P(ax),) if spec.join_type == "left_outer" else ()
     shard = jax.shard_map(
         functools.partial(_join_body, spec),
         mesh=mesh,
-        in_specs=(P(ax), P(ax, None), P(ax)) * 2 + extra,
-        out_specs=(P(ax), P(ax, None), P(ax, None), P(ax), P(ax, None)),
+        in_specs=(P(ax), P(ax, None), P(ax)) * 2 + extra_in,
+        out_specs=(P(ax), P(ax, None), P(ax, None), P(ax), P(ax, None)) + extra_out,
         check_vma=False,
     )
     key_sh = NamedSharding(mesh, P(ax))
@@ -437,7 +463,8 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
         shard,
         in_shardings=(key_sh, row_sh, key_sh) * 2
         + ((key_sh, key_sh) if spec.with_filters else ()),
-        out_shardings=(key_sh, row_sh, row_sh, key_sh, row_sh),
+        out_shardings=(key_sh, row_sh, row_sh, key_sh, row_sh)
+        + ((key_sh,) if spec.join_type == "left_outer" else ()),
     )
     fn.spec = spec
     return fn
@@ -539,24 +566,29 @@ def oracle_aggregate(
 
 
 def plan_join_capacities(
-    build_keys: np.ndarray, probe_keys: np.ndarray, num_executors: int
+    build_keys: np.ndarray,
+    probe_keys: np.ndarray,
+    num_executors: int,
+    left_outer: bool = False,
 ) -> Tuple[int, int, int]:
     """Exact per-shard (build_recv, probe_recv, out) capacities for a hash
     join of these keys, from the host twin of the device placement hash —
-    what any driver should do instead of guessing skew headroom.  Matches for
-    key k land on k's owner shard, bcount(k) * pcount(k) of them."""
+    what any driver should do instead of guessing skew headroom.  Key k's
+    rows land on its owner shard and emit pcount(k) * bcount(k) matches
+    there (left-outer: pcount(k) * max(bcount(k), 1) — unmatched probe rows
+    still emit their null-extension row)."""
     n = num_executors
     brecv = max(1, int(np.bincount(hash_owners_host(build_keys, n), minlength=n).max()))
     precv = max(1, int(np.bincount(hash_owners_host(probe_keys, n), minlength=n).max()))
     uk_b, cb = np.unique(build_keys, return_counts=True)
     uk_p, cp = np.unique(probe_keys, return_counts=True)
-    present = np.isin(uk_b, uk_p)
-    matches = np.zeros(len(uk_b), np.int64)
-    matches[present] = cp[np.searchsorted(uk_p, uk_b[present])]
-    matches *= cb
+    present = np.isin(uk_p, uk_b)
+    bcount = np.zeros(len(uk_p), np.int64)
+    bcount[present] = cb[np.searchsorted(uk_b, uk_p[present])]
+    per_key = cp * (np.maximum(bcount, 1) if left_outer else bcount)
     per_shard = np.zeros(n, np.int64)
-    if len(uk_b):
-        np.add.at(per_shard, hash_owners_host(uk_b, n), matches)
+    if len(uk_p):
+        np.add.at(per_shard, hash_owners_host(uk_p, n), per_key)
     return brecv, precv, max(1, int(per_shard.max()))
 
 
@@ -570,13 +602,16 @@ def run_hash_join(
     impl: str = "auto",
     build_capacity: Optional[int] = None,
     probe_capacity: Optional[int] = None,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Host driver for the inner equi-join: plan receive/output capacities
-    exactly from the placement hash (:func:`plan_join_capacities`), shard both
-    sides, run the compiled join, and verify the device placement agreed with
-    the host plan.  Returns flat (keys, build_rows, probe_rows) in
+    join_type: str = "inner",
+):
+    """Host driver for the equi-join: plan receive/output capacities exactly
+    from the placement hash (:func:`plan_join_capacities`), shard both sides,
+    run the compiled join, and verify the device placement agreed with the
+    host plan.  Returns flat (keys, build_rows, probe_rows) in
     shard-concatenated order — compare as a multiset (``oracle_join`` returns
-    one).  The capacity-planning + unpack half every join caller needs, like
+    one); with ``join_type='left_outer'`` a fourth ``matched`` bool array is
+    returned (False rows are null-extended: zeroed build lanes).  The
+    capacity-planning + unpack half every join caller needs, like
     run_grouped_aggregate is for GROUP BY.  ``build_capacity``/
     ``probe_capacity`` override the tight per-shard input capacities (callers
     that over-provision exercise the padding paths; tests do)."""
@@ -588,7 +623,9 @@ def run_hash_join(
     n = int(mesh.devices.size)
     bcap = build_capacity or max(1, -(-len(build_keys) // n))
     pcap = probe_capacity or max(1, -(-len(probe_keys) // n))
-    brecv, precv, out_cap = plan_join_capacities(build_keys, probe_keys, n)
+    brecv, precv, out_cap = plan_join_capacities(
+        build_keys, probe_keys, n, left_outer=(join_type == "left_outer")
+    )
     spec = JoinSpec(
         num_executors=n,
         build_capacity=bcap, build_recv_capacity=brecv,
@@ -599,16 +636,18 @@ def run_hash_join(
         dtype=build_vals.dtype,
         axis_name=axis_name,
         impl=impl,
+        join_type=join_type,
     )
     fn = build_hash_join(mesh, spec)
     bk, bv, bn = shard_rows_host(build_keys, build_vals, n, bcap, value_dtype=spec.dtype)
     pk, pv, pn = shard_rows_host(probe_keys, probe_vals, n, pcap, value_dtype=spec.dtype)
     key_sh = NamedSharding(mesh, P(axis_name))
     row_sh = NamedSharding(mesh, P(axis_name, None))
-    ok, ob, op_, oc, rt = fn(
+    outs = fn(
         jax.device_put(bk, key_sh), jax.device_put(bv, row_sh), jax.device_put(bn, key_sh),
         jax.device_put(pk, key_sh), jax.device_put(pv, row_sh), jax.device_put(pn, key_sh),
     )
+    ok, ob, op_, oc, rt = outs[:5]
     rt = np.asarray(rt)
     if not ((rt[:, 0] <= brecv).all() and (rt[:, 1] <= precv).all()):
         raise RuntimeError(
@@ -620,6 +659,11 @@ def run_hash_join(
         raise RuntimeError(
             f"join output overflowed the exact host plan ({oc.max()} > {out_cap})"
         )
+    if join_type == "left_outer":
+        keys, brows, prows, matched = unpack_shard_prefixes(
+            (ok, ob, op_, outs[5]), oc, out_cap
+        )
+        return keys, brows, prows, matched
     keys, brows, prows = unpack_shard_prefixes((ok, ob, op_), oc, out_cap)
     return keys, brows, prows
 
@@ -629,24 +673,39 @@ def oracle_join(
     build_vals: np.ndarray,
     probe_keys: np.ndarray,
     probe_vals: np.ndarray,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """numpy reference inner join: rows (key, build_row, probe_row), as a
-    sorted multiset of tuples for order-insensitive comparison."""
+    join_type: str = "inner",
+):
+    """numpy reference equi-join: rows (key, build_row, probe_row), as a
+    sorted multiset of tuples for order-insensitive comparison.  With
+    ``join_type='left_outer'`` a fourth ``matched`` bool array is returned and
+    unmatched probe rows emit one zero-build row each (run_hash_join's null
+    convention)."""
     from collections import defaultdict
 
+    left_outer = join_type == "left_outer"
     by_key = defaultdict(list)
     for k, row in zip(build_keys, build_vals):
         by_key[int(k)].append(row)
-    keys, brows, prows = [], [], []
+    zero_build = np.zeros(build_vals.shape[1], build_vals.dtype)
+    keys, brows, prows, matched = [], [], [], []
     for k, prow in zip(probe_keys, probe_vals):
-        for brow in by_key.get(int(k), ()):
+        hits = by_key.get(int(k), ())
+        for brow in hits:
             keys.append(int(k))
             brows.append(brow)
             prows.append(prow)
+            matched.append(True)
+        if left_outer and not hits:
+            keys.append(int(k))
+            brows.append(zero_build)
+            prows.append(prow)
+            matched.append(False)
     if not keys:
-        return (
+        out = (
             np.zeros(0, np.uint32),
             np.zeros((0, build_vals.shape[1]), build_vals.dtype),
             np.zeros((0, probe_vals.shape[1]), probe_vals.dtype),
         )
-    return np.array(keys, np.uint32), np.stack(brows), np.stack(prows)
+        return out + (np.zeros(0, bool),) if left_outer else out
+    out = (np.array(keys, np.uint32), np.stack(brows), np.stack(prows))
+    return out + (np.array(matched),) if left_outer else out

@@ -147,7 +147,7 @@ def _tc_step_body(spec: TcSpec, tc_a, tc_b, tc_num, sbk, sbc, btotal):
 
     # 2. sort-merge expansion (shared with the hash join): probe = tc rows,
     #    build = edges; each match emits the new path (a, c)
-    j, li, new_ok, new_total = expand_matches(
+    j, li, new_ok, _, new_total = expand_matches(
         spec.join_capacity, sbk, btotal[0], rtk, rtvalid, spec.tc_recv, spec.edge_recv
     )
     new_a = jnp.where(

@@ -137,24 +137,33 @@ def _run_join_case(seed: int) -> None:
     pvals = rng.integers(-50, 50, size=(ptotal, pw)).astype(np.int32)
 
     mesh = make_mesh(n)
+    join_type = "left_outer" if seed % 3 == 0 else "inner"
     # over-provisioned input capacities (bcap/pcap >= fill) keep the
     # padding/validity-mask paths under fuzz, not just the tight auto-sizing
-    jk, jb, jp = run_hash_join(
+    out = run_hash_join(
         mesh, bkeys, bvals, pkeys, pvals, impl="dense",
-        build_capacity=bcap, probe_capacity=pcap,
+        build_capacity=bcap, probe_capacity=pcap, join_type=join_type,
     )
-    got = sorted(
-        (int(k), tuple(b.tolist()), tuple(p.tolist()))
-        for k, b, p in zip(jk, jb, jp)
-    )
-    want_k, want_b, want_p = oracle_join(bkeys, bvals, pkeys, pvals)
-    want = sorted(
-        (int(k), tuple(b.tolist()), tuple(p.tolist()))
-        for k, b, p in zip(want_k, want_b, want_p)
-    )
-    assert got == want, (
-        f"seed={seed} n={n} bcap={bcap} pcap={pcap} distinct={distinct}: "
-        f"{len(got)} rows != oracle {len(want)}"
+    want = oracle_join(bkeys, bvals, pkeys, pvals, join_type=join_type)
+    if join_type == "left_outer":
+        got_rows = sorted(
+            (int(k), tuple(b.tolist()), tuple(p.tolist()), bool(m))
+            for k, b, p, m in zip(*out)
+        )
+        want_rows = sorted(
+            (int(k), tuple(b.tolist()), tuple(p.tolist()), bool(m))
+            for k, b, p, m in zip(*want)
+        )
+    else:
+        got_rows = sorted(
+            (int(k), tuple(b.tolist()), tuple(p.tolist())) for k, b, p in zip(*out)
+        )
+        want_rows = sorted(
+            (int(k), tuple(b.tolist()), tuple(p.tolist())) for k, b, p in zip(*want)
+        )
+    assert got_rows == want_rows, (
+        f"seed={seed} n={n} bcap={bcap} pcap={pcap} distinct={distinct} "
+        f"{join_type}: {len(got_rows)} rows != oracle {len(want_rows)}"
     )
 
 

@@ -422,3 +422,61 @@ class TestFilterPushdown:
             run_grouped_aggregate(
                 mesh, replace(spec, with_filter=False), keys, values, mask=mask
             )
+
+
+class TestLeftOuterJoin:
+    def test_left_outer_vs_oracle(self, mesh, rng):
+        bkeys = rng.integers(0, 30, size=60, dtype=np.uint64).astype(np.uint32)
+        pkeys = rng.integers(0, 60, size=200, dtype=np.uint64).astype(np.uint32)
+        bvals = rng.integers(1, 50, size=(60, 2)).astype(np.int32)
+        pvals = rng.integers(1, 50, size=(200, 1)).astype(np.int32)
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        jk, jb, jp, jm = run_hash_join(
+            mesh, bkeys, bvals, pkeys, pvals, impl="dense", join_type="left_outer"
+        )
+        wk, wb, wp, wm = oracle_join(bkeys, bvals, pkeys, pvals, join_type="left_outer")
+        got = sorted(
+            (int(k), tuple(b.tolist()), tuple(p.tolist()), bool(m))
+            for k, b, p, m in zip(jk, jb, jp, jm)
+        )
+        want = sorted(
+            (int(k), tuple(b.tolist()), tuple(p.tolist()), bool(m))
+            for k, b, p, m in zip(wk, wb, wp, wm)
+        )
+        assert got == want
+        assert not np.asarray(jm).all()  # some rows really were null-extended
+
+    def test_empty_build_side_all_null_extended(self, mesh, rng):
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        pkeys = rng.integers(0, 9, size=50, dtype=np.uint64).astype(np.uint32)
+        pvals = rng.integers(1, 9, size=(50, 1)).astype(np.int32)
+        jk, jb, jp, jm = run_hash_join(
+            mesh,
+            np.zeros(0, np.uint32), np.zeros((0, 1), np.int32),
+            pkeys, pvals, impl="dense", join_type="left_outer",
+        )
+        assert len(jk) == 50 and not jm.any()
+        assert (jb == 0).all()
+        assert sorted(jk.tolist()) == sorted(pkeys.tolist())
+
+    def test_inner_unchanged_by_default(self, mesh, rng):
+        # join_type defaults to inner: no matched array, unmatched probes dropped
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        bkeys = np.array([1, 2], np.uint32)
+        bvals = np.array([[10], [20]], np.int32)
+        pkeys = np.array([2, 3, 2], np.uint32)
+        pvals = np.array([[7], [8], [9]], np.int32)
+        jk, jb, jp = run_hash_join(mesh, bkeys, bvals, pkeys, pvals, impl="dense")
+        assert sorted(jk.tolist()) == [2, 2]
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="join_type"):
+            JoinSpec(
+                num_executors=N,
+                build_capacity=8, build_recv_capacity=8, build_width=1,
+                probe_capacity=8, probe_recv_capacity=8, probe_width=1,
+                out_capacity=8, impl="dense", join_type="full_outer",
+            ).validate()
