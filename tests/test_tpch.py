@@ -322,3 +322,40 @@ def test_q6_forecast_revenue_filtered_aggregate(mesh, rng):
         assert len(keys_h) == 0
     # recv totals count only unfiltered rows: the filter saved exchange traffic
     assert np.asarray(rt).sum() == predicate.sum()
+
+
+def test_q13_customer_order_distribution(mesh, rng):
+    """q13 shape: customer LEFT OUTER JOIN orders (customers with zero orders
+    must appear), COUNT(orders) per customer, then the count-of-counts
+    distribution — the query the left-outer arm exists for."""
+    from sparkucx_tpu.ops.relational import run_grouped_aggregate, run_hash_join
+
+    n_cust, n_orders = 80, 400
+    custkeys = np.arange(n_cust, dtype=np.uint32)
+    cust_vals = np.zeros((n_cust, 1), np.int32)
+    # ~25% of customers get no orders at all
+    ordering_custs = custkeys[rng.random(n_cust) < 0.75]
+    order_cust = ordering_custs[rng.integers(0, len(ordering_custs), size=n_orders)].astype(np.uint32)
+    order_vals = np.ones((n_orders, 1), np.int32)
+
+    # probe = customer (the preserved SQL-left side), build = orders
+    jk, jb, jp, jm = run_hash_join(
+        mesh, order_cust, order_vals, custkeys, cust_vals,
+        impl="dense", join_type="left_outer",
+    )
+    # COUNT(o_orderkey) per customer = matched rows only (NULLs don't count)
+    spec = AggregateSpec(
+        num_executors=N, capacity=-(-len(jk) // N), recv_capacity=4 * -(-len(jk) // N),
+        aggs=("sum",),
+    )
+    gk, gv, gc = run_grouped_aggregate(
+        mesh, spec, jk, jm.astype(np.int32)[:, None]
+    )
+    # oracle: orders per customer, including zeros
+    want = np.bincount(order_cust, minlength=n_cust)
+    assert np.array_equal(gk, custkeys)          # every customer present
+    assert np.array_equal(gv[:, 0], want)        # COUNT per customer
+    # the q13 output: distribution of customers by order count
+    dist_keys, dist_counts = np.unique(gv[:, 0], return_counts=True)
+    assert dist_counts.sum() == n_cust
+    assert (want == 0).sum() == dist_counts[dist_keys == 0].sum()
