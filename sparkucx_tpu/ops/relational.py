@@ -54,6 +54,26 @@ _HASH_MULT = np.uint32(2654435761)
 
 VALID_AGGS = ("sum", "min", "max")
 
+#: join_type -> rows emitted per probe row with m build matches.  ONE table
+#: serves both the device kernel (xp=jnp in expand_matches) and the host
+#: capacity planner (xp=np in plan_join_capacities) so the two can never
+#: drift — a divergence would make the exact host plan under-size out_cap.
+_JOIN_EMIT = {
+    "inner": lambda m, xp: m,
+    "left_outer": lambda m, xp: xp.maximum(m, 1),
+    "left_semi": lambda m, xp: xp.minimum(m, 1),
+    "left_anti": lambda m, xp: 1 - xp.minimum(m, 1),
+}
+
+
+def _join_emit(join_type: str):
+    fn = _JOIN_EMIT.get(join_type)
+    if fn is None:
+        raise ValueError(
+            f"unknown join_type {join_type!r} (valid: {tuple(_JOIN_EMIT)})"
+        )
+    return fn
+
 
 def hash_owners(keys: jnp.ndarray, num_executors: int, valid: jnp.ndarray) -> jnp.ndarray:
     """Destination executor per row: multiplicative hash of the uint32 key,
@@ -272,7 +292,7 @@ def expand_matches(
     probe_valid: jnp.ndarray,
     probe_cap: int,
     build_cap: int,
-    left_outer: bool = False,
+    join_type: str = "inner",
 ):
     """Sort-merge match expansion shared by the hash join and the transitive
     closure: given the build side's sorted (padded) keys ``sbk`` with
@@ -286,13 +306,18 @@ def expand_matches(
     saturates the reported total at int32 max so a caller's ``total >
     out_capacity`` overflow check cannot pass silently.
 
-    ``left_outer=True`` emits exactly one row for each valid probe row with NO
-    build match (SQL LEFT OUTER JOIN): its ``li`` is meaningless and
-    ``unmatched`` is True — the caller substitutes nulls for build lanes."""
+    Per-probe-row emission by ``join_type`` (m = its build-match count):
+    'inner' m rows; 'left_outer' max(m, 1) — the extra row is null-extended
+    (its ``li`` is meaningless, ``unmatched`` True, caller substitutes nulls
+    for build lanes); 'left_semi' min(m, 1) — EXISTS (``li`` points at the
+    first match in SORTED build order; SQL semi emits probe columns only, so
+    callers should not read build lanes through it); 'left_anti' 1 if m == 0
+    else 0 — NOT EXISTS, ``li`` meaningless and ``unmatched`` True on every
+    emitted row."""
     lo = jnp.searchsorted(sbk, probe_keys, side="left").astype(jnp.int32)
     hi = jnp.minimum(jnp.searchsorted(sbk, probe_keys, side="right").astype(jnp.int32), btotal)
     matched = jnp.where(probe_valid, jnp.maximum(hi - lo, 0), 0)
-    cnt = jnp.where(probe_valid, jnp.maximum(matched, 1), 0) if left_outer else matched
+    cnt = jnp.where(probe_valid, _join_emit(join_type)(matched, jnp), 0)
     offs = exclusive_cumsum(cnt)
     cum = jnp.cumsum(cnt)
     total = jnp.where(
@@ -306,7 +331,9 @@ def expand_matches(
     )
     li = jnp.clip(lo[j] + (pos - offs[j]), 0, build_cap - 1)
     ok = pos < total
-    unmatched = ok & (matched[j] == 0) if left_outer else jnp.zeros_like(ok)
+    # semantically all-False for inner/semi (their emitted rows always have a
+    # match) — computed uniformly, the caller's null-substitution masks on it
+    unmatched = ok & (matched[j] == 0)
     return j, li, ok, unmatched, total
 
 
@@ -321,11 +348,19 @@ class JoinSpec:
 
     ``build_*`` is the hash-table (dimension) side, ``probe_*`` the streamed
     (fact) side.  In SQL terms the probe side is the LEFT operand:
-    ``SELECT ... FROM probe [LEFT OUTER] JOIN build ON key`` — so
-    ``join_type='left_outer'`` preserves every valid PROBE row, emitting one
-    null-extended output (zeroed build lanes, flagged False in the extra
-    ``out_matched`` output) when it has no build match; TPC-H q13
-    (customer LEFT OUTER JOIN orders) puts customer on the probe side.
+    ``SELECT ... FROM probe [LEFT OUTER] JOIN build ON key``.  ``join_type``:
+
+    * ``'inner'`` — m matches emit m rows;
+    * ``'left_outer'`` — every valid probe row is preserved; a matchless one
+      emits one null-extended output (zeroed build lanes, flagged False in
+      the extra ``out_matched`` output).  TPC-H q13 (customer LEFT OUTER JOIN
+      orders) puts customer on the probe side;
+    * ``'left_semi'`` — EXISTS: each probe row with >= 1 match emits exactly
+      one row, build lanes zeroed — SQL semi joins emit probe columns only
+      (q4/q21's correlated EXISTS);
+    * ``'left_anti'`` — NOT EXISTS: each matchless probe row emits one row,
+      build lanes zeroed (q22's NOT EXISTS).
+
     ``out_capacity``: per-executor output rows — bound the many-to-many
     expansion (for PK-FK joins like TPC-H's, probe_recv_capacity is enough)."""
 
@@ -358,8 +393,7 @@ class JoinSpec:
             raise ValueError(f"unknown impl {self.impl!r}")
         if np.dtype(self.dtype).itemsize != 4:
             raise ValueError("value dtype must be 32-bit (keys bitcast through it)")
-        if self.join_type not in ("inner", "left_outer"):
-            raise ValueError(f"unknown join_type {self.join_type!r}")
+        _join_emit(self.join_type)  # raises on unknown join_type
 
 
 def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum,
@@ -402,18 +436,22 @@ def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum,
 
     # Match range per probe row (hi clamped at btotal so a KEY_MAX probe key
     # never matches build padding), expanded into the static output.
-    left_outer = spec.join_type == "left_outer"
     j, li, ok, unmatched, total = expand_matches(
         spec.out_capacity, sbk, btotal, rpk, rpvalid,
         spec.probe_recv_capacity, spec.build_recv_capacity,
-        left_outer=left_outer,
+        join_type=spec.join_type,
     )
     zero = jnp.zeros((), spec.dtype)
     out_keys = jnp.where(ok, rpk[j], jnp.uint32(0))
-    out_build = jnp.where((ok & ~unmatched)[:, None], sbv[li], zero)
+    if spec.join_type in ("left_semi", "left_anti"):
+        # SQL semi/anti joins emit probe columns only — and "the" build match
+        # is ambiguous for semi (sorted-build order != host input order)
+        out_build = jnp.zeros((spec.out_capacity, spec.build_width), spec.dtype)
+    else:
+        out_build = jnp.where((ok & ~unmatched)[:, None], sbv[li], zero)
     out_probe = jnp.where(ok[:, None], rpv[j], zero)
     outs = (out_keys, out_build, out_probe, total[None], jnp.stack([rbtotal, rptotal])[None, :])
-    if left_outer:
+    if spec.join_type == "left_outer":
         outs += (ok & ~unmatched,)  # out_matched: False = null-extended row
     return outs
 
@@ -569,14 +607,14 @@ def plan_join_capacities(
     build_keys: np.ndarray,
     probe_keys: np.ndarray,
     num_executors: int,
-    left_outer: bool = False,
+    join_type: str = "inner",
 ) -> Tuple[int, int, int]:
     """Exact per-shard (build_recv, probe_recv, out) capacities for a hash
     join of these keys, from the host twin of the device placement hash —
     what any driver should do instead of guessing skew headroom.  Key k's
-    rows land on its owner shard and emit pcount(k) * bcount(k) matches
-    there (left-outer: pcount(k) * max(bcount(k), 1) — unmatched probe rows
-    still emit their null-extension row)."""
+    rows land on its owner shard and emit ``pcount(k) * f(bcount(k))``
+    rows there, with f per the join type (inner: b; left_outer: max(b, 1);
+    left_semi: min(b, 1); left_anti: b == 0)."""
     n = num_executors
     brecv = max(1, int(np.bincount(hash_owners_host(build_keys, n), minlength=n).max()))
     precv = max(1, int(np.bincount(hash_owners_host(probe_keys, n), minlength=n).max()))
@@ -585,7 +623,7 @@ def plan_join_capacities(
     present = np.isin(uk_p, uk_b)
     bcount = np.zeros(len(uk_p), np.int64)
     bcount[present] = cb[np.searchsorted(uk_b, uk_p[present])]
-    per_key = cp * (np.maximum(bcount, 1) if left_outer else bcount)
+    per_key = cp * _join_emit(join_type)(bcount, np)
     per_shard = np.zeros(n, np.int64)
     if len(uk_p):
         np.add.at(per_shard, hash_owners_host(uk_p, n), per_key)
@@ -610,7 +648,9 @@ def run_hash_join(
     host plan.  Returns flat (keys, build_rows, probe_rows) in
     shard-concatenated order — compare as a multiset (``oracle_join`` returns
     one); with ``join_type='left_outer'`` a fourth ``matched`` bool array is
-    returned (False rows are null-extended: zeroed build lanes).  The
+    returned (False rows are null-extended: zeroed build lanes).
+    ``'left_semi'``/``'left_anti'`` keep the 3-tuple with build lanes zeroed
+    (SQL semi/anti emit probe columns only).  The
     capacity-planning + unpack half every join caller needs, like
     run_grouped_aggregate is for GROUP BY.  ``build_capacity``/
     ``probe_capacity`` override the tight per-shard input capacities (callers
@@ -624,7 +664,7 @@ def run_hash_join(
     bcap = build_capacity or max(1, -(-len(build_keys) // n))
     pcap = probe_capacity or max(1, -(-len(probe_keys) // n))
     brecv, precv, out_cap = plan_join_capacities(
-        build_keys, probe_keys, n, left_outer=(join_type == "left_outer")
+        build_keys, probe_keys, n, join_type=join_type
     )
     spec = JoinSpec(
         num_executors=n,
@@ -679,7 +719,9 @@ def oracle_join(
     sorted multiset of tuples for order-insensitive comparison.  With
     ``join_type='left_outer'`` a fourth ``matched`` bool array is returned and
     unmatched probe rows emit one zero-build row each (run_hash_join's null
-    convention)."""
+    convention); ``'left_semi'`` emits each matched probe row once and
+    ``'left_anti'`` each matchless probe row once, both with zeroed build
+    lanes (SQL semi/anti emit probe columns only)."""
     from collections import defaultdict
 
     left_outer = join_type == "left_outer"
@@ -690,6 +732,16 @@ def oracle_join(
     keys, brows, prows, matched = [], [], [], []
     for k, prow in zip(probe_keys, probe_vals):
         hits = by_key.get(int(k), ())
+        if join_type == "left_semi":
+            # probe columns only: one zero-build row per matched probe row
+            hits = [zero_build] if hits else []
+        elif join_type == "left_anti":
+            if not hits:
+                keys.append(int(k))
+                brows.append(zero_build)
+                prows.append(prow)
+                matched.append(False)
+            continue
         for brow in hits:
             keys.append(int(k))
             brows.append(brow)

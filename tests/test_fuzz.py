@@ -137,7 +137,7 @@ def _run_join_case(seed: int) -> None:
     pvals = rng.integers(-50, 50, size=(ptotal, pw)).astype(np.int32)
 
     mesh = make_mesh(n)
-    join_type = "left_outer" if seed % 3 == 0 else "inner"
+    join_type = ["inner", "left_outer", "left_semi", "left_anti"][seed % 4]
     # over-provisioned input capacities (bcap/pcap >= fill) keep the
     # padding/validity-mask paths under fuzz, not just the tight auto-sizing
     out = run_hash_join(

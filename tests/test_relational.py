@@ -480,3 +480,46 @@ class TestLeftOuterJoin:
                 probe_capacity=8, probe_recv_capacity=8, probe_width=1,
                 out_capacity=8, impl="dense", join_type="full_outer",
             ).validate()
+
+
+class TestSemiAntiJoin:
+    def test_semi_and_anti_partition_the_probe(self, mesh, rng):
+        """Semi + anti outputs together must be exactly the probe rows, split
+        by match existence — EXISTS / NOT EXISTS (TPC-H q4/q21/q22)."""
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        bkeys = rng.integers(0, 25, size=40, dtype=np.uint64).astype(np.uint32)
+        pkeys = rng.integers(0, 50, size=150, dtype=np.uint64).astype(np.uint32)
+        bvals = rng.integers(1, 9, size=(40, 1)).astype(np.int32)
+        pvals = rng.integers(1, 9, size=(150, 2)).astype(np.int32)
+
+        semi = run_hash_join(
+            mesh, bkeys, bvals, pkeys, pvals, impl="dense", join_type="left_semi"
+        )
+        anti = run_hash_join(
+            mesh, bkeys, bvals, pkeys, pvals, impl="dense", join_type="left_anti"
+        )
+        for got, jt in ((semi, "left_semi"), (anti, "left_anti")):
+            wk, wb, wp = oracle_join(bkeys, bvals, pkeys, pvals, join_type=jt)
+            assert sorted(
+                (int(k), tuple(p.tolist())) for k, p in zip(got[0], got[2])
+            ) == sorted((int(k), tuple(p.tolist())) for k, p in zip(wk, wp)), jt
+            assert (got[1] == 0).all(), f"{jt} must zero build lanes"
+        # the partition property
+        exists = np.isin(pkeys, bkeys)
+        assert len(semi[0]) == exists.sum()
+        assert len(anti[0]) == (~exists).sum()
+        assert len(semi[0]) + len(anti[0]) == len(pkeys)
+
+    def test_semi_emits_each_probe_row_once(self, mesh, rng):
+        # heavy build duplication must not multiply semi output
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        bkeys = np.full(90, 7, np.uint32)  # 90 build rows, one key
+        bvals = np.arange(90, dtype=np.int32)[:, None]
+        pkeys = np.array([7, 7, 8], np.uint32)
+        pvals = np.array([[1], [2], [3]], np.int32)
+        jk, jb, jp = run_hash_join(
+            mesh, bkeys, bvals, pkeys, pvals, impl="dense", join_type="left_semi"
+        )
+        assert sorted(jp[:, 0].tolist()) == [1, 2]  # the two key-7 probe rows, once each
