@@ -359,3 +359,53 @@ def test_q13_customer_order_distribution(mesh, rng):
     dist_keys, dist_counts = np.unique(gv[:, 0], return_counts=True)
     assert dist_counts.sum() == n_cust
     assert (want == 0).sum() == dist_counts[dist_keys == 0].sum()
+
+
+def test_q4_order_priority_semi_join(mesh, rng):
+    """q4 shape: orders SEMI JOIN lineitem (EXISTS a late lineitem), the
+    lineitem predicate pushed down as a filter mask, then GROUP BY
+    o_orderpriority COUNT(*) — semi join + WHERE pushdown composed."""
+    from sparkucx_tpu.ops.columnar import shard_rows_host
+    from sparkucx_tpu.ops.relational import run_grouped_aggregate
+
+    num_orders, lineitems = 120, 900
+    o_orderkey = np.arange(num_orders, dtype=np.uint32)
+    o_priority = rng.integers(0, 5, size=num_orders).astype(np.int32)
+    l_orderkey = rng.integers(0, num_orders, size=lineitems, dtype=np.uint64).astype(np.uint32)
+    l_late = rng.random(lineitems) < 0.3  # commitdate < receiptdate
+
+    # device semi join with the lineitem filter below the build exchange
+    bcap = -(-lineitems // N)
+    pcap = -(-num_orders // N)
+    spec = JoinSpec(
+        num_executors=N,
+        build_capacity=bcap, build_recv_capacity=lineitems, build_width=1,
+        probe_capacity=pcap, probe_recv_capacity=num_orders, probe_width=1,
+        out_capacity=num_orders, impl="dense",
+        with_filters=True, join_type="left_semi",
+    )
+    fn = build_hash_join(mesh, spec)
+    bk, bv, bn = shard_rows_host(l_orderkey, np.zeros((lineitems, 1), np.int32), N, bcap)
+    bm, _, _ = shard_rows_host(l_late.astype(np.uint32), np.zeros((lineitems, 0), np.int32), N, bcap)
+    pk, pv, pn = shard_rows_host(o_orderkey, o_priority[:, None], N, pcap)
+    out = fn(
+        *_shard(mesh, bk, bv, bn), *_shard(mesh, pk, pv, pn),
+        jax.device_put(bm.astype(bool), NamedSharding(mesh, P("ex"))),
+        jax.device_put(np.ones(N * pcap, bool), NamedSharding(mesh, P("ex"))),
+    )
+    jk, _, jp = _join_to_host(*out[:4], out[4])
+
+    # GROUP BY priority COUNT(*) over the qualifying orders
+    agg_spec = AggregateSpec(
+        num_executors=N, capacity=-(-max(len(jk), 1) // N),
+        recv_capacity=4 * -(-max(len(jk), 1) // N), aggs=(),
+    )
+    gk, gv, gc = run_grouped_aggregate(
+        mesh, agg_spec, jp[:, 0].astype(np.uint32), np.zeros((len(jk), 0), np.int32)
+    )
+
+    # numpy oracle: orders with >= 1 late lineitem, counted by priority
+    exists = np.isin(o_orderkey, np.unique(l_orderkey[l_late]))
+    want_k, want_c = np.unique(o_priority[exists], return_counts=True)
+    assert np.array_equal(gk, want_k.astype(np.uint32))
+    assert np.array_equal(gc, want_c)
