@@ -117,3 +117,33 @@ def test_q42_category_sum_pure_agg(mesh, rng):
         assert out_v[i, 0] == price[m].sum()
         assert out_c[i] == m.sum()
     assert set(out_k.tolist()) == set(np.unique(keys).tolist())
+
+
+def test_q16_exclusion_anti_join(mesh, rng):
+    """q16/q93 shape: catalog sales EXCLUDING orders that appear in returns —
+    a NOT EXISTS anti join feeding an aggregate, the TPC-DS exclusion idiom."""
+    from sparkucx_tpu.ops.relational import run_grouped_aggregate, run_hash_join
+
+    num_orders, returns = 600, 150
+    cs_order = rng.integers(0, num_orders, size=1500, dtype=np.uint64).astype(np.uint32)
+    cs_price = rng.integers(1, 200, size=(1500, 1)).astype(np.int32)
+    cr_order = rng.choice(num_orders, size=returns, replace=False).astype(np.uint32)
+
+    jk, jb, jp = run_hash_join(
+        mesh,
+        cr_order, np.zeros((returns, 1), np.int32),  # build = returned orders
+        cs_order, cs_price,                           # probe = catalog sales
+        impl="dense", join_type="left_anti",
+    )
+    assert (jb == 0).all()
+    # aggregate net sales over the surviving rows: one global group
+    spec = AggregateSpec(
+        num_executors=N, capacity=-(-max(len(jk), 1) // N),
+        recv_capacity=4 * -(-max(len(jk), 1) // N), aggs=("sum",),
+    )
+    gk, gv, gc = run_grouped_aggregate(
+        mesh, spec, np.zeros(len(jk), np.uint32), jp[:, 0][:, None]
+    )
+    keep = ~np.isin(cs_order, cr_order)
+    assert gc[0] == keep.sum()
+    assert gv[0, 0] == cs_price[keep, 0].sum()
