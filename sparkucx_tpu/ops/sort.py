@@ -141,7 +141,7 @@ def _sort_body(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, num_vali
     #    caller's padding was not sentinel-keyed).
     idx = jnp.arange(spec.capacity, dtype=jnp.int32)
     keys = jnp.where(idx < nv, keys, KEY_MAX)
-    order = jnp.argsort(keys)
+    order = jnp.argsort(keys, stable=True)  # stability is the documented contract
     skeys = keys[order]
     spay = gather_rows(payload, order)
 
@@ -173,7 +173,7 @@ def _sort_body(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, num_vali
     rkeys = jax.lax.bitcast_convert_type(recv[:, 0], jnp.uint32)
     ridx = jnp.arange(spec.recv_capacity, dtype=jnp.int32)
     rkeys = jnp.where(ridx < total, rkeys, KEY_MAX)
-    rorder = jnp.argsort(rkeys)
+    rorder = jnp.argsort(rkeys, stable=True)
     out_keys = rkeys[rorder]
     out_pay = gather_rows(recv[:, 1:], rorder)
     return out_keys, out_pay, total[None]
@@ -189,7 +189,7 @@ def _sort_body_single(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, n
     nv = num_valid[0]
     idx = jnp.arange(spec.capacity, dtype=jnp.int32)
     keys = jnp.where(idx < nv, keys, KEY_MAX)
-    order = jnp.argsort(keys)
+    order = jnp.argsort(keys, stable=True)
     out_keys = keys[order]
     # valid rows sort to the front (stable argsort, padding keys KEY_MAX), so
     # zeroing the tail matches the collective lowerings' output contract —
