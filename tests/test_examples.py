@@ -19,6 +19,12 @@ def test_examples_dir_has_scripts():
     assert len(SCRIPTS) >= 4
 
 
+def test_readme_lists_every_script():
+    readme = (EXAMPLES_DIR / "README.md").read_text()
+    for script in SCRIPTS:
+        assert script in readme, f"examples/README.md does not mention {script}"
+
+
 @pytest.mark.parametrize("script", SCRIPTS)
 def test_example_runs(script):
     env = dict(os.environ)
