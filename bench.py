@@ -61,11 +61,15 @@ CHAIN = int(os.environ.get("BENCH_CHAIN", "256"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 TCP_BYTES = int(os.environ.get("BENCH_TCP_BYTES", str(256 << 20)))
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "30"))
-# 4 attempts with backoff (~2.5 min worst case, well inside DEADLINE): the
-# tunnel flaps for minutes at a time and a single-probe failure would record
-# a round with no TPU number at all
-PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "4"))
-DEADLINE = float(os.environ.get("BENCH_DEADLINE", "720"))
+# Probe until this much of the deadline budget remains (enough for the
+# superstep + sub-metric measurements once the chip answers): the tunnel
+# flaps for minutes-to-hours at a time, and a round whose gate records null
+# is a round whose headline is unverifiable after the fact (BENCH_r02/r04).
+PROBE_RESERVE = float(os.environ.get("BENCH_PROBE_RESERVE", "420"))
+# optional hard cap on probe attempts (0 = keep going until the reserve);
+# lets an operator fail fast without waiting out the deadline budget
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "0"))
+DEADLINE = float(os.environ.get("BENCH_DEADLINE", "1200"))
 SKIP_SUBMETRICS = os.environ.get("BENCH_SKIP_SUBMETRICS", "") == "1"
 
 RESULT = {
@@ -96,13 +100,19 @@ def _watchdog() -> None:
     os._exit(0)
 
 
-def probe_tpu() -> tuple:
-    """Bounded out-of-process backend probe.
+def probe_tpu(budget_left) -> tuple:
+    """Bounded out-of-process backend probe with deadline-aware retries.
 
     A dead chip tunnel makes ``jax.devices()`` block forever inside
     ``make_c_api_client`` (no Python-level timeout can interrupt it), so the
-    first backend touch happens in a killable subprocess.  Returns
-    ``(platform, error)`` — platform is None on failure.
+    first backend touch happens in a killable subprocess.  The tunnel flaps
+    for long stretches, so a single failed probe must not write off the
+    round: keep retrying with backoff until only ``PROBE_RESERVE`` seconds of
+    deadline remain (the time the measurements themselves need).  Each failed
+    attempt is logged to stderr so a null round shows its retry history.
+    ``budget_left`` (required) returns the seconds of deadline remaining;
+    ``BENCH_PROBE_ATTEMPTS`` > 0 additionally caps the attempt count.
+    Returns ``(platform, error)`` — platform is None on failure.
     """
     # honor JAX_PLATFORMS even when a site hook pinned jax_platforms (the same
     # override parallel/mesh.apply_platform_env handles in-process)
@@ -113,7 +123,9 @@ def probe_tpu() -> tuple:
         "d = jax.devices(); print(d[0].platform, len(d))\n"
     )
     last = "unknown"
-    for attempt in range(PROBE_ATTEMPTS):
+    attempt = 0
+    while True:
+        attempt += 1
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -129,9 +141,26 @@ def probe_tpu() -> tuple:
             last = last[0][:300]
         except subprocess.TimeoutExpired:
             last = f"backend init timed out after {PROBE_TIMEOUT}s (tunnel down?)"
-        if attempt + 1 < PROBE_ATTEMPTS:
-            time.sleep(3 * (attempt + 1))
-    return None, last
+        if PROBE_ATTEMPTS and attempt >= PROBE_ATTEMPTS:
+            print(
+                f"# probe attempt {attempt} failed ({last}); attempt cap reached",
+                file=sys.stderr,
+            )
+            return None, f"{last} [after {attempt} probe attempts]"
+        remaining = budget_left()
+        backoff = min(5.0 * attempt, 30.0)
+        if remaining - backoff - PROBE_TIMEOUT <= PROBE_RESERVE:
+            print(
+                f"# probe attempt {attempt} failed ({last}); budget exhausted",
+                file=sys.stderr,
+            )
+            return None, f"{last} [after {attempt} probe attempts]"
+        print(
+            f"# probe attempt {attempt} failed ({last}); retrying in {backoff:.0f}s "
+            f"({remaining:.0f}s of deadline left)",
+            file=sys.stderr,
+        )
+        time.sleep(backoff)
 
 
 def tcp_shuffle_read_gbps(total_bytes: int, chunk: int = 1 << 20) -> float:
@@ -260,18 +289,17 @@ def main():
         RESULT["tcp_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # 2. Bounded chip probe — never touch the backend in-process before this.
-    platform, probe_err = probe_tpu()
+    platform, probe_err = probe_tpu(budget_left)
     if platform is None:
         RESULT["tpu"] = None
         RESULT["error"] = f"backend unreachable: {probe_err}"
-        # honest provenance for a null round: where the last in-session
-        # hardware measurements live (the tunnel drops for hours at a time)
+        # honest provenance for a null round: point at the measurement log
+        # rather than baking numbers into this string (they go stale the
+        # moment the harness changes — see ADVICE r4)
         RESULT["note"] = (
-            "chip tunnel down at bench time; in-session measured numbers and "
-            "their configs are recorded in docs/PERF.md (last full capture of "
-            "THIS harness 2026-07-30: value=255.239 GB/s vs_baseline=161.5, "
-            "gather_gbps=134.5 impl=dma, gather_xla_gbps=4.05, "
-            "sort_mrows_s=20.7 impl=single, integrity=pass)"
+            "chip tunnel down for the whole probe window; the most recent "
+            "in-session hardware captures, with their configs, dates, and "
+            "commits, are recorded in docs/PERF.md (measured-results table)"
         )
         emit_once()
         return
@@ -360,16 +388,37 @@ def main():
             if budget_left() < 150:
                 raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
             gb_impls = []
+            wire = []
             RESULT["groupby_mrows_s"] = round(
                 measure_groupby(
                     1, 1 << 21, REPEATS,
                     report=lambda it, dt, rows, impl: gb_impls.append(impl),
+                    wire_rows=wire,
                 ), 3,
             )
             if gb_impls:
                 RESULT["groupby_impl"] = gb_impls[-1]
+            if wire:
+                RESULT["groupby_wire_rows"] = wire[0]
         except Exception as e:
             RESULT["groupby_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            # Same workload with map-side partial aggregation below the
+            # exchange (conf partialAggregation, on by default for jobs):
+            # wire rows collapse from ~2M to ~n_senders * 100 keys.
+            if budget_left() < 150:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            wire_p = []
+            gb_rows = 1 << 21
+            RESULT["groupby_partial_mrows_s"] = round(
+                measure_groupby(1, gb_rows, REPEATS, partial=True, wire_rows=wire_p),
+                3,
+            )
+            if wire_p and wire_p[0]:
+                RESULT["groupby_partial_wire_rows"] = wire_p[0]
+                RESULT["groupby_wire_reduction"] = round(gb_rows / wire_p[0], 1)
+        except Exception as e:
+            RESULT["groupby_partial_error"] = f"{type(e).__name__}: {e}"[:200]
 
     emit_once()
 

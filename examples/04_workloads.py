@@ -30,18 +30,27 @@ from sparkucx_tpu.ops.tc import TcSpec, oracle_tc, run_transitive_closure
 def groupby(mesh, n: int) -> None:
     # GroupByTest's shape: random keys from a small keyspace, grouped; the
     # gate's pass criterion is the distinct-key count (test.sh:163-167).
+    # Map-side partial aggregation (Spark's HashAggregateExec(partial)) is
+    # taken from the conf toggle, on by default — each shard exchanges at
+    # most one partial row per local distinct key instead of every raw row.
+    from sparkucx_tpu.config import TpuShuffleConf
+
     total, num_keys = 20_000, 100
+    partial = TpuShuffleConf().partial_aggregation
     rng = np.random.default_rng(5)
     keys = rng.integers(0, num_keys, size=total).astype(np.uint32)
     values = rng.integers(0, 1000, size=(total, 2)).astype(np.int32)
     spec = AggregateSpec(
         num_executors=n, capacity=-(-total // n), recv_capacity=4 * -(-total // n),
-        aggs=("sum", "max"),
+        aggs=("sum", "max"), partial=partial,
     )
     gk, gv, gc = run_grouped_aggregate(mesh, spec, keys, values)
     wk, wv, wc = oracle_aggregate(keys, values, spec.aggs)
     assert np.array_equal(gk, wk) and np.array_equal(gv, wv) and np.array_equal(gc, wc)
-    print(f"OK: GROUP BY over {total} rows -> {len(gk)} groups, oracle-exact")
+    print(
+        f"OK: GROUP BY over {total} rows -> {len(gk)} groups, oracle-exact "
+        f"(partial aggregation {'on' if partial else 'off'})"
+    )
 
 
 def join(mesh, n: int) -> None:

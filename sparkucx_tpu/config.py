@@ -131,6 +131,13 @@ class TpuShuffleConf:
     #: Ragged block-gather lowering: 'auto' (pipelined DMA kernel on TPU, XLA
     #: gather elsewhere) | 'dma' | 'tiled' | 'xla'.
     gather_impl: str = "auto"
+    #: Map-side partial aggregation below the exchange for GROUP BY jobs
+    #: (AggregateSpec.partial) — Spark's HashAggregateExec(partial) under the
+    #: ShuffleExchange, on by default exactly as in Spark.  Shrinks exchange
+    #: traffic by the group-reduction factor and bounds hot-key skew to one
+    #: partial row per (sender, key); disable to force the raw-row exchange
+    #: (count_distinct plans do so automatically — partials don't compose).
+    partial_aggregation: bool = True
 
     # instrumentation
     collect_stats: bool = True
@@ -189,6 +196,7 @@ class TpuShuffleConf:
             ("meshAxisName", "mesh_axis_name", str),
             ("keepDeviceRecv", "keep_device_recv", lambda v: str(v).lower() == "true"),
             ("gatherImpl", "gather_impl", str),
+            ("partialAggregation", "partial_aggregation", lambda v: str(v).lower() == "true"),
             ("spillToDisk", "spill_to_disk", lambda v: str(v).lower() == "true"),
             ("spillDir", "spill_dir", str),
             ("spillDiskCap", "spill_disk_cap_bytes", parse_size),

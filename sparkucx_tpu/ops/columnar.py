@@ -226,6 +226,10 @@ def shard_rows_host(
     tests — one definition of the sharding convention."""
     n, cap = num_shards, capacity
     total = len(keys)
+    if values.shape[0] != total:
+        raise ValueError(
+            f"keys/values row mismatch: {total} keys vs {values.shape[0]} value rows"
+        )
     if total > n * cap:
         raise ValueError(f"{total} rows exceed {n} x {cap} capacity")
     width = values.shape[1]

@@ -52,7 +52,11 @@ from sparkucx_tpu.ops.sort import KEY_MAX  # noqa: E402  (re-export)
 #: Multiplicative hash constant (Knuth); uint32 wraparound is the mixing step.
 _HASH_MULT = np.uint32(2654435761)
 
-VALID_AGGS = ("sum", "min", "max")
+#: 'avg' is computed as a fused sum on device (the count is always produced
+#: alongside), divided exactly in the host driver — Spark's partial-avg plan
+#: (HashAggregateExec emits sum+count partials, the final stage divides).
+#: 'count_distinct' counts distinct values of its column per group, on device.
+VALID_AGGS = ("sum", "min", "max", "avg", "count_distinct")
 
 #: join_type -> rows emitted per probe row with m build matches.  ONE table
 #: serves both the device kernel (xp=jnp in expand_matches) and the host
@@ -64,6 +68,18 @@ _JOIN_EMIT = {
     "left_semi": lambda m, xp: xp.minimum(m, 1),
     "left_anti": lambda m, xp: 1 - xp.minimum(m, 1),
 }
+
+#: right/full outer decompose into a probe-driven base expansion plus an
+#: appended pass over unmatched BUILD rows (a build-side match-flag scan —
+#: probe-row emission counts alone cannot express them).
+_OUTER_BASE = {"right_outer": "inner", "full_outer": "left_outer"}
+
+#: join types whose compiled fn emits the extra ``out_matched`` output
+#: (False = null-extended row: zeroed build lanes for an unmatched probe row,
+#: zeroed probe lanes for an unmatched build row).
+OUTER_JOIN_TYPES = ("left_outer", "right_outer", "full_outer")
+
+JOIN_TYPES = tuple(_JOIN_EMIT) + tuple(_OUTER_BASE)
 
 
 def _join_emit(join_type: str):
@@ -130,8 +146,11 @@ class AggregateSpec:
     ``capacity``: per-executor input rows; ``recv_capacity``: per-executor rows
     after the hash exchange (>= worst-case skew of hash(key) % n — with K
     distinct keys expect ~total/n, so leave headroom like SortSpec does);
-    ``aggs``: one of 'sum'|'min'|'max' per value column.  A per-group COUNT is
-    always produced (it is also COUNT(*) when there are no value columns)."""
+    ``aggs``: one of ``VALID_AGGS`` ('sum'|'min'|'max'|'avg'|'count_distinct')
+    per value column — 'avg' is a fused sum on device divided by the count in
+    the host driver, 'count_distinct' counts distinct column values per group.
+    A per-group COUNT is always produced (it is also COUNT(*) when there are
+    no value columns)."""
 
     num_executors: int
     capacity: int
@@ -145,6 +164,19 @@ class AggregateSpec:
     #: owner is the never-sent n) — Spark SQL's Filter below the Exchange,
     #: on device instead of pre-filtered host tables.
     with_filter: bool = False
+    #: True performs MAP-SIDE PARTIAL AGGREGATION below the exchange — Spark's
+    #: HashAggregateExec(partial) under the ShuffleExchange: each shard first
+    #: segment-reduces its own rows to at most one partial row per local
+    #: distinct key (agg columns + a count), exchanges the PARTIALS, and the
+    #: final merge re-reduces them (sum/min/max/avg compose; count becomes
+    #: sum-of-counts).  For GroupByTest-shaped data (a small keyspace over
+    #: millions of rows, buildlib/test.sh:163-173) this shrinks exchange
+    #: traffic by the group-reduction factor — and it bounds hot-key skew:
+    #: each shard sends at most ONE row per key, so a hot key lands
+    #: ``num_executors`` partial rows on its owner, not the raw row count.
+    #: Results are bit-identical for integer dtypes (int32 adds associate);
+    #: 'count_distinct' is rejected (distinct counts do not compose by sum).
+    partial: bool = False
 
     @property
     def width(self) -> int:
@@ -165,13 +197,108 @@ class AggregateSpec:
         for a in self.aggs:
             if a not in VALID_AGGS:
                 raise ValueError(f"unknown aggregation {a!r} (valid: {VALID_AGGS})")
+        if self.partial and "count_distinct" in self.aggs:
+            raise ValueError(
+                "count_distinct cannot use partial aggregation (per-shard "
+                "distinct counts do not compose by sum); use partial=False"
+            )
 
 
 def _agg_identity(agg: str, dtype) -> jnp.ndarray:
-    if agg == "sum":
+    if agg in ("sum", "avg", "count_distinct"):
         return jnp.zeros((), dtype)
     info = jnp.finfo(dtype) if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype)
     return jnp.array(info.max if agg == "min" else info.min, dtype)
+
+
+def _segment_reduce(
+    aggs: Tuple[str, ...],
+    out_cap: int,
+    keys,
+    vals,
+    valid,
+    counts=None,
+    tight: bool = True,
+):
+    """Stable key-sort + segment-reduce — the GROUP BY kernel shared by the
+    post-exchange final phase and the map-side partial phase.
+
+    ``counts`` carries pre-aggregated row counts when the inputs are partial
+    rows (group count = sum of partial counts); None counts raw rows.
+    ``tight=True`` asserts valid rows form a prefix (post-exchange compaction
+    guarantees it; so does an unmasked local shard) and sorts once; with a
+    scattered validity pattern (WHERE-pushdown masks) an extra stable pass on
+    the validity flag keeps valid sentinel-keyed rows ahead of invalid ones
+    inside the KEY_MAX tie.  Returns (group_keys, group_vals, group_count,
+    num_groups); groups are numbered in ascending key order.
+    """
+    pk = padded_keys(keys, valid)
+    order = jnp.argsort(pk, stable=True)
+    if not tight:
+        order = order[jnp.argsort(jnp.logical_not(valid)[order], stable=True)]
+    skeys = keys[order]
+    svals = vals[order]
+    svalid = valid[order]
+    scounts = counts[order] if counts is not None else svalid.astype(jnp.int32)
+    prev_differs = jnp.concatenate([jnp.ones(1, bool), skeys[1:] != skeys[:-1]])
+    is_start = prev_differs & svalid
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    # Padding rows scatter out of range and are dropped.
+    seg = jnp.where(svalid, seg, out_cap)
+    num_groups = is_start.sum().astype(jnp.int32)
+
+    group_keys = jnp.zeros(out_cap, jnp.uint32).at[seg].set(skeys, mode="drop")
+    group_count = (
+        jnp.zeros(out_cap, jnp.int32)
+        .at[seg]
+        .add(jnp.where(svalid, scounts, 0), mode="drop")
+    )
+    cols = []
+    for c, agg in enumerate(aggs):
+        if agg == "count_distinct":
+            cols.append(
+                _distinct_count_col(out_cap, pk, vals[:, c], valid).astype(svals.dtype)
+            )
+            continue
+        ident = _agg_identity(agg, svals.dtype)
+        col = jnp.where(svalid, svals[:, c], ident)
+        acc = jnp.full(out_cap, ident)
+        if agg in ("sum", "avg"):
+            acc = acc.at[seg].add(col, mode="drop")
+        elif agg == "min":
+            acc = acc.at[seg].min(col, mode="drop")
+        else:
+            acc = acc.at[seg].max(col, mode="drop")
+        cols.append(acc)
+    group_vals = (
+        jnp.stack(cols, axis=1) if cols else jnp.zeros((out_cap, 0), svals.dtype)
+    )
+    return group_keys, group_vals, group_count, num_groups
+
+
+def _distinct_count_col(out_cap: int, pk, col, valid):
+    """COUNT(DISTINCT col) per group: lexsort rows by (validity, key, value)
+    — three stable argsorts, innermost first — so each group's values are
+    contiguous AND sorted, then count (key, value) pair starts per segment.
+    Group numbering (ascending distinct valid keys) matches
+    :func:`_segment_reduce`'s, so the scattered counts align with its groups.
+    """
+    order = jnp.argsort(col, stable=True)
+    order = order[jnp.argsort(pk[order], stable=True)]
+    order = order[jnp.argsort(jnp.logical_not(valid)[order], stable=True)]
+    sk = pk[order]
+    sv = col[order]
+    svalid = valid[order]
+    key_start = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+    is_start = key_start & svalid
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    seg = jnp.where(svalid, seg, out_cap)
+    pair_start = key_start | jnp.concatenate([jnp.ones(1, bool), sv[1:] != sv[:-1]])
+    return (
+        jnp.zeros(out_cap, jnp.int32)
+        .at[seg]
+        .add((pair_start & svalid).astype(jnp.int32), mode="drop")
+    )
 
 
 def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
@@ -184,56 +311,40 @@ def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
         # compacted received prefix and is agnostic to the input pattern
         valid &= mask
 
+    counts = None
+    if spec.partial:
+        # Map-side partial aggregation (HashAggregateExec(partial) below the
+        # Exchange): reduce locally first, then exchange one row per local
+        # distinct key carrying (key | agg columns | count).  The count lane
+        # travels BITCAST through the value dtype, so it is exact for any
+        # 32-bit dtype (a float32 cast would silently round counts > 2^24).
+        lk, lv, lc, lng = _segment_reduce(
+            spec.aggs, cap, keys, values, valid, tight=(mask is None)
+        )
+        keys = lk
+        values = jnp.concatenate(
+            [lv, jax.lax.bitcast_convert_type(lc, spec.dtype)[:, None]], axis=1
+        )
+        valid = idx < lng
+
     cspec = ColumnarSpec(
         num_executors=spec.num_executors,
         capacity=cap,
         recv_capacity=spec.recv_capacity,
-        width=spec.width + 1,
+        width=spec.width + (2 if spec.partial else 1),
         dtype=spec.dtype,
         axis_name=spec.axis_name,
         impl=spec.impl,
     )
     rkeys, rvals, rvalid, rtotal = exchange_keyed_rows(cspec, keys, values, valid)
+    if spec.partial:
+        counts = jax.lax.bitcast_convert_type(rvals[:, -1], jnp.int32)
+        rvals = rvals[:, :-1]
 
-    # Local GROUP BY: stable sort with padding forced to KEY_MAX (valid
-    # sentinel-keyed rows stay ahead of padding within the tie), segment-reduce.
-    order = jnp.argsort(padded_keys(rkeys, rvalid), stable=True)
-    skeys = rkeys[order]
-    svals = rvals[order]
-    svalid = rvalid[order]
-    prev_differs = jnp.concatenate(
-        [jnp.ones(1, bool), skeys[1:] != skeys[:-1]]
-    )
-    is_start = prev_differs & svalid
-    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
-    # Padding rows scatter out of range and are dropped.
-    seg = jnp.where(svalid, seg, spec.recv_capacity)
-    num_groups = is_start.sum().astype(jnp.int32)
-
-    group_keys = (
-        jnp.zeros(spec.recv_capacity, jnp.uint32).at[seg].set(skeys, mode="drop")
-    )
-    group_count = (
-        jnp.zeros(spec.recv_capacity, jnp.int32)
-        .at[seg]
-        .add(svalid.astype(jnp.int32), mode="drop")
-    )
-    cols = []
-    for c, agg in enumerate(spec.aggs):
-        ident = _agg_identity(agg, svals.dtype)
-        col = jnp.where(svalid, svals[:, c], ident)
-        acc = jnp.full(spec.recv_capacity, ident)
-        if agg == "sum":
-            acc = acc.at[seg].add(col, mode="drop")
-        elif agg == "min":
-            acc = acc.at[seg].min(col, mode="drop")
-        else:
-            acc = acc.at[seg].max(col, mode="drop")
-        cols.append(acc)
-    group_vals = (
-        jnp.stack(cols, axis=1)
-        if cols
-        else jnp.zeros((spec.recv_capacity, 0), svals.dtype)
+    # Final GROUP BY on the received (raw or partial) rows: sum/min/max/avg
+    # compose with themselves, counts compose by sum.
+    group_keys, group_vals, group_count, num_groups = _segment_reduce(
+        spec.aggs, spec.recv_capacity, rkeys, rvals, rvalid, counts=counts
     )
     return group_keys, group_vals, group_count, num_groups[None], rtotal[None]
 
@@ -253,10 +364,15 @@ def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
     * ``group_keys``: (n * recv_capacity,) uint32 — shard j's first
       ``num_groups[j]`` entries are its distinct keys (each key appears on
       exactly one shard, ascending within the shard);
-    * ``group_values``: aggregated value per group/column (aligned rows);
+    * ``group_values``: aggregated value per group/column (aligned rows).
+      'avg' columns carry their SUM on device (the fused sum+count pair —
+      counts are always produced); the host driver divides exactly;
+      'count_distinct' columns carry the per-group distinct value count;
     * ``group_counts``: rows aggregated into each group (COUNT);
     * ``num_groups``: (n,) int32;
-    * ``recv_totals``: (n,) int32 — TRUE rows hashed to each shard.  Any value
+    * ``recv_totals``: (n,) int32 — TRUE rows hashed to each shard (with
+      ``spec.partial``, PARTIAL rows: at most one per (sender, key) — the
+      wire-traffic reduction is visible right here).  Any value
       > ``recv_capacity`` means that shard's exchange truncated and its groups
       are incomplete: re-run with headroom, like SortSpec.recv_capacity.
     """
@@ -359,7 +475,12 @@ class JoinSpec:
       one row, build lanes zeroed — SQL semi joins emit probe columns only
       (q4/q21's correlated EXISTS);
     * ``'left_anti'`` — NOT EXISTS: each matchless probe row emits one row,
-      build lanes zeroed (q22's NOT EXISTS).
+      build lanes zeroed (q22's NOT EXISTS);
+    * ``'right_outer'`` — every valid build row is preserved: inner expansion
+      plus one row per matchless build row (zeroed probe lanes, flagged False
+      in ``out_matched``);
+    * ``'full_outer'`` — both sides preserved: left_outer expansion plus the
+      matchless build rows (TPC-DS q97's store/catalog FULL OUTER JOIN).
 
     ``out_capacity``: per-executor output rows — bound the many-to-many
     expansion (for PK-FK joins like TPC-H's, probe_recv_capacity is enough)."""
@@ -393,7 +514,10 @@ class JoinSpec:
             raise ValueError(f"unknown impl {self.impl!r}")
         if np.dtype(self.dtype).itemsize != 4:
             raise ValueError("value dtype must be 32-bit (keys bitcast through it)")
-        _join_emit(self.join_type)  # raises on unknown join_type
+        if self.join_type not in JOIN_TYPES:
+            raise ValueError(
+                f"unknown join_type {self.join_type!r} (valid: {JOIN_TYPES})"
+            )
 
 
 def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum,
@@ -435,11 +559,14 @@ def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum,
     sbv = rbv[border]
 
     # Match range per probe row (hi clamped at btotal so a KEY_MAX probe key
-    # never matches build padding), expanded into the static output.
+    # never matches build padding), expanded into the static output.  Right
+    # and full outer run their probe-driven BASE expansion here; the build
+    # side's unmatched rows are appended after it.
+    base_type = _OUTER_BASE.get(spec.join_type, spec.join_type)
     j, li, ok, unmatched, total = expand_matches(
         spec.out_capacity, sbk, btotal, rpk, rpvalid,
         spec.probe_recv_capacity, spec.build_recv_capacity,
-        join_type=spec.join_type,
+        join_type=base_type,
     )
     zero = jnp.zeros((), spec.dtype)
     out_keys = jnp.where(ok, rpk[j], jnp.uint32(0))
@@ -450,9 +577,37 @@ def _join_body(spec: JoinSpec, bkeys, bvals, bnum, pkeys, pvals, pnum,
     else:
         out_build = jnp.where((ok & ~unmatched)[:, None], sbv[li], zero)
     out_probe = jnp.where(ok[:, None], rpv[j], zero)
+    out_matched = ok & ~unmatched
+    if spec.join_type in _OUTER_BASE:
+        # Build-side match-flag pass: sort the probe keys, binary-search each
+        # valid build row, and append the matchless build rows (zeroed probe
+        # lanes, matched=False) compacted after the base expansion.  Equal
+        # keys are indistinguishable, so clamping the right bound at ptotal
+        # handles valid-KEY_MAX vs padding exactly as expand_matches does.
+        ptotal = rpvalid.sum().astype(jnp.int32)
+        spk = jnp.sort(padded_keys(rpk, rpvalid))
+        lob = jnp.searchsorted(spk, sbk, side="left").astype(jnp.int32)
+        hib = jnp.minimum(
+            jnp.searchsorted(spk, sbk, side="right").astype(jnp.int32), ptotal
+        )
+        bvalid_sorted = (
+            jnp.arange(spec.build_recv_capacity, dtype=jnp.int32) < btotal
+        )
+        build_unmatched = bvalid_sorted & (jnp.maximum(hib - lob, 0) == 0)
+        dest = jnp.where(
+            build_unmatched,
+            total + exclusive_cumsum(build_unmatched.astype(jnp.int32)),
+            spec.out_capacity,  # matched/padding rows scatter out of range
+        )
+        out_keys = out_keys.at[dest].set(sbk, mode="drop")
+        out_build = out_build.at[dest].set(sbv, mode="drop")
+        # out_probe and out_matched stay zeros/False on the appended rows.
+        ub = build_unmatched.sum().astype(jnp.int32)
+        imax = jnp.int32(np.iinfo(np.int32).max)
+        total = jnp.where(total > imax - ub, imax, total + ub)  # keep saturation
     outs = (out_keys, out_build, out_probe, total[None], jnp.stack([rbtotal, rptotal])[None, :])
-    if spec.join_type == "left_outer":
-        outs += (ok & ~unmatched,)  # out_matched: False = null-extended row
+    if spec.join_type in OUTER_JOIN_TYPES:
+        outs += (out_matched,)  # out_matched: False = null-extended row
     return outs
 
 
@@ -464,10 +619,11 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
     (out_keys, out_build, out_probe, out_counts, recv_totals)`` — with
     ``spec.with_filters`` the signature gains trailing per-row bool
     ``(build_mask, probe_mask)``: False rows never enter either exchange
-    (the filtered-join WHERE pushdown); with ``spec.join_type='left_outer'``
-    the outputs gain a sixth ``out_matched`` (n * out_capacity,) bool —
-    False marks a null-extended row (its out_build lanes are zeros, its
-    out_keys/out_probe are the unmatched probe row's):
+    (the filtered-join WHERE pushdown); with an outer ``spec.join_type``
+    (left_outer / right_outer / full_outer) the outputs gain a sixth
+    ``out_matched`` (n * out_capacity,) bool — False marks a null-extended
+    row (zeroed build lanes for an unmatched probe row; zeroed probe lanes
+    for an unmatched build row of a right/full outer join):
 
     * inputs are sharded like build_grouped_aggregate's (keys uint32, values
       (rows, width) of ``dtype``, num (n,) int32);
@@ -487,7 +643,7 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
     ax = spec.axis_name
 
     extra_in = (P(ax), P(ax)) if spec.with_filters else ()
-    extra_out = (P(ax),) if spec.join_type == "left_outer" else ()
+    extra_out = (P(ax),) if spec.join_type in OUTER_JOIN_TYPES else ()
     shard = jax.shard_map(
         functools.partial(_join_body, spec),
         mesh=mesh,
@@ -502,7 +658,7 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
         in_shardings=(key_sh, row_sh, key_sh) * 2
         + ((key_sh, key_sh) if spec.with_filters else ()),
         out_shardings=(key_sh, row_sh, row_sh, key_sh, row_sh)
-        + ((key_sh,) if spec.join_type == "left_outer" else ()),
+        + ((key_sh,) if spec.join_type in OUTER_JOIN_TYPES else ()),
     )
     fn.spec = spec
     return fn
@@ -523,7 +679,9 @@ def run_grouped_aggregate(
     ``keys``: (T,) uint32; ``values``: (T, len(aggs)).  With a
     ``spec.with_filter`` spec, ``mask`` (T,) bool is required: False rows are
     dropped on device before the exchange.  Returns (group keys ascending,
-    aggregated columns, counts) as host arrays.
+    aggregated columns, counts) as host arrays.  When any column is 'avg' the
+    value array comes back float64 with avg columns divided exactly by the
+    group counts (the device computes the fused sum; counts ride along free).
     """
     n = spec.num_executors
     total = keys.shape[0]
@@ -563,7 +721,13 @@ def run_grouped_aggregate(
                 attempt_spec.recv_capacity,
             )
             order = np.argsort(keys_h)
-            return keys_h[order], vals_h[order], cnts_h[order]
+            keys_h, vals_h, cnts_h = keys_h[order], vals_h[order], cnts_h[order]
+            if "avg" in spec.aggs:
+                vals_h = vals_h.astype(np.float64)
+                for c, agg in enumerate(spec.aggs):
+                    if agg == "avg":
+                        vals_h[:, c] /= np.maximum(cnts_h, 1)
+            return keys_h, vals_h, cnts_h
         attempt_spec = replace(
             attempt_spec, recv_capacity=2 * attempt_spec.recv_capacity
         )
@@ -581,12 +745,21 @@ def run_grouped_aggregate(
 def oracle_aggregate(
     keys: np.ndarray, values: np.ndarray, aggs: Sequence[str]
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """numpy reference: (distinct keys ascending, aggregated columns, counts)."""
+    """numpy reference: (distinct keys ascending, aggregated columns, counts).
+    Mirrors run_grouped_aggregate's output conventions: 'avg' columns are
+    exact float64 sum/count (and flip the whole value array to float64);
+    'count_distinct' columns carry per-group distinct value counts."""
     uniq, inv, counts = np.unique(keys, return_inverse=True, return_counts=True)
     cols = []
     for c, agg in enumerate(aggs):
-        if agg == "sum":
-            cols.append(np.bincount(inv, weights=values[:, c].astype(np.float64), minlength=len(uniq)).astype(values.dtype))
+        if agg in ("sum", "avg"):
+            s = np.bincount(inv, weights=values[:, c].astype(np.float64), minlength=len(uniq))
+            cols.append((s / counts) if agg == "avg" else s.astype(values.dtype))
+        elif agg == "count_distinct":
+            nd = np.zeros(len(uniq), np.int64)
+            for g in range(len(uniq)):
+                nd[g] = len(np.unique(values[inv == g, c]))
+            cols.append(nd.astype(values.dtype))
         else:
             red = np.minimum if agg == "min" else np.maximum
             ident = (
@@ -614,7 +787,8 @@ def plan_join_capacities(
     what any driver should do instead of guessing skew headroom.  Key k's
     rows land on its owner shard and emit ``pcount(k) * f(bcount(k))``
     rows there, with f per the join type (inner: b; left_outer: max(b, 1);
-    left_semi: min(b, 1); left_anti: b == 0)."""
+    left_semi: min(b, 1); left_anti: b == 0); right/full outer additionally
+    emit each probe-matchless build row once on its key's owner shard."""
     n = num_executors
     brecv = max(1, int(np.bincount(hash_owners_host(build_keys, n), minlength=n).max()))
     precv = max(1, int(np.bincount(hash_owners_host(probe_keys, n), minlength=n).max()))
@@ -623,10 +797,17 @@ def plan_join_capacities(
     present = np.isin(uk_p, uk_b)
     bcount = np.zeros(len(uk_p), np.int64)
     bcount[present] = cb[np.searchsorted(uk_b, uk_p[present])]
-    per_key = cp * _join_emit(join_type)(bcount, np)
+    base_type = _OUTER_BASE.get(join_type, join_type)
+    per_key = cp * _join_emit(base_type)(bcount, np)
     per_shard = np.zeros(n, np.int64)
     if len(uk_p):
         np.add.at(per_shard, hash_owners_host(uk_p, n), per_key)
+    if join_type in _OUTER_BASE:
+        only_build = ~np.isin(uk_b, uk_p)
+        if only_build.any():
+            np.add.at(
+                per_shard, hash_owners_host(uk_b[only_build], n), cb[only_build]
+            )
     return brecv, precv, max(1, int(per_shard.max()))
 
 
@@ -647,8 +828,9 @@ def run_hash_join(
     run the compiled join, and verify the device placement agreed with the
     host plan.  Returns flat (keys, build_rows, probe_rows) in
     shard-concatenated order — compare as a multiset (``oracle_join`` returns
-    one); with ``join_type='left_outer'`` a fourth ``matched`` bool array is
-    returned (False rows are null-extended: zeroed build lanes).
+    one); with an outer ``join_type`` (left/right/full) a fourth ``matched``
+    bool array is returned (False rows are null-extended: zeroed build lanes
+    for unmatched probe rows, zeroed probe lanes for unmatched build rows).
     ``'left_semi'``/``'left_anti'`` keep the 3-tuple with build lanes zeroed
     (SQL semi/anti emit probe columns only).  The
     capacity-planning + unpack half every join caller needs, like
@@ -699,7 +881,7 @@ def run_hash_join(
         raise RuntimeError(
             f"join output overflowed the exact host plan ({oc.max()} > {out_cap})"
         )
-    if join_type == "left_outer":
+    if join_type in OUTER_JOIN_TYPES:
         keys, brows, prows, matched = unpack_shard_prefixes(
             (ok, ob, op_, outs[5]), oc, out_cap
         )
@@ -716,15 +898,18 @@ def oracle_join(
     join_type: str = "inner",
 ):
     """numpy reference equi-join: rows (key, build_row, probe_row), as a
-    sorted multiset of tuples for order-insensitive comparison.  With
-    ``join_type='left_outer'`` a fourth ``matched`` bool array is returned and
-    unmatched probe rows emit one zero-build row each (run_hash_join's null
-    convention); ``'left_semi'`` emits each matched probe row once and
-    ``'left_anti'`` each matchless probe row once, both with zeroed build
+    sorted multiset of tuples for order-insensitive comparison.  With an
+    outer ``join_type`` a fourth ``matched`` bool array is returned and
+    null-extended rows zero the missing side (run_hash_join's convention):
+    'left_outer' emits one zero-build row per matchless probe row,
+    'right_outer' inner matches plus one zero-probe row per matchless build
+    row, 'full_outer' both; ``'left_semi'`` emits each matched probe row once
+    and ``'left_anti'`` each matchless probe row once, both with zeroed build
     lanes (SQL semi/anti emit probe columns only)."""
     from collections import defaultdict
 
-    left_outer = join_type == "left_outer"
+    base_type = _OUTER_BASE.get(join_type, join_type)
+    left_outer = base_type == "left_outer"
     by_key = defaultdict(list)
     for k, row in zip(build_keys, build_vals):
         by_key[int(k)].append(row)
@@ -732,10 +917,10 @@ def oracle_join(
     keys, brows, prows, matched = [], [], [], []
     for k, prow in zip(probe_keys, probe_vals):
         hits = by_key.get(int(k), ())
-        if join_type == "left_semi":
+        if base_type == "left_semi":
             # probe columns only: one zero-build row per matched probe row
             hits = [zero_build] if hits else []
-        elif join_type == "left_anti":
+        elif base_type == "left_anti":
             if not hits:
                 keys.append(int(k))
                 brows.append(zero_build)
@@ -752,12 +937,23 @@ def oracle_join(
             brows.append(zero_build)
             prows.append(prow)
             matched.append(False)
+    if join_type in _OUTER_BASE:
+        # right/full outer: append each probe-matchless build row once
+        probe_keyset = {int(k) for k in probe_keys}
+        zero_probe = np.zeros(probe_vals.shape[1], probe_vals.dtype)
+        for k, brow in zip(build_keys, build_vals):
+            if int(k) not in probe_keyset:
+                keys.append(int(k))
+                brows.append(brow)
+                prows.append(zero_probe)
+                matched.append(False)
+    outer = join_type in OUTER_JOIN_TYPES
     if not keys:
         out = (
             np.zeros(0, np.uint32),
             np.zeros((0, build_vals.shape[1]), build_vals.dtype),
             np.zeros((0, probe_vals.shape[1]), probe_vals.dtype),
         )
-        return out + (np.zeros(0, bool),) if left_outer else out
+        return out + (np.zeros(0, bool),) if outer else out
     out = (np.array(keys, np.uint32), np.stack(brows), np.stack(prows))
-    return out + (np.array(matched),) if left_outer else out
+    return out + (np.array(matched),) if outer else out

@@ -104,6 +104,11 @@ def _parse_args(argv):
         help="dimension-side rows (join mode); 0 means -n // 4",
     )
     p.add_argument(
+        "--partial", action="store_true",
+        help="map-side partial aggregation below the exchange (groupby mode; "
+        "conf spark.shuffle.tpu.partialAggregation)",
+    )
+    p.add_argument(
         "--batches", type=int, default=1,
         help="device batches for the out-of-core sort driver (sort mode)",
     )
@@ -421,12 +426,16 @@ def measure_columnar(
 def measure_groupby(
     executors: int, total_rows: int, iterations: int,
     outstanding: int = 8, num_keys: int = 100, report=None,
+    partial: bool = False, wire_rows=None,
 ) -> float:
     """Measurement core of the ``groupby`` mode — the device-resident GROUP BY
     (100 B rows: uint32 key + 24 summed int32 lanes; the GroupByTest workload
     shape, BASELINE.json configs[0]).  Returns best M input rows/s;
     ``report(it, seconds, rows, impl)`` per iteration.  Shared by the CLI and
-    bench.py like measure_sort."""
+    bench.py like measure_sort.  ``partial`` enables map-side partial
+    aggregation below the exchange (conf ``partialAggregation``);
+    ``wire_rows``, if a list, receives the TRUE exchanged row count — the
+    before/after traffic comparison is ``total_rows`` vs that number."""
     from sparkucx_tpu.parallel.mesh import apply_platform_env
 
     apply_platform_env()
@@ -434,20 +443,34 @@ def measure_groupby(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from sparkucx_tpu.ops.exchange import make_mesh
-    from sparkucx_tpu.ops.relational import AggregateSpec, build_grouped_aggregate
+    from sparkucx_tpu.ops.relational import (
+        AggregateSpec, build_grouped_aggregate, hash_owners_host,
+    )
 
     n = executors
     cap = -(-total_rows // n)
-    # hash placement headroom: rows land ~total/n per shard for a uniform
-    # keyspace; 2x absorbs key skew when n > 1 (n == 1 receives everything)
+    rng = np.random.default_rng(0)
+    host_keys = rng.integers(0, num_keys, size=n * cap).astype(np.uint32)
+    # Size receive buffers from the ACTUAL hash placement (like measure_join):
+    # per-shard key granularity concentrates rows far past any fixed headroom
+    # when num_keys is small relative to n.  The overflow assert below then
+    # guards host/device placement agreement, not luck.  With partial
+    # aggregation each sender exchanges at most one row per local distinct
+    # key, so the placement twin counts per-sender distinct keys instead.
+    if partial:
+        per_owner = np.zeros(n, np.int64)
+        for s in range(n):
+            uk = np.unique(host_keys[s * cap : (s + 1) * cap])
+            np.add.at(per_owner, hash_owners_host(uk, n), 1)
+        recv = int(per_owner.max())
+    else:
+        recv = int(np.bincount(hash_owners_host(host_keys, n), minlength=n).max())
     spec = AggregateSpec(
-        num_executors=n, capacity=cap, recv_capacity=cap if n == 1 else 2 * cap,
-        aggs=("sum",) * 24,
+        num_executors=n, capacity=cap, recv_capacity=recv,
+        aggs=("sum",) * 24, partial=partial,
     )
     mesh = make_mesh(n)
     fn = build_grouped_aggregate(mesh, spec)
-    rng = np.random.default_rng(0)
-    host_keys = rng.integers(0, num_keys, size=n * cap).astype(np.uint32)
     keys = jax.device_put(host_keys, NamedSharding(mesh, P("ex")))
     # zeros like measure_sort's payload: the aggregation cost is value-
     # independent, and 200 MB of random host data would crawl through remote
@@ -465,6 +488,8 @@ def measure_groupby(
         f"hash skew overflowed recv_capacity ({recv_totals.max()} > "
         f"{spec.recv_capacity}): use more --keys or fewer executors"
     )
+    if wire_rows is not None:
+        wire_rows.append(int(recv_totals.sum()))
     rows_aggregated = int(np.asarray(out[2]).sum())
     assert rows_aggregated == n * cap, (
         f"groupby dropped rows ({rows_aggregated} != {n * cap})"
@@ -498,9 +523,19 @@ def run_groupby(args) -> None:
             flush=True,
         )
 
+    wire = []
     measure_groupby(
         args.executors, args.num_blocks, args.iterations,
         outstanding=args.outstanding, num_keys=args.keys, report=report,
+        partial=args.partial, wire_rows=wire,
+    )
+    mode = "partial (map-side agg below the exchange)" if args.partial else "raw rows"
+    print(
+        f"exchange traffic [{mode}]: {wire[0]} rows on the wire for "
+        f"{args.num_blocks} input rows ({args.num_blocks / max(wire[0], 1):.0f}x reduction)"
+        if args.partial
+        else f"exchange traffic [{mode}]: {wire[0]} rows on the wire",
+        flush=True,
     )
 
 

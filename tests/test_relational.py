@@ -135,7 +135,12 @@ class TestGroupedAggregate:
     def test_spec_validation(self, mesh):
         with pytest.raises(ValueError, match="unknown aggregation"):
             AggregateSpec(
-                num_executors=N, capacity=8, recv_capacity=8, aggs=("avg",), impl="dense"
+                num_executors=N, capacity=8, recv_capacity=8, aggs=("median",), impl="dense"
+            ).validate()
+        with pytest.raises(ValueError, match="count_distinct"):
+            AggregateSpec(
+                num_executors=N, capacity=8, recv_capacity=8,
+                aggs=("count_distinct",), impl="dense", partial=True,
             ).validate()
         with pytest.raises(ValueError, match="mesh size"):
             build_grouped_aggregate(
@@ -478,8 +483,269 @@ class TestLeftOuterJoin:
                 num_executors=N,
                 build_capacity=8, build_recv_capacity=8, build_width=1,
                 probe_capacity=8, probe_recv_capacity=8, probe_width=1,
-                out_capacity=8, impl="dense", join_type="full_outer",
+                out_capacity=8, impl="dense", join_type="cross",
             ).validate()
+
+
+class TestPartialAggregate:
+    """Map-side partial aggregation below the exchange (spec.partial) —
+    Spark's HashAggregateExec(partial); results must be bit-identical to the
+    unfused path for integer dtypes."""
+
+    def test_bit_equality_with_unfused_fuzz(self, mesh, rng):
+        from sparkucx_tpu.ops.relational import run_grouped_aggregate
+
+        for trial in range(4):
+            total = int(rng.integers(100, 2500))
+            nkeys = int(rng.integers(1, 60))
+            keys = rng.integers(0, nkeys, size=total).astype(np.uint32)
+            values = rng.integers(-1000, 1000, size=(total, 3)).astype(np.int32)
+            spec = AggregateSpec(
+                num_executors=N, capacity=-(-total // N) + 8,
+                recv_capacity=4 * max(32, -(-total // N)),
+                aggs=("sum", "min", "max"), impl="dense",
+            )
+            fused = run_grouped_aggregate(mesh, replace(spec, partial=True), keys, values)
+            plain = run_grouped_aggregate(mesh, spec, keys, values)
+            for f, p in zip(fused, plain):
+                np.testing.assert_array_equal(f, p)
+
+    def test_hot_key_sends_one_partial_per_shard(self, mesh, rng):
+        """The skew-mitigation property: a single hot key exchanges at most
+        one partial row per shard, so recv_totals stays at N even for
+        millions of raw rows."""
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=2 * N,
+            aggs=("sum",), impl="dense", partial=True,
+        )
+        fn = build_grouped_aggregate(mesh, spec)
+        keys = np.full(N * CAP, 99, np.uint32)  # one hot key everywhere
+        values = np.ones((N * CAP, 1), np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        gk, gv, gc, ng, rt = fn(*_agg_inputs(mesh, keys, values, nvalid))
+        assert int(np.asarray(rt).sum()) == N  # one partial per sender
+        rows, _ = _collect_groups_raw(gk, gv, gc, ng)
+        assert rows == {99: ([N * CAP], N * CAP)}
+
+    def test_partial_with_filter_mask(self, mesh, rng):
+        """Scattered WHERE masks compose with the partial path (the local
+        sort must keep valid sentinel-keyed rows ahead of masked ones)."""
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=4 * CAP,
+            aggs=("sum", "max"), impl="dense", with_filter=True, partial=True,
+        )
+        fn = build_grouped_aggregate(mesh, spec)
+        keys = rng.integers(0, 10, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        keys[rng.choice(N * CAP, size=17, replace=False)] = KEY_MAX
+        values = rng.integers(-50, 50, size=(N * CAP, 2)).astype(np.int32)
+        nvalid = np.full(N, CAP, np.int32)
+        mask = rng.random(N * CAP) < 0.5
+        gk, gv, gc, ng, rt = fn(
+            _keys_sh(mesh, keys), _rows_sh(mesh, values), _keys_sh(mesh, nvalid),
+            _keys_sh(mesh, mask),
+        )
+        rows, _ = _collect_groups_raw(gk, gv, gc, ng)
+        wk, wv, wc = oracle_aggregate(keys[mask], values[mask], spec.aggs)
+        assert sorted(rows) == list(wk)
+        for k, v, c in zip(wk, wv, wc):
+            got_v, got_c = rows[int(k)]
+            np.testing.assert_array_equal(got_v, v)
+            assert got_c == c
+
+    def test_float_partials_compose(self, mesh, rng):
+        """min/max float partials compose exactly (no reassociation), and the
+        bitcast count lane survives a float dtype."""
+        spec = AggregateSpec(
+            num_executors=N, capacity=CAP, recv_capacity=4 * CAP,
+            aggs=("min", "max"), dtype=np.dtype(np.float32),
+            impl="dense", partial=True,
+        )
+        fn = build_grouped_aggregate(mesh, spec)
+        keys = rng.integers(0, 16, size=N * CAP, dtype=np.uint64).astype(np.uint32)
+        values = rng.normal(size=(N * CAP, 2)).astype(np.float32)
+        rows, _ = _collect_groups_raw(
+            *fn(*_agg_inputs(mesh, keys, values, np.full(N, CAP, np.int32)))[:4]
+        )
+        wk, wv, wc = oracle_aggregate(keys, values, spec.aggs)
+        for k, v, c in zip(wk, wv, wc):
+            got_v, got_c = rows[int(k)]
+            np.testing.assert_array_equal(np.asarray(got_v, np.float32), v)
+            assert got_c == c  # counts rode the bitcast lane exactly
+
+
+def _collect_groups_raw(gk, gv, gc, ng, *_):
+    """_collect_groups without the fn call — for tests that already ran it."""
+    gk = np.asarray(gk).reshape(N, -1)
+    gv = np.asarray(gv).reshape(N, gk.shape[1], -1)
+    gc = np.asarray(gc).reshape(N, -1)
+    ng = np.asarray(ng)
+    rows = {}
+    for j in range(N):
+        for g in range(ng[j]):
+            k = int(gk[j, g])
+            assert k not in rows, "key appeared on two shards"
+            rows[k] = (list(gv[j, g]), int(gc[j, g]))
+    return rows, ng
+
+
+class TestAvgCountDistinct:
+    def test_avg_fused_vs_oracle(self, mesh, rng):
+        from sparkucx_tpu.ops.relational import run_grouped_aggregate
+
+        total = 3000
+        keys = rng.integers(0, 40, size=total).astype(np.uint32)
+        values = rng.integers(-500, 500, size=(total, 2)).astype(np.int32)
+        spec = AggregateSpec(
+            num_executors=N, capacity=512, recv_capacity=1024,
+            aggs=("avg", "sum"), impl="dense",
+        )
+        gk, gv, gc = run_grouped_aggregate(mesh, spec, keys, values)
+        wk, wv, wc = oracle_aggregate(keys, values, spec.aggs)
+        assert gv.dtype == np.float64 and wv.dtype == np.float64
+        np.testing.assert_array_equal(gk, wk)
+        np.testing.assert_array_equal(gv, wv)  # exact: int sums / int counts
+        np.testing.assert_array_equal(gc, wc)
+
+    def test_avg_composes_with_partial(self, mesh, rng):
+        from sparkucx_tpu.ops.relational import run_grouped_aggregate
+
+        total = 2000
+        keys = rng.integers(0, 25, size=total).astype(np.uint32)
+        values = rng.integers(-99, 99, size=(total, 1)).astype(np.int32)
+        spec = AggregateSpec(
+            num_executors=N, capacity=512, recv_capacity=1024,
+            aggs=("avg",), impl="dense",
+        )
+        fused = run_grouped_aggregate(mesh, replace(spec, partial=True), keys, values)
+        plain = run_grouped_aggregate(mesh, spec, keys, values)
+        for f, p in zip(fused, plain):
+            np.testing.assert_array_equal(f, p)
+
+    def test_count_distinct_vs_oracle(self, mesh, rng):
+        from sparkucx_tpu.ops.relational import run_grouped_aggregate
+
+        total = 2500
+        keys = rng.integers(0, 30, size=total).astype(np.uint32)
+        # few distinct values -> heavy duplication inside groups
+        values = rng.integers(0, 12, size=(total, 2)).astype(np.int32)
+        values[:, 1] = rng.integers(-3, 3, size=total)
+        spec = AggregateSpec(
+            num_executors=N, capacity=512, recv_capacity=1024,
+            aggs=("count_distinct", "count_distinct"), impl="dense",
+        )
+        gk, gv, gc = run_grouped_aggregate(mesh, spec, keys, values)
+        wk, wv, wc = oracle_aggregate(keys, values, spec.aggs)
+        np.testing.assert_array_equal(gk, wk)
+        np.testing.assert_array_equal(gv, wv)
+        np.testing.assert_array_equal(gc, wc)
+
+    def test_count_distinct_sentinel_and_mask(self, mesh, rng):
+        """count_distinct with scattered masks and KEY_MAX keys (the lexsort
+        numbering must stay aligned with the main segment numbering)."""
+        from sparkucx_tpu.ops.relational import run_grouped_aggregate
+
+        total = 1200
+        keys = rng.integers(0, 8, size=total).astype(np.uint32)
+        keys[rng.choice(total, size=21, replace=False)] = KEY_MAX
+        values = rng.integers(0, 5, size=(total, 1)).astype(np.int32)
+        mask = rng.random(total) < 0.6
+        spec = AggregateSpec(
+            num_executors=N, capacity=256, recv_capacity=1024,
+            aggs=("count_distinct",), impl="dense", with_filter=True,
+        )
+        gk, gv, gc = run_grouped_aggregate(mesh, spec, keys, values, mask=mask)
+        wk, wv, wc = oracle_aggregate(keys[mask], values[mask], spec.aggs)
+        np.testing.assert_array_equal(gk, wk)
+        np.testing.assert_array_equal(gv, wv)
+        np.testing.assert_array_equal(gc, wc)
+
+
+class TestRightFullOuterJoin:
+    def _check(self, mesh, rng, join_type, bkeys, bvals, pkeys, pvals):
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        jk, jb, jp, jm = run_hash_join(
+            mesh, bkeys, bvals, pkeys, pvals, impl="dense", join_type=join_type
+        )
+        wk, wb, wp, wm = oracle_join(bkeys, bvals, pkeys, pvals, join_type=join_type)
+        got = sorted(
+            (int(k), tuple(b.tolist()), tuple(p.tolist()), bool(m))
+            for k, b, p, m in zip(jk, jb, jp, jm)
+        )
+        want = sorted(
+            (int(k), tuple(b.tolist()), tuple(p.tolist()), bool(m))
+            for k, b, p, m in zip(wk, wb, wp, wm)
+        )
+        assert got == want
+        return jm
+
+    def test_right_outer_vs_oracle(self, mesh, rng):
+        bkeys = rng.integers(0, 60, size=80, dtype=np.uint64).astype(np.uint32)
+        pkeys = rng.integers(0, 30, size=150, dtype=np.uint64).astype(np.uint32)
+        bvals = rng.integers(1, 50, size=(80, 2)).astype(np.int32)
+        pvals = rng.integers(1, 50, size=(150, 1)).astype(np.int32)
+        jm = self._check(mesh, rng, "right_outer", bkeys, bvals, pkeys, pvals)
+        assert not jm.all()  # some build rows really were unmatched
+
+    def test_full_outer_vs_oracle(self, mesh, rng):
+        # disjoint key halves guarantee null-extensions on BOTH sides
+        bkeys = rng.integers(0, 40, size=70, dtype=np.uint64).astype(np.uint32)
+        pkeys = rng.integers(20, 60, size=90, dtype=np.uint64).astype(np.uint32)
+        bvals = rng.integers(1, 9, size=(70, 1)).astype(np.int32)
+        pvals = rng.integers(1, 9, size=(90, 2)).astype(np.int32)
+        jm = self._check(mesh, rng, "full_outer", bkeys, bvals, pkeys, pvals)
+        assert not jm.all()
+
+    def test_full_outer_preserves_every_row(self, mesh, rng):
+        """Row-conservation law: inner matches + probe-unmatched +
+        build-unmatched = full outer output."""
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        bkeys = rng.integers(0, 20, size=50, dtype=np.uint64).astype(np.uint32)
+        pkeys = rng.integers(10, 30, size=60, dtype=np.uint64).astype(np.uint32)
+        bvals = rng.integers(1, 9, size=(50, 1)).astype(np.int32)
+        pvals = rng.integers(1, 9, size=(60, 1)).astype(np.int32)
+        inner = run_hash_join(mesh, bkeys, bvals, pkeys, pvals, impl="dense")
+        full = run_hash_join(
+            mesh, bkeys, bvals, pkeys, pvals, impl="dense", join_type="full_outer"
+        )
+        p_unmatched = (~np.isin(pkeys, bkeys)).sum()
+        b_unmatched = (~np.isin(bkeys, pkeys)).sum()
+        assert len(full[0]) == len(inner[0]) + p_unmatched + b_unmatched
+
+    def test_right_outer_empty_probe_side(self, mesh, rng):
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        bkeys = rng.integers(0, 9, size=40, dtype=np.uint64).astype(np.uint32)
+        bvals = rng.integers(1, 9, size=(40, 2)).astype(np.int32)
+        jk, jb, jp, jm = run_hash_join(
+            mesh,
+            bkeys, bvals,
+            np.zeros(0, np.uint32), np.zeros((0, 1), np.int32),
+            impl="dense", join_type="right_outer",
+        )
+        assert len(jk) == 40 and not jm.any()
+        assert (jp == 0).all()
+        assert sorted(jk.tolist()) == sorted(bkeys.tolist())
+
+    def test_sentinel_build_key_full_outer(self, mesh):
+        """Valid KEY_MAX build rows must null-extend exactly once each, never
+        be confused with probe-side padding."""
+        from sparkucx_tpu.ops.relational import run_hash_join
+
+        bkeys = np.array([KEY_MAX, 3], np.uint32)
+        bvals = np.array([[111], [333]], np.int32)
+        pkeys = np.array([3, 4], np.uint32)
+        pvals = np.array([[30], [40]], np.int32)
+        jk, jb, jp, jm = run_hash_join(
+            mesh, bkeys, bvals, pkeys, pvals, impl="dense", join_type="full_outer"
+        )
+        rows = sorted(zip(jk.tolist(), jb[:, 0].tolist(), jp[:, 0].tolist(), jm.tolist()))
+        assert rows == [
+            (3, 333, 30, True),          # the inner match
+            (4, 0, 40, False),           # probe-side null extension
+            (int(KEY_MAX), 111, 0, False),  # build-side null extension
+        ]
 
 
 class TestSemiAntiJoin:

@@ -119,6 +119,113 @@ def test_q42_category_sum_pure_agg(mesh, rng):
     assert set(out_k.tolist()) == set(np.unique(keys).tolist())
 
 
+def test_q97_channel_overlap_full_outer(mesh, rng):
+    """q97 shape: store_sales FULL OUTER JOIN catalog_sales on customer —
+    count customers buying from store only / catalog only / both.  The
+    canonical FULL OUTER consumer in TPC-DS; both sides contribute
+    null-extended rows and the matched flag + indicator lanes classify them."""
+    from sparkucx_tpu.ops.relational import run_hash_join
+
+    store_cust = rng.choice(200, size=60, replace=False).astype(np.uint32)
+    catalog_cust = rng.choice(200, size=80, replace=False).astype(np.uint32)
+    ones_s = np.ones((60, 1), np.int32)   # store indicator lane
+    ones_c = np.ones((80, 1), np.int32)   # catalog indicator lane
+
+    jk, jb, jp, jm = run_hash_join(
+        mesh, store_cust, ones_s, catalog_cust, ones_c,
+        impl="dense", join_type="full_outer",
+    )
+    both = int(((jb[:, 0] == 1) & (jp[:, 0] == 1)).sum())
+    store_only = int(((jb[:, 0] == 1) & (jp[:, 0] == 0)).sum())
+    catalog_only = int(((jb[:, 0] == 0) & (jp[:, 0] == 1)).sum())
+    overlap = np.isin(store_cust, catalog_cust)
+    assert both == overlap.sum()
+    assert store_only == (~overlap).sum()
+    assert catalog_only == (~np.isin(catalog_cust, store_cust)).sum()
+    assert both + store_only + catalog_only == len(jk)
+    assert (jm == ((jb[:, 0] == 1) & (jp[:, 0] == 1))).all()
+
+
+def test_q80_net_profit_right_outer(mesh, rng):
+    """q80 shape: store_sales ⟕ store_returns — every sale preserved, returns
+    subtracted where present.  Expressed with the FACT side as the build
+    (hash-table) input via RIGHT OUTER: build=sales is preserved, probe=
+    returns null-extends, so net = price - refund with refund 0 for
+    unreturned sales."""
+    from sparkucx_tpu.ops.relational import run_hash_join
+
+    n_sales = 300
+    sale_id = rng.permutation(n_sales).astype(np.uint32)  # unique ticket ids
+    price = rng.integers(10, 400, size=(n_sales, 1)).astype(np.int32)
+    returned = rng.choice(n_sales, size=70, replace=False).astype(np.uint32)
+    refund = rng.integers(1, 9, size=(70, 1)).astype(np.int32)
+
+    jk, jb, jp, jm = run_hash_join(
+        mesh, sale_id, price, returned, refund,
+        impl="dense", join_type="right_outer",
+    )
+    assert len(jk) == n_sales  # every sale exactly once (PK join + preserved)
+    price_of = {int(k): int(v) for k, v in zip(sale_id, price[:, 0])}
+    refund_of = {int(k): int(v) for k, v in zip(returned, refund[:, 0])}
+    for k, b, p, m in zip(jk, jb[:, 0], jp[:, 0], jm):
+        assert int(b) == price_of[int(k)]
+        assert int(p) == refund_of.get(int(k), 0)
+        assert bool(m) == (int(k) in refund_of)
+    net = (jb[:, 0] - jp[:, 0]).sum()
+    assert net == price.sum() - refund.sum()
+
+
+def test_q7_avg_by_item_with_filter(mesh, rng):
+    """q7 shape: AVG(quantity), AVG(sales_price) GROUP BY item over rows
+    surviving the demographics filter — fused sum+count avg under a WHERE
+    pushdown mask, divided exactly on the host."""
+    from sparkucx_tpu.ops.relational import oracle_aggregate, run_grouped_aggregate
+
+    rows, items = 2400, 30
+    item = rng.integers(0, items, size=rows).astype(np.uint32)
+    qty = rng.integers(1, 20, size=rows).astype(np.int32)
+    sp = rng.integers(5, 500, size=rows).astype(np.int32)
+    demo_ok = rng.random(rows) < 0.35  # the cd_gender/cd_marital filter
+
+    spec = AggregateSpec(
+        num_executors=N, capacity=512, recv_capacity=1024,
+        aggs=("avg", "avg"), with_filter=True,
+    )
+    gk, gv, gc = run_grouped_aggregate(
+        mesh, spec, item, np.stack([qty, sp], axis=1), mask=demo_ok
+    )
+    wk, wv, wc = oracle_aggregate(
+        item[demo_ok], np.stack([qty, sp], axis=1)[demo_ok], spec.aggs
+    )
+    np.testing.assert_array_equal(gk, wk)
+    np.testing.assert_array_equal(gv, wv)  # float64, exact int/int division
+    np.testing.assert_array_equal(gc, wc)
+
+
+def test_q38_distinct_customers_by_month(mesh, rng):
+    """q38 shape: COUNT(DISTINCT customer) per month — repeat purchases by
+    the same customer in a month must count once (the device lexsort
+    dedup), alongside a plain COUNT(*) of visits."""
+    from sparkucx_tpu.ops.relational import oracle_aggregate, run_grouped_aggregate
+
+    visits, months, customers = 3000, 12, 90
+    month = rng.integers(0, months, size=visits).astype(np.uint32)
+    cust = rng.integers(0, customers, size=visits).astype(np.int32)
+
+    spec = AggregateSpec(
+        num_executors=N, capacity=512, recv_capacity=1024,
+        aggs=("count_distinct",),
+    )
+    gk, gv, gc = run_grouped_aggregate(mesh, spec, month, cust[:, None])
+    wk, wv, wc = oracle_aggregate(month, cust[:, None], spec.aggs)
+    np.testing.assert_array_equal(gk, wk)
+    np.testing.assert_array_equal(gv, wv)
+    np.testing.assert_array_equal(gc, wc)
+    # sanity vs a direct host computation of the headline number
+    for k, v in zip(gk, gv[:, 0]):
+        assert v == len(np.unique(cust[month == k]))
+
+
 def test_q16_exclusion_anti_join(mesh, rng):
     """q16/q93 shape: catalog sales EXCLUDING orders that appear in returns —
     a NOT EXISTS anti join feeding an aggregate, the TPC-DS exclusion idiom."""
