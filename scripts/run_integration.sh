@@ -46,6 +46,12 @@ run_tc_test() {       # test.sh:175-179 (SparkTC; gate at :196)
   EXECUTORS=4 VERTICES=100 EDGES=200 python scripts/integration_tc.py
 }
 
+run_tpch_test() {     # BASELINE.json configs[2]: TPC-H q18 as a 2-stage,
+                      # 3-shuffle daemon job (SQL through the L7 surface)
+  EXECUTORS=2 MAPPERS=4 REDUCERS=8 ROWS=200000 ORDERS=10000 \
+    python scripts/integration_tpch.py
+}
+
 run_fault_test() {    # OS-process fault injection: mapper SIGKILL mid-write
   FAULTS=1 EXECUTORS=2 MAPPERS=4 REDUCERS=8 PAIRS_PER_MAP=5000 \
     python scripts/integration_groupby.py   # + reducer SIGKILL mid-fetch
@@ -98,6 +104,8 @@ echo "== terasort test (1M rows) =="
 run_terasort_test
 echo "== tc test =="
 run_tc_test
+echo "== tpch q18 test (2 stages, 3 shuffles) =="
+run_tpch_test
 echo "== fault-injection test =="
 run_fault_test
 echo "== jvm shim check =="

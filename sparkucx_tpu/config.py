@@ -104,7 +104,8 @@ class TpuShuffleConf:
     #: Total on-disk spill budget per store; 0 = unbounded.  Counts staged
     #: (padded) bytes — spill files are sparse, holes cost nothing.  Exceeding
     #: it is a TransportError at rollover (like region overflow), not silent
-    #: data loss.
+    #: data loss.  ``host_recv_mode='memmap'`` received-shard spill is charged
+    #: against the same budget (cluster-wide).
     spill_disk_cap_bytes: int = 0
     #: Reduce-side combine/sort memory budget (the ExternalSorter role,
     #: UcxShuffleReader.scala:137-199): crossing it spills sorted runs to
@@ -128,6 +129,17 @@ class TpuShuffleConf:
     #: envelope of received bytes — opt-in (default off) so large multi-round
     #: shuffles keep the donation that halves peak HBM.
     keep_device_recv: bool = False
+    #: Where the post-exchange received shards live on the HOST (SURVEY §7's
+    #: "HBM budget" hard-part, host half).  ``'array'`` keeps one RAM copy per
+    #: round (fastest fetches; ~1x received bytes of host RSS on top of the
+    #: store's staging).  ``'memmap'`` writes each round's shards to disk
+    #: (``spill_dir``) and serves fetches through ``np.memmap`` views — host
+    #: RSS stays bounded by one round regardless of round count, the page
+    #: cache does the rest.  ``'device'`` keeps NO host copy at all: fetches
+    #: slice the HBM-resident shard and D2H only the requested block
+    #: (requires ``keep_device_recv``) — the reference's serve-from-NVKV
+    #: mode, where host memory never holds the shuffle.
+    host_recv_mode: str = "array"
     #: Ragged block-gather lowering: 'auto' (pipelined DMA kernel on TPU, XLA
     #: gather elsewhere) | 'dma' | 'tiled' | 'xla'.
     gather_impl: str = "auto"
@@ -197,6 +209,7 @@ class TpuShuffleConf:
             ("keepDeviceRecv", "keep_device_recv", lambda v: str(v).lower() == "true"),
             ("gatherImpl", "gather_impl", str),
             ("partialAggregation", "partial_aggregation", lambda v: str(v).lower() == "true"),
+            ("hostRecvMode", "host_recv_mode", str),
             ("spillToDisk", "spill_to_disk", lambda v: str(v).lower() == "true"),
             ("spillDir", "spill_dir", str),
             ("spillDiskCap", "spill_disk_cap_bytes", parse_size),

@@ -156,6 +156,17 @@ class Block(ABC):
         self.get_block(out)
         return MemoryBlock(data=out, size=out.size, is_host_memory=True)
 
+    def memory_view(self) -> Optional[np.ndarray]:
+        """Zero-copy serving hook: a stable uint8 view of the block's bytes,
+        or None when the block must be materialized (file-backed).  Serving
+        paths capture the view under ``self.lock``; a concurrent ``mutate``
+        swaps the backing array but the captured view keeps the old one alive
+        — the same consistent-at-capture semantics as ``get_memory_block``.
+        Memory-backed blocks should override: materializing a fresh buffer
+        per fetch was the measured wall of the peer-serving path (allocation
+        + copy + page faults per request, docs/PERF.md peer row)."""
+        return None
+
 
 class BytesBlock(Block):
     """A block backed by an in-memory byte buffer (test/loopback helper)."""
@@ -170,6 +181,9 @@ class BytesBlock(Block):
     def get_block(self, dest: BufferLike) -> None:
         view = _as_u8(dest)
         view[: self._payload.size] = self._payload
+
+    def memory_view(self) -> np.ndarray:
+        return self._payload
 
     def set_payload(self, payload: Union[bytes, np.ndarray]) -> None:
         with self.lock:

@@ -146,6 +146,11 @@ def run_client(args) -> None:
     def worker(tid: int) -> None:
         transport = PeerTransport(conf, executor_id=100 + tid)
         transport.add_executor(0, f"{host or '127.0.0.1'}:{port}".encode())
+        # -o bounds the blocks (and result buffers) in flight per window —
+        # numOutstanding semantics (UcxPerfBenchmark.scala:129-151): issue a
+        # window, progress until it drains, issue the next.  For peak
+        # localhost throughput run with -o = -n (whole set in flight) so the
+        # next request is queued at the server while a reply streams.
         bufs = [MemoryBlock(np.zeros(size, dtype=np.uint8), size=size) for _ in range(args.outstanding)]
         for it in range(args.iterations):
             t0 = time.perf_counter()
@@ -153,7 +158,7 @@ def run_client(args) -> None:
             for base in range(0, args.num_blocks, args.outstanding):
                 bids = [
                     ShuffleBlockId(0, 0, (base + k) % args.num_blocks)
-                    for k in range(args.outstanding)
+                    for k in range(min(args.outstanding, args.num_blocks - base))
                 ]
                 reqs = transport.fetch_blocks_by_block_ids(
                     0, bids, bufs[: len(bids)], [None] * len(bids)

@@ -114,7 +114,11 @@ def _estimate(obj: Any, depth: int = _ESTIMATE_MAX_DEPTH) -> int:
 
 
 class _Run:
-    """One spilled sorted run: a pickle stream of (merge_key, key, value)."""
+    """One spilled sorted run: a pickle stream of (merge_key, key, value).
+
+    Pickle is safe HERE and only here: spill files are written and read back
+    by the same process under a mkstemp path — they never carry peer bytes.
+    The socket-facing record codec is the typed one (utils/codec.py)."""
 
     def __init__(self, items: Iterable[Tuple[Any, Any, Any]], spill_dir: Optional[str]):
         if spill_dir:

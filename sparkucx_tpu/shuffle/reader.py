@@ -20,7 +20,6 @@ fetch_wait_time (UcxShuffleReader.scala:118-123,148-153).
 
 from __future__ import annotations
 
-import pickle
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
@@ -55,11 +54,36 @@ class BlockFetchResult:
 
 
 def default_deserializer(payload: bytes) -> Iterable[Any]:
-    """Stream of pickled records per block (the Spark serializer-stream analogue)."""
+    """Record stream per block (the Spark serializer-stream analogue).
+
+    Decodes the typed, NON-EXECUTING wire format of utils/codec.py — block
+    payloads arrive from peers over sockets, and the default codec must not
+    be an arbitrary-code-execution surface the way Spark's JavaSerializer
+    (or pickle) is.  Malformed frames raise ``ValueError``.  For trusted
+    single-host runs needing arbitrary Python objects, pass
+    :func:`pickle_deserializer` explicitly."""
+    from sparkucx_tpu.utils.codec import decode_records
+
+    yield from decode_records(payload)
+
+
+def serialize_records(records: Iterable[Any]) -> bytes:
+    """Writer-side twin of ``default_deserializer`` (typed safe codec)."""
+    from sparkucx_tpu.utils.codec import encode_records
+
+    return encode_records(records)
+
+
+def pickle_deserializer(payload: bytes) -> Iterable[Any]:
+    """OPT-IN pickle record stream — executes whatever the bytes describe, so
+    use it only when every peer is trusted (single-host runs, tests needing
+    arbitrary object graphs).  Never the default: block payloads are
+    peer-controlled socket bytes (see parallel/bootstrap.py's rule)."""
+    import io
+    import pickle
+
     if not payload:
         return
-    import io
-
     bio = io.BytesIO(payload)
     while bio.tell() < len(payload):
         try:
@@ -68,9 +92,10 @@ def default_deserializer(payload: bytes) -> Iterable[Any]:
             return
 
 
-def serialize_records(records: Iterable[Any]) -> bytes:
-    """Writer-side twin of ``default_deserializer`` (test/benchmark helper)."""
+def pickle_serialize_records(records: Iterable[Any]) -> bytes:
+    """Writer-side twin of :func:`pickle_deserializer` (opt-in, trusted runs)."""
     import io
+    import pickle
 
     bio = io.BytesIO()
     for rec in records:

@@ -176,13 +176,19 @@ class BlockServer:
     def _resolve_one(self, bid: ShuffleBlockId):
         """Resolve to a ``(buffer, offset, length)`` view or None.
 
-        Registry blocks (may hit files) materialize into a fresh buffer under
-        the block lock; store blocks serve a zero-copy view of host staging.
-        Either way the reply path sends the view without another copy."""
+        Memory-backed registry blocks serve their stable ``memory_view``
+        zero-copy (materializing a fresh buffer per fetch — alloc + copy +
+        page faults — was the measured wall of this path); file-backed ones
+        materialize under the block lock.  Store blocks serve a zero-copy
+        view of host staging.  Either way the reply path sends the view
+        without another copy."""
         if self.registry_lookup is not None:
             blk = self.registry_lookup(bid)
             if blk is not None:
                 with blk.lock:
+                    view = blk.memory_view()
+                    if view is not None:
+                        return view, 0, int(view.size)
                     mb = blk.get_memory_block()
                 # hand back the materialized buffer as a view, not bytes — the
                 # reply path then sends it without a second copy

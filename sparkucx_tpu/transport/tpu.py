@@ -70,9 +70,13 @@ class _ShuffleMeta:
     peer_ranges: List[Tuple[int, int]]               # reducer ownership
     mapper_infos: Dict[int, MapperInfo] = field(default_factory=dict)
     # post-exchange receive state, one entry per staging round (multi-round
-    # spill; a single round in the common case), each per executor:
+    # spill; a single round in the common case), each per executor.  Entries
+    # are plain arrays (host_recv_mode='array'), np.memmap views ('memmap'),
+    # or absent entirely ('device' — fetches slice HBM on demand):
     recv_shards: Optional[List[List[np.ndarray]]] = None  # [round][executor] uint8
     recv_sizes: Optional[List[np.ndarray]] = None         # [round] (n, n) rows j<-i
+    #: memmap backing files to unlink on remove_shuffle ('memmap' mode)
+    recv_spill_paths: List[str] = field(default_factory=list)
     # HBM-resident copies of the received shards (conf.keep_device_recv) —
     # the source the device-side block gather serves from:
     recv_device: Optional[List[List[object]]] = None      # [round][executor] jax.Array
@@ -105,6 +109,10 @@ class TpuShuffleCluster:
         self._meta: Dict[int, _ShuffleMeta] = {}
         self._exchange_cache: Dict[Tuple[int, int, str], Callable] = {}
         self._lock = threading.RLock()
+        #: bytes of received-shard spill currently on disk (host_recv_mode=
+        #: 'memmap'), charged against conf.spill_disk_cap_bytes like the
+        #: store's staging spill
+        self._recv_spill_bytes = 0
 
     # -- membership / lookup ----------------------------------------------
 
@@ -145,7 +153,19 @@ class TpuShuffleCluster:
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
-            self._meta.pop(shuffle_id, None)
+            meta = self._meta.pop(shuffle_id, None)
+        if meta is not None:
+            meta.recv_shards = None  # drop memmap views before unlinking
+            for path in meta.recv_spill_paths:
+                try:
+                    import os
+
+                    size = os.path.getsize(path)
+                    os.unlink(path)
+                    with self._lock:
+                        self._recv_spill_bytes -= size
+                except OSError:
+                    pass
         for t in self.transports:
             t.store.remove_shuffle(shuffle_id)
 
@@ -217,6 +237,15 @@ class TpuShuffleCluster:
                 f"exchange before all maps committed ({committed}/{meta.num_mappers})"
             )
 
+        mode = self.conf.host_recv_mode
+        if mode not in ("array", "memmap", "device"):
+            raise ValueError(f"unknown host_recv_mode {mode!r} (array|memmap|device)")
+        if mode == "device" and not self.conf.keep_device_recv:
+            raise TransportError(
+                "host_recv_mode='device' serves fetches from the HBM shards — "
+                "it requires conf.keep_device_recv=true"
+            )
+
         with span("exchange.seal", shuffle_id=shuffle_id):
             sealed = [t.store.seal(shuffle_id) for t in self.transports]
         num_rounds = max(len(s) for s in sealed)
@@ -254,18 +283,72 @@ class TpuShuffleCluster:
             with span("exchange.collective", shuffle_id=shuffle_id, round=rnd, rows=send_rows):
                 recv, recv_sizes = fn(data, size_mat)
                 jax.block_until_ready(recv)
-            # One D2H per executor shard; fetches then slice host memory.
             shard_by_device = {s.device: s.data for s in recv.addressable_shards}
-            with span("exchange.d2h", shuffle_id=shuffle_id, round=rnd):
-                meta.recv_shards.append(
-                    [np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)]
-                )
+            if mode == "device":
+                # No host copy at all: fetches slice the retained HBM shard
+                # and D2H only the requested block (locate_received_block).
+                pass
+            elif mode == "memmap":
+                # One D2H per shard, streamed straight into a disk-backed
+                # mapping; the round's RAM is released once pages flush, so
+                # host RSS stays bounded by ~one round however many rounds
+                # the shuffle spills (the store's own disk tier discipline).
+                with span("exchange.d2h_memmap", shuffle_id=shuffle_id, round=rnd):
+                    meta.recv_shards.append(
+                        self._memmap_round(meta, rnd, shard_by_device, devices, n)
+                    )
+            else:
+                # One D2H per executor shard; fetches then slice host memory.
+                with span("exchange.d2h", shuffle_id=shuffle_id, round=rnd):
+                    meta.recv_shards.append(
+                        [np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)]
+                    )
             meta.recv_sizes.append(np.asarray(recv_sizes))
             if self.conf.keep_device_recv:
                 if meta.recv_device is None:
                     meta.recv_device = []
                 meta.recv_device.append([shard_by_device[devices[j]] for j in range(n)])
+        if mode == "device":
+            meta.recv_shards = None  # explicit no-host-copy marker
         meta.exchanged = True
+
+    def _memmap_round(self, meta, rnd: int, shard_by_device, devices, n: int):
+        """Spill one round's received shards to a disk-backed mapping and
+        return uint8 ``np.memmap`` views (host_recv_mode='memmap')."""
+        import os
+        import tempfile
+
+        spill_dir = self.conf.spill_dir
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+        views = []
+        for j in range(n):
+            host = np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
+            cap = self.conf.spill_disk_cap_bytes
+            with self._lock:
+                if cap and self._recv_spill_bytes + host.nbytes > cap:
+                    raise TransportError(
+                        f"received-shard spill would exceed spill_disk_cap_bytes "
+                        f"({self._recv_spill_bytes + host.nbytes} > {cap}); raise the "
+                        f"cap or use host_recv_mode='device'"
+                    )
+                self._recv_spill_bytes += host.nbytes
+            fd, path = tempfile.mkstemp(
+                prefix=f"sparkucx_tpu_recv_s{meta.shuffle_id}_r{rnd}_e{j}_",
+                dir=spill_dir,
+            )
+            os.close(fd)
+            shape = host.shape
+            mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=shape)
+            mm[:] = host
+            mm.flush()
+            # Drop the write mapping and reopen read-only: the dirty pages are
+            # unmapped (host RSS actually falls back to ~one transient shard),
+            # and fetches fault in only the pages they touch.
+            del mm, host
+            meta.recv_spill_paths.append(path)
+            views.append(np.memmap(path, dtype=np.uint8, mode="r", shape=shape))
+        return views
 
     # -- post-exchange block lookup ---------------------------------------
 
@@ -286,6 +369,12 @@ class TpuShuffleCluster:
         if rows == 0:
             return np.empty(0, dtype=np.uint8), 0
         length = meta.mapper_infos[map_id].partitions[reduce_id][1]
+        if meta.recv_shards is None:
+            # host_recv_mode='device': no host copy exists — slice the block's
+            # rows out of the HBM-resident shard and D2H just those bytes.
+            shard = meta.recv_device[rnd][consumer]
+            block_rows = np.asarray(shard[src_row : src_row + rows])
+            return block_rows.reshape(-1).view(np.uint8)[:length], length
         shard = meta.recv_shards[rnd][consumer]
         start = src_row * self.row_bytes
         return shard[start : start + length], length

@@ -1,0 +1,134 @@
+"""Tests for the typed non-executing record codec (utils/codec.py) — the
+data-plane default that replaces pickle on socket-delivered block payloads."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.utils.codec import (
+    MAX_DEPTH,
+    decode_records,
+    encode_record,
+    encode_records,
+)
+
+
+class TestRoundtrip:
+    def test_scalar_shapes(self):
+        vals = [
+            None, True, False, 0, -1, 2**62, -(2**62), 2**100, -(2**100),
+            0.0, -1.5, 3.141592653589793, float("inf"), "", "héllo ∆",
+            b"", b"\x00\xff" * 100,
+        ]
+        for v in vals:
+            got = list(decode_records(encode_record(v)))
+            assert got == [v] and type(got[0]) is type(v), v
+
+    def test_nan_roundtrip(self):
+        (got,) = decode_records(encode_record(float("nan")))
+        assert got != got  # NaN
+
+    def test_containers(self):
+        vals = [
+            (), (1, "a", b"b"), [1, [2, [3]]], {"k": 1, 2: (3, 4)},
+            ("key", {"nested": [1.5, None, True]}),
+        ]
+        for v in vals:
+            (got,) = decode_records(encode_record(v))
+            assert got == v and type(got) is type(v)
+
+    def test_record_stream_concatenates(self):
+        records = [(i, f"v{i}") for i in range(100)] + [None, (0, 0)]
+        assert list(decode_records(encode_records(records))) == records
+
+    def test_fuzz_random_kv_records(self, rng):
+        for _ in range(20):
+            records = [
+                (int(rng.integers(-1e9, 1e9)), float(rng.normal()),
+                 bytes(rng.integers(0, 256, size=int(rng.integers(0, 50)), dtype=np.uint8)))
+                for _ in range(int(rng.integers(0, 40)))
+            ]
+            assert list(decode_records(encode_records(records))) == records
+
+    def test_numpy_scalars_coerce(self):
+        (got,) = decode_records(encode_record((np.int32(7), np.float32(0.5), np.bool_(True))))
+        assert got == (7, 0.5, True)
+        assert type(got[0]) is int and type(got[1]) is float and type(got[2]) is bool
+
+    def test_empty_payload_yields_nothing(self):
+        assert list(decode_records(b"")) == []
+
+
+class TestRejection:
+    def test_unknown_tag(self):
+        with pytest.raises(ValueError, match="unknown record tag"):
+            list(decode_records(b"Z"))
+
+    def test_truncated_scalar_and_length(self):
+        for bad in (b"i\x00\x00", b"s\x00\x00\x00\x05ab", b"f", b"t\x00\x00"):
+            with pytest.raises(ValueError, match="truncated"):
+                list(decode_records(bad))
+
+    def test_truncated_container_items(self):
+        # tuple claims 3 items, carries 1
+        with pytest.raises(ValueError, match="truncated"):
+            list(decode_records(b"t\x00\x00\x00\x03N"))
+
+    def test_over_deep_nesting_bounded(self):
+        payload = b"t\x00\x00\x00\x01" * (MAX_DEPTH + 10) + b"N"
+        with pytest.raises(ValueError, match="MAX_DEPTH"):
+            list(decode_records(payload))
+
+    def test_unencodable_type_raises(self):
+        with pytest.raises(TypeError, match="safe codec"):
+            encode_record(object())
+
+    def test_unhashable_map_key_is_valueerror(self):
+        # crafted frame: map of 1 entry whose key is an (empty) list — the
+        # error contract promises ValueError, never a leaked TypeError
+        with pytest.raises(ValueError, match="unhashable"):
+            list(decode_records(b"m\x00\x00\x00\x01l\x00\x00\x00\x00N"))
+
+    def test_pickle_payload_never_executes(self, tmp_path):
+        """The canonical attack: a pickle whose deserialization has a side
+        effect.  The default codec must raise, not execute."""
+        canary = tmp_path / "owned"
+
+        class Evil:
+            def __reduce__(self):
+                return (open, (str(canary), "w"))
+
+        payload = pickle.dumps(Evil())
+        with pytest.raises(ValueError):
+            list(decode_records(payload))
+        assert not canary.exists(), "decoding socket bytes executed code"
+
+
+class TestReaderWiring:
+    def test_default_deserializer_is_the_safe_codec(self, tmp_path):
+        from sparkucx_tpu.shuffle.reader import default_deserializer, serialize_records
+
+        records = [("k1", 1), ("k2", [2, 3])]
+        assert list(default_deserializer(serialize_records(records))) == records
+        # and it rejects pickle bytes rather than loading them
+        canary = tmp_path / "owned"
+
+        class Evil:
+            def __reduce__(self):
+                return (open, (str(canary), "w"))
+
+        with pytest.raises(ValueError):
+            list(default_deserializer(pickle.dumps(Evil())))
+        assert not canary.exists()
+
+    def test_pickle_optin_still_available(self):
+        from sparkucx_tpu.shuffle.reader import (
+            pickle_deserializer,
+            pickle_serialize_records,
+        )
+
+        # sets are outside the safe codec's value set — the opt-in pickle
+        # path is for exactly these arbitrary-object needs on trusted hosts
+        recs = [{1, 2, 3}, frozenset({"a"})]
+        assert list(pickle_deserializer(pickle_serialize_records(recs))) == recs

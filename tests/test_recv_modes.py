@@ -1,0 +1,203 @@
+"""host_recv_mode: the post-exchange host-memory budget (SURVEY §7 "HBM
+budget", host half; VERDICT r4 item 8).
+
+'array' keeps a RAM copy per round (the historical behavior), 'memmap' spills
+each round's received shards to disk and serves fetches through read-only
+``np.memmap`` views, 'device' keeps no host copy at all and slices the
+HBM-resident shard per fetch."""
+
+import os
+
+
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus, TransportError
+from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+N_EXEC = 4
+
+
+def _buf(n):
+    return MemoryBlock(np.zeros(n, dtype=np.uint8), size=n)
+
+
+def _write_shuffle(cluster, shuffle_id, M, R, rng, block=2000):
+    meta = cluster.create_shuffle(shuffle_id, M, R)
+    oracle = {}
+    for m in range(M):
+        t = cluster.transport(meta.map_owner[m])
+        w = t.store.map_writer(shuffle_id, m)
+        for r in range(R):
+            payload = rng.integers(0, 256, size=block, dtype=np.uint8).tobytes()
+            oracle[(m, r)] = payload
+            w.write_partition(r, payload)
+        t.commit_block(w.commit().pack())
+    return meta, oracle
+
+
+def _fetch_all(cluster, meta, shuffle_id, M, R, oracle):
+    for r in range(R):
+        consumer = meta.owner_of_reduce(r)
+        t = cluster.transport(consumer)
+        bufs = [_buf(8192) for _ in range(M)]
+        reqs = t.fetch_blocks_by_block_ids(
+            consumer, [ShuffleBlockId(shuffle_id, m, r) for m in range(M)],
+            bufs, [None] * M,
+        )
+        for m in range(M):
+            res = reqs[m].wait(5)
+            assert res.status == OperationStatus.SUCCESS, str(res.error)
+            assert bufs[m].host_view()[: bufs[m].size].tobytes() == oracle[(m, r)]
+
+
+class TestMemmapMode:
+    def test_multi_round_vs_oracle_and_cleanup(self, rng, tmp_path):
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=N_EXEC * 4096,
+            block_alignment=128,
+            num_executors=N_EXEC,
+            host_recv_mode="memmap",
+            spill_dir=str(tmp_path),
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        M, R = 3 * N_EXEC, 8
+        meta, oracle = _write_shuffle(cluster, 0, M, R, rng)
+        cluster.run_exchange(0)
+        assert len(meta.recv_shards) > 1, "test should spill multiple rounds"
+        # every shard view is a read-only disk-backed mapping, not RAM
+        for rnd in meta.recv_shards:
+            for shard in rnd:
+                assert isinstance(shard, np.memmap)
+                assert not shard.flags.writeable
+        spilled = list(meta.recv_spill_paths)
+        assert spilled and all(os.path.exists(p) for p in spilled)
+        _fetch_all(cluster, meta, 0, M, R, oracle)
+        cluster.remove_shuffle(0)
+        assert not any(os.path.exists(p) for p in spilled), "spill files leaked"
+
+
+class TestMemmapDiskCap:
+    def test_recv_spill_charged_against_cap(self, rng, tmp_path):
+        """spill_disk_cap_bytes bounds the received-shard spill too — a
+        too-small cap is a TransportError at exchange, not silent disk fill."""
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 18,
+            block_alignment=128,
+            num_executors=N_EXEC,
+            host_recv_mode="memmap",
+            spill_dir=str(tmp_path),
+            spill_disk_cap_bytes=4096,  # far below one received round
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        _write_shuffle(cluster, 0, 4, 4, rng, block=512)
+        with pytest.raises(TransportError, match="spill_disk_cap_bytes"):
+            cluster.run_exchange(0)
+
+    def test_cap_released_on_remove(self, rng, tmp_path):
+        """remove_shuffle returns its spill bytes to the budget."""
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 18,
+            block_alignment=128,
+            num_executors=N_EXEC,
+            host_recv_mode="memmap",
+            spill_dir=str(tmp_path),
+            spill_disk_cap_bytes=16 << 20,  # fits one shuffle, not two
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        for sid in range(3):  # three sequential shuffles reuse the budget
+            meta, oracle = _write_shuffle(cluster, sid, 4, 4, rng, block=512)
+            cluster.run_exchange(sid)
+            _fetch_all(cluster, meta, sid, 4, 4, oracle)
+            cluster.remove_shuffle(sid)
+        assert cluster._recv_spill_bytes == 0
+
+
+class TestDeviceMode:
+    def test_no_host_copy_vs_oracle(self, rng):
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 18,
+            block_alignment=128,
+            num_executors=N_EXEC,
+            host_recv_mode="device",
+            keep_device_recv=True,
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        M, R = 8, 8
+        meta, oracle = _write_shuffle(cluster, 0, M, R, rng)
+        cluster.run_exchange(0)
+        assert meta.recv_shards is None, "device mode must keep no host copy"
+        assert meta.recv_device is not None
+        _fetch_all(cluster, meta, 0, M, R, oracle)
+
+    def test_requires_keep_device_recv(self, rng):
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 18,
+            block_alignment=128,
+            num_executors=N_EXEC,
+            host_recv_mode="device",
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        meta, _ = _write_shuffle(cluster, 0, 2, 2, rng, block=64)
+        with pytest.raises(TransportError, match="keep_device_recv"):
+            cluster.run_exchange(0)
+
+    def test_unknown_mode_rejected(self, rng):
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=1 << 18,
+            num_executors=N_EXEC,
+            host_recv_mode="ram",
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        _write_shuffle(cluster, 0, 2, 2, rng, block=64)
+        with pytest.raises(ValueError, match="host_recv_mode"):
+            cluster.run_exchange(0)
+
+
+class TestHostBudgetStructural:
+    """The budget claim in structural form.  A direct ru_maxrss comparison is
+    NOT meaningful on this virtual CPU mesh: ``np.asarray`` of a cpu-backend
+    jax shard is zero-copy (the 'array'-mode host shards alias the jax
+    buffers that exist in both modes), and XLA:CPU's pooled allocator never
+    returns freed pages to the OS, so peak RSS measures the allocator
+    high-water mark, not retention (measured: 653 vs 620 MiB for a 160 MiB
+    dataset).  On real TPU hardware the D2H in 'array' mode is a genuine host
+    copy per round — what 'memmap'/'device' eliminate.  What CAN be asserted
+    portably: after a multi-round memmap exchange, every retained recv shard
+    is file-backed (zero RAM-backed recv bytes), their file sizes cover the
+    received data, and fetches never resurrect a RAM copy."""
+
+    def test_memmap_retains_zero_ram_backed_recv_bytes(self, rng, tmp_path):
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=N_EXEC * 4096,
+            block_alignment=128,
+            num_executors=N_EXEC,
+            host_recv_mode="memmap",
+            spill_dir=str(tmp_path),
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+        M, R = 3 * N_EXEC, 8
+        meta, oracle = _write_shuffle(cluster, 0, M, R, rng)
+        cluster.run_exchange(0)
+        assert len(meta.recv_shards) >= 3, "should spill multiple rounds"
+        ram_backed = sum(
+            shard.nbytes
+            for rnd in meta.recv_shards
+            for shard in rnd
+            if not isinstance(shard, np.memmap)
+        )
+        assert ram_backed == 0, f"{ram_backed} recv bytes retained in RAM"
+        on_disk = sum(os.path.getsize(p) for p in meta.recv_spill_paths)
+        received = sum(int(s.sum()) for s in meta.recv_sizes) * conf.block_alignment
+        assert on_disk >= received > 0
+        assert cluster._recv_spill_bytes == on_disk
+        # fetches serve from the mappings without converting them to arrays
+        _fetch_all(cluster, meta, 0, M, R, oracle)
+        assert all(
+            isinstance(shard, np.memmap)
+            for rnd in meta.recv_shards
+            for shard in rnd
+        )
