@@ -379,6 +379,18 @@ def main():
         except Exception as e:
             RESULT["sort_error"] = f"{type(e).__name__}: {e}"[:200]
         try:
+            # The Pallas LSD radix sort (ops/radix.py) head-to-head against
+            # the argsort floor above — first hardware execution of the
+            # kernel happens HERE, so a Mosaic compile failure lands in
+            # sort_radix_error while the argsort number stands.
+            if budget_left() < 120:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            RESULT["sort_radix_mrows_s"] = round(
+                measure_sort(1, 1 << 21, REPEATS, sort_impl="radix"), 3
+            )
+        except Exception as e:
+            RESULT["sort_radix_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
             # GROUP BY — the reference's gate workload (GroupByTest,
             # buildlib/test.sh:163-173) as one on-device hash-exchange +
             # segment-reduce step; 2M x 100 B rows, 100-key keyspace like the

@@ -76,7 +76,10 @@ class SortSpec:
     def resolve_impl(self, platform: Optional[str] = None) -> "SortSpec":
         """'auto' -> 'single' when one executor (sample sort degenerates to one
         local sort — no splitters, no exchange, HALF the sort work; any
-        backend), else 'ragged' on TPU / 'dense' elsewhere."""
+        backend), else 'ragged' on TPU / 'dense' elsewhere.  'radix' swaps the
+        n=1 local sort for the Pallas LSD radix kernel (ops/radix.py) whose
+        scatter moves key+payload together by segment DMA — the explicit
+        opt-in for beating the XLA argsort+gather floor (docs/PERF.md)."""
         if self.impl != "auto":
             return self
         if self.num_executors == 1 and self.recv_capacity >= self.capacity:
@@ -86,13 +89,13 @@ class SortSpec:
         return replace(self, impl="ragged" if platform == "tpu" else "dense")
 
     def validate(self) -> None:
-        if self.impl not in ("ragged", "dense", "single"):
+        if self.impl not in ("ragged", "dense", "single", "radix"):
             raise ValueError(f"unknown impl {self.impl!r}")
-        if self.impl == "single" and (
+        if self.impl in ("single", "radix") and (
             self.num_executors != 1 or self.recv_capacity < self.capacity
         ):
             raise ValueError(
-                "impl='single' needs num_executors=1 and recv_capacity >= capacity"
+                f"impl={self.impl!r} needs num_executors=1 and recv_capacity >= capacity"
             )
         if np.dtype(self.dtype).itemsize != 4:
             raise ValueError("payload dtype must be 32-bit (keys bitcast through it)")
@@ -202,6 +205,32 @@ def _sort_body_single(spec: SortSpec, keys: jnp.ndarray, payload: jnp.ndarray, n
     return out_keys, out_pay, nv[None].astype(jnp.int32)
 
 
+def _sort_body_radix(spec: SortSpec, keys, payload, num_valid, *, interpret: bool):
+    """n=1 path with the Pallas LSD radix sort (ops/radix.py): key and payload
+    fuse into one row tile and move TOGETHER by segment DMA each pass —
+    no XLA argsort, no permutation gather (the two measured walls of the
+    'single' path, docs/PERF.md sort-floor analysis)."""
+    from sparkucx_tpu.ops.radix import radix_sort_rows
+
+    nv = num_valid[0]
+    idx = jnp.arange(spec.capacity, dtype=jnp.int32)
+    keys = jnp.where(idx < nv, keys, KEY_MAX)
+    rows = jnp.concatenate(
+        [jax.lax.bitcast_convert_type(keys, spec.dtype)[:, None], payload], axis=1
+    )
+    rows = radix_sort_rows(rows, interpret=interpret)
+    out_keys = jax.lax.bitcast_convert_type(rows[:, 0], jnp.uint32)
+    # invalid rows (forced KEY_MAX, input tail) sort stably to the back:
+    # positions >= nv are exactly them; zero their payload like the other
+    # lowerings so caller padding cannot leak through the permutation
+    out_pay = jnp.where((idx < nv)[:, None], rows[:, 1:], 0)
+    pad = spec.recv_capacity - spec.capacity
+    if pad:
+        out_keys = jnp.concatenate([out_keys, jnp.full(pad, KEY_MAX, jnp.uint32)])
+        out_pay = jnp.concatenate([out_pay, jnp.zeros((pad, spec.width), spec.dtype)])
+    return out_keys, out_pay, nv[None].astype(jnp.int32)
+
+
 def build_distributed_sort(mesh: Mesh, spec: SortSpec):
     """Compile the full distributed sort for ``mesh``.
 
@@ -230,7 +259,13 @@ def build_distributed_sort(mesh: Mesh, spec: SortSpec):
     spec.validate()
     ax = spec.axis_name
 
-    body = _sort_body_single if spec.impl == "single" else _sort_body
+    if spec.impl == "radix":
+        # the Pallas kernel needs real Mosaic for its dynamic-size DMAs; any
+        # other backend runs the interpreter (CPU-mesh tests)
+        interpret = mesh.devices.reshape(-1)[0].platform != "tpu"
+        body = functools.partial(_sort_body_radix, interpret=interpret)
+    else:
+        body = _sort_body_single if spec.impl == "single" else _sort_body
     shard = jax.shard_map(
         functools.partial(body, spec),
         mesh=mesh,

@@ -109,6 +109,12 @@ def _parse_args(argv):
         "conf spark.shuffle.tpu.partialAggregation)",
     )
     p.add_argument(
+        "--sort-impl", default="auto",
+        choices=["auto", "single", "radix", "ragged", "dense"],
+        help="sort lowering (sort mode); 'radix' = the Pallas LSD radix "
+        "kernel with fused key+payload segment-DMA scatter (n=1 only)",
+    )
+    p.add_argument(
         "--batches", type=int, default=1,
         help="device batches for the out-of-core sort driver (sort mode)",
     )
@@ -317,7 +323,7 @@ def run_gather(args) -> None:
 
 def measure_sort(
     executors: int, total_rows: int, iterations: int, report=None,
-    outstanding: int = 8,
+    outstanding: int = 8, sort_impl: str = "auto",
 ) -> float:
     """Measurement core of the ``sort`` mode — device-resident TeraSort step
     (100 B rows: uint32 key + 24 int32 lanes; BASELINE.json configs[1]).
@@ -340,7 +346,8 @@ def measure_sort(
     # executor owns the whole range, so n=1 needs none (and the 'single'
     # lowering then skips the output pad copy entirely)
     spec = SortSpec(
-        num_executors=n, capacity=cap, recv_capacity=2 * cap if n > 1 else cap, width=24
+        num_executors=n, capacity=cap, recv_capacity=2 * cap if n > 1 else cap,
+        width=24, impl=sort_impl,
     )
     mesh = make_mesh(n)
     fn = build_distributed_sort(mesh, spec)
@@ -667,11 +674,16 @@ def run_sort(args) -> None:
         )
 
     if args.batches > 1:
+        if args.sort_impl == "radix":
+            raise SystemExit(
+                "--sort-impl radix is not supported with --batches > 1 yet "
+                "(the out-of-core driver resolves its own per-batch lowering)"
+            )
         run_sort_external(args)
         return
     measure_sort(
         args.executors, args.num_blocks, args.iterations,
-        report=report, outstanding=args.outstanding,
+        report=report, outstanding=args.outstanding, sort_impl=args.sort_impl,
     )
 
 

@@ -75,8 +75,9 @@ class _ShuffleMeta:
     # or absent entirely ('device' — fetches slice HBM on demand):
     recv_shards: Optional[List[List[np.ndarray]]] = None  # [round][executor] uint8
     recv_sizes: Optional[List[np.ndarray]] = None         # [round] (n, n) rows j<-i
-    #: memmap backing files to unlink on remove_shuffle ('memmap' mode)
-    recv_spill_paths: List[str] = field(default_factory=list)
+    #: memmap backing (path, bytes) to unlink on remove_shuffle ('memmap'
+    #: mode); sizes are tracked so the disk budget is refunded exactly
+    recv_spill_paths: List[Tuple[str, int]] = field(default_factory=list)
     # HBM-resident copies of the received shards (conf.keep_device_recv) —
     # the source the device-side block gather serves from:
     recv_device: Optional[List[List[object]]] = None      # [round][executor] jax.Array
@@ -155,17 +156,20 @@ class TpuShuffleCluster:
         with self._lock:
             meta = self._meta.pop(shuffle_id, None)
         if meta is not None:
-            meta.recv_shards = None  # drop memmap views before unlinking
-            for path in meta.recv_spill_paths:
-                try:
-                    import os
+            import os
 
-                    size = os.path.getsize(path)
+            meta.recv_shards = None  # drop memmap views before unlinking
+            for path, size in meta.recv_spill_paths:
+                try:
                     os.unlink(path)
+                    freed = True
+                except FileNotFoundError:
+                    freed = True  # already gone: the bytes are not on disk
+                except OSError:
+                    freed = False  # still on disk: keep it charged
+                if freed:
                     with self._lock:
                         self._recv_spill_bytes -= size
-                except OSError:
-                    pass
         for t in self.transports:
             t.store.remove_shuffle(shuffle_id)
 
@@ -325,28 +329,41 @@ class TpuShuffleCluster:
         for j in range(n):
             host = np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
             cap = self.conf.spill_disk_cap_bytes
+            nbytes = int(host.nbytes)
+            # reserve-then-write keeps check+charge atomic under the lock;
+            # any write failure refunds the reservation and removes the
+            # half-written file so the budget cannot leak
             with self._lock:
-                if cap and self._recv_spill_bytes + host.nbytes > cap:
+                if cap and self._recv_spill_bytes + nbytes > cap:
                     raise TransportError(
                         f"received-shard spill would exceed spill_disk_cap_bytes "
-                        f"({self._recv_spill_bytes + host.nbytes} > {cap}); raise the "
+                        f"({self._recv_spill_bytes + nbytes} > {cap}); raise the "
                         f"cap or use host_recv_mode='device'"
                     )
-                self._recv_spill_bytes += host.nbytes
+                self._recv_spill_bytes += nbytes
             fd, path = tempfile.mkstemp(
                 prefix=f"sparkucx_tpu_recv_s{meta.shuffle_id}_r{rnd}_e{j}_",
                 dir=spill_dir,
             )
             os.close(fd)
             shape = host.shape
-            mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=shape)
-            mm[:] = host
-            mm.flush()
+            try:
+                mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=shape)
+                mm[:] = host
+                mm.flush()
+            except BaseException:
+                with self._lock:
+                    self._recv_spill_bytes -= nbytes
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                raise
             # Drop the write mapping and reopen read-only: the dirty pages are
             # unmapped (host RSS actually falls back to ~one transient shard),
             # and fetches fault in only the pages they touch.
             del mm, host
-            meta.recv_spill_paths.append(path)
+            meta.recv_spill_paths.append((path, nbytes))
             views.append(np.memmap(path, dtype=np.uint8, mode="r", shape=shape))
         return views
 

@@ -73,7 +73,7 @@ class TestMemmapMode:
             for shard in rnd:
                 assert isinstance(shard, np.memmap)
                 assert not shard.flags.writeable
-        spilled = list(meta.recv_spill_paths)
+        spilled = [p for p, _ in meta.recv_spill_paths]
         assert spilled and all(os.path.exists(p) for p in spilled)
         _fetch_all(cluster, meta, 0, M, R, oracle)
         cluster.remove_shuffle(0)
@@ -190,7 +190,7 @@ class TestHostBudgetStructural:
             if not isinstance(shard, np.memmap)
         )
         assert ram_backed == 0, f"{ram_backed} recv bytes retained in RAM"
-        on_disk = sum(os.path.getsize(p) for p in meta.recv_spill_paths)
+        on_disk = sum(os.path.getsize(p) for p, _ in meta.recv_spill_paths)
         received = sum(int(s.sum()) for s in meta.recv_sizes) * conf.block_alignment
         assert on_disk >= received > 0
         assert cluster._recv_spill_bytes == on_disk
