@@ -26,6 +26,21 @@ public final class DaemonClient implements AutoCloseable {
   public static final int OP_FETCH = 3;          // AM FetchBlockReq
   public static final int OP_FETCH_ACK = 4;      // AM FetchBlockReqAck
 
+  /** Shared frame ceiling — MUST equal MAX_FRAME_BYTES in
+   * sparkucx_tpu/core/definitions.py (the daemon drops any connection whose
+   * frame claims more; fixture 10_oversized_frame.bin pins both sides). */
+  public static final long MAX_FRAME_BYTES = 1L << 31;
+
+  /** True when a frame header's declared sizes exceed the shared ceiling —
+   * the reject condition both the daemon and this client apply before
+   * allocating anything.  Written without the naive sum so two huge positive
+   * lengths cannot wrap the long negative and sneak past the guard. */
+  static boolean frameTooLarge(long headerLen, long bodyLen) {
+    return headerLen < 0 || bodyLen < 0
+        || headerLen > MAX_FRAME_BYTES
+        || bodyLen > MAX_FRAME_BYTES - headerLen;
+  }
+
   private final Socket socket;
   private final DataOutputStream out;
   private final DataInputStream in;
@@ -95,8 +110,20 @@ public final class DaemonClient implements AutoCloseable {
     in.readFully(frameHeader);
     ByteBuffer bb = ByteBuffer.wrap(frameHeader).order(ByteOrder.LITTLE_ENDIAN);
     bb.getInt(); // reply op
-    int hlen = (int) bb.getLong();
-    int blen = (int) bb.getLong();
+    long hlenL = bb.getLong();
+    long blenL = bb.getLong();
+    // the shared wire ceiling, plus the JVM's own array bound: a frame AT
+    // the 2 GiB limit is wire-legal but not int-addressable here, so it gets
+    // the same controlled close instead of a NegativeArraySizeException
+    if (frameTooLarge(hlenL, blenL)
+        || hlenL > Integer.MAX_VALUE || blenL > Integer.MAX_VALUE) {
+      socket.close();
+      throw new IOException(
+          "reply frame too large (header " + hlenL + " + body " + blenL
+              + " B vs limit " + MAX_FRAME_BYTES + ")");
+    }
+    int hlen = (int) hlenL;
+    int blen = (int) blenL;
     byte[] replyHeader = new byte[hlen];
     byte[] replyBody = new byte[blen];
     in.readFully(replyHeader);

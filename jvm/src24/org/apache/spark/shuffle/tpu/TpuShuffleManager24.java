@@ -1,0 +1,143 @@
+package org.apache.spark.shuffle.tpu;
+
+import java.io.IOException;
+
+import org.apache.spark.ShuffleDependency;
+import org.apache.spark.SparkConf;
+import org.apache.spark.TaskContext;
+import org.apache.spark.shuffle.ShuffleBlockResolver;
+import org.apache.spark.shuffle.ShuffleHandle;
+import org.apache.spark.shuffle.ShuffleManager;
+import org.apache.spark.shuffle.ShuffleReadMetricsReporter;
+import org.apache.spark.shuffle.ShuffleReader;
+import org.apache.spark.shuffle.ShuffleWriteMetricsReporter;
+import org.apache.spark.shuffle.ShuffleWriter;
+
+/**
+ * The {@code spark.shuffle.manager} entry point for SPARK 2.4 — the analogue
+ * of the reference's {@code compat/spark_2_4/UcxShuffleManager.scala:21-35},
+ * compiled against the 2.4-signature {@link ShuffleManager} stub
+ * (jvm/stubs24) in its own CI leg.
+ *
+ * The daemon protocol is generation-agnostic by construction (jvm/README.md
+ * "Spark 2.4 vs 3.x"), so this class is a signature adapter over the SAME
+ * machinery the 3.x shim uses:
+ *
+ * <ul>
+ *   <li>{@code registerShuffle(id, numMaps, dep)} — 2.4 hands numMaps
+ *       explicitly; forwarded to the daemon instead of being derived from
+ *       the RDD;
+ *   <li>{@code getWriter(handle, mapId int, ctx)} — 2.4's mapId IS the map
+ *       partition index, exactly what the daemon's map slot wants (the
+ *       re-keying note in TpuShuffleManager.getWriter);
+ *   <li>{@code getReader(handle, startPartition, endPartition, ctx)} — no
+ *       AQE map range on 2.4: the full range {@code [0, numMaps)}, no
+ *       metrics reporters (no-op reporters are supplied so the shared
+ *       writer/reader classes keep their accounting calls).
+ * </ul>
+ */
+public class TpuShuffleManager24 implements ShuffleManager {
+  private final SparkConf conf;
+  private volatile DaemonClient client;
+
+  public TpuShuffleManager24(SparkConf conf) {
+    this.conf = conf;
+  }
+
+  private DaemonClient daemon() throws IOException {
+    DaemonClient c = client;
+    if (c == null) {
+      synchronized (this) {
+        if (client == null) {
+          String host = conf.get("spark.shuffle.tpu.daemon.host", "127.0.0.1");
+          int port = conf.getInt("spark.shuffle.tpu.daemon.port", 1338);
+          client = new DaemonClient(host, port);
+        }
+        c = client;
+      }
+    }
+    return c;
+  }
+
+  /** 2.4 has no separate read/write metrics reporter plumbing on this SPI —
+   * the shared writer/reader classes get no-op sinks. */
+  static final class NoopWriteMetrics implements ShuffleWriteMetricsReporter {
+    @Override public void incBytesWritten(long v) {}
+    @Override public void incRecordsWritten(long v) {}
+  }
+
+  static final class NoopReadMetrics implements ShuffleReadMetricsReporter {
+    @Override public void incRemoteBlocksFetched(long v) {}
+    @Override public void incRemoteBytesRead(long v) {}
+    @Override public void incFetchWaitTime(long v) {}
+  }
+
+  @Override
+  public <K, V, C> ShuffleHandle registerShuffle(
+      int shuffleId, int numMaps, ShuffleDependency<K, V, C> dependency) {
+    try {
+      daemon().createShuffle(
+          shuffleId, numMaps, dependency.partitioner().numPartitions());
+    } catch (IOException e) {
+      throw new RuntimeException("TPU shuffle daemon unreachable", e);
+    }
+    return new TpuShuffleManager.TpuShuffleHandle<>(shuffleId, numMaps, dependency);
+  }
+
+  @Override
+  @SuppressWarnings("unchecked")
+  public <K, V> ShuffleWriter<K, V> getWriter(
+      ShuffleHandle handle, int mapId, TaskContext context) {
+    TpuShuffleManager.TpuShuffleHandle<K, V, ?> h =
+        (TpuShuffleManager.TpuShuffleHandle<K, V, ?>) handle;
+    // 2.4's int mapId is already the 0..numMaps-1 index the daemon keys on;
+    // it also serves as the MapStatus id on this generation.
+    try {
+      return new TpuShuffleWriter<>(daemon(), h, mapId, mapId, new NoopWriteMetrics());
+    } catch (IOException e) {
+      throw new RuntimeException(e);
+    }
+  }
+
+  @Override
+  @SuppressWarnings("unchecked")
+  public <K, C> ShuffleReader<K, C> getReader(
+      ShuffleHandle handle, int startPartition, int endPartition, TaskContext context) {
+    TpuShuffleManager.TpuShuffleHandle<K, ?, C> h =
+        (TpuShuffleManager.TpuShuffleHandle<K, ?, C>) handle;
+    try {
+      // no AQE on 2.4: always the full map range
+      return new TpuShuffleReader<>(
+          daemon(), h, 0, Integer.MAX_VALUE, startPartition, endPartition,
+          new NoopReadMetrics());
+    } catch (IOException e) {
+      throw new RuntimeException(e);
+    }
+  }
+
+  @Override
+  public boolean unregisterShuffle(int shuffleId) {
+    try {
+      daemon().removeShuffle(shuffleId);
+      return true;
+    } catch (IOException e) {
+      return false;
+    }
+  }
+
+  @Override
+  public ShuffleBlockResolver shuffleBlockResolver() {
+    return null;  // blocks live in the daemon (TpuShuffleManager's rationale)
+  }
+
+  @Override
+  public void stop() {
+    DaemonClient c = client;
+    if (c != null) {
+      try {
+        c.close();
+      } catch (IOException ignored) {
+      }
+    }
+  }
+}

@@ -1,0 +1,34 @@
+package org.apache.spark.shuffle;
+
+import org.apache.spark.ShuffleDependency;
+import org.apache.spark.TaskContext;
+
+/**
+ * Compile-only stub of the SPARK 2.4 ShuffleManager SPI — the signature set
+ * the reference's compat/spark_2_4 tree overrides
+ * (compat/spark_2_4/UcxShuffleManager.scala:21-35):
+ *
+ * <ul>
+ *   <li>{@code registerShuffle} takes an explicit {@code numMaps};
+ *   <li>{@code getWriter}'s mapId is the {@code int} map partition index
+ *       (3.x made it a {@code long} task attempt id);
+ *   <li>{@code getReader} has no map range (AQE) and no metrics reporter
+ *       parameters.
+ * </ul>
+ *
+ * Compiled INSTEAD OF the 3.x stub (same fully-qualified name) for the
+ * jvm/src24 tree — classpath order in scripts/run_integration.sh and
+ * .github/workflows/ci.yml puts this stub first for that compile.  All other
+ * SPI stubs (ShuffleWriter, ShuffleReader, ShuffleHandle, ...) are shared:
+ * those surfaces did not change shape across the generations.
+ */
+public interface ShuffleManager {
+  <K, V, C> ShuffleHandle registerShuffle(
+      int shuffleId, int numMaps, ShuffleDependency<K, V, C> dependency);
+  <K, V> ShuffleWriter<K, V> getWriter(ShuffleHandle handle, int mapId, TaskContext context);
+  <K, C> ShuffleReader<K, C> getReader(
+      ShuffleHandle handle, int startPartition, int endPartition, TaskContext context);
+  boolean unregisterShuffle(int shuffleId);
+  ShuffleBlockResolver shuffleBlockResolver();
+  void stop();
+}

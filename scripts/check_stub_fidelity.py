@@ -42,6 +42,12 @@ from typing import Dict, List, Optional, Set, Tuple
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STUB_DIR = os.path.join(ROOT, "jvm", "stubs")
 SRC_DIR = os.path.join(ROOT, "jvm", "src")
+#: the Spark 2.4 compile leg: src24 shim sources checked against the shared
+#: stubs with stubs24 OVERRIDING same-named types (the 2.4-signature
+#: ShuffleManager) — mirrors the classpath order of the javac legs in
+#: run_integration.sh / ci.yml
+STUB24_DIR = os.path.join(ROOT, "jvm", "stubs24")
+SRC24_DIR = os.path.join(ROOT, "jvm", "src24")
 
 _KEYWORDS = {
     "if", "for", "while", "switch", "catch", "return", "new", "throw",
@@ -147,16 +153,19 @@ def parse_java(path: str) -> List[JavaType]:
     return out
 
 
-def load_stubs() -> Dict[str, JavaType]:
+def load_stubs(stub_dir: Optional[str] = None) -> Dict[str, JavaType]:
+    # resolve the default at CALL time: tests retarget the module globals
+    # at alternate trees (tests/test_stub_fidelity.py run_on)
+    stub_dir = stub_dir or STUB_DIR
     stubs: Dict[str, JavaType] = {}
     errors: List[str] = []
-    for dirpath, _, files in os.walk(STUB_DIR):
+    for dirpath, _, files in os.walk(stub_dir):
         for fn in files:
             if not fn.endswith(".java"):
                 continue
             path = os.path.join(dirpath, fn)
             types = parse_java(path)
-            expect_pkg = os.path.relpath(dirpath, STUB_DIR).replace(os.sep, ".")
+            expect_pkg = os.path.relpath(dirpath, stub_dir).replace(os.sep, ".")
             expect_name = fn[:-5]
             if not types:
                 errors.append(f"{path}: no type declaration found")
@@ -346,20 +355,30 @@ def _find_close(src: str, open_paren: int) -> Optional[int]:
 
 def main() -> int:
     stubs = load_stubs()
+    # 2.4 leg: shared stubs with the stubs24 overrides shadowing same-named
+    # types (the classpath order of the javac invocation); references from
+    # src24 to the 3.x shim classes themselves are not stub-typed and are
+    # skipped by the checker like any non-stub receiver
+    overrides = load_stubs(STUB24_DIR)
+    stubs24 = dict(stubs)
+    stubs24.update(overrides)
     errors: List[str] = []
     n_files = 0
-    for dirpath, _, files in os.walk(SRC_DIR):
-        for fn in sorted(files):
-            if fn.endswith(".java"):
-                n_files += 1
-                errors.extend(check_shim_file(os.path.join(dirpath, fn), stubs))
+    legs = [(SRC_DIR, stubs), (SRC24_DIR, stubs24)]
+    for src_dir, stub_set in legs:
+        for dirpath, _, files in os.walk(src_dir):
+            for fn in sorted(files):
+                if fn.endswith(".java"):
+                    n_files += 1
+                    errors.extend(check_shim_file(os.path.join(dirpath, fn), stub_set))
     if errors:
         for e in errors:
             print(f"FIDELITY: {e}")
         print(f"STUB FIDELITY: FAIL ({len(errors)} problems)")
         return 1
     print(
-        f"STUB FIDELITY: OK — {n_files} shim sources x {len(stubs)} stub types: "
+        f"STUB FIDELITY: OK — {n_files} shim sources (incl. the 2.4-signature "
+        f"leg) x {len(stubs)}+{len(overrides)} stub types: "
         "imports resolve, SPI overrides complete, resolved calls + ctors match "
         "stub signatures"
     )

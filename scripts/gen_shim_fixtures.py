@@ -25,7 +25,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from sparkucx_tpu.core.definitions import AmId  # noqa: E402
+from sparkucx_tpu.core.definitions import AmId, MAX_FRAME_BYTES  # noqa: E402
 from sparkucx_tpu.shuffle.daemon import DaemonOp, _frame  # noqa: E402
 
 FIXTURE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "jvm", "fixtures")
@@ -45,6 +45,22 @@ WRITE_BODY = bytes(range(256))
 #: that the protocol is compat-generation-agnostic (jvm/README.md, "Spark 2.4
 #: vs 3.x").
 AQE_MAPS, AQE_REDUCES = (1, 2), (REDUCE_ID, REDUCE_ID)
+
+#: 09: an AQE COALESCED read — one reducer task reading a coalesced range of
+#: reduce partitions (5..6) across EVERY mapper (0..3), the
+#: ShufflePartitionSpec shape AQE emits after coalescing small partitions.
+#: Some of these (map, reduce) cells are legitimately empty in the behavioral
+#: replay (tests/test_daemon.py) — the daemon must answer size 0, never -1.
+COALESCE_MAPS = tuple(m for m in range(NUM_MAPPERS) for _ in (5, 6))
+COALESCE_REDUCES = tuple(r for _ in range(NUM_MAPPERS) for r in (5, 6))
+
+#: 10: an OVERSIZED frame header — op WritePartition claiming a body one byte
+#: past MAX_FRAME_BYTES.  Negative fixture: both sides must REFUSE it
+#: (FixtureCheck.java asserts the Java limit matches and rejects; the daemon
+#: drops the connection and keeps serving — tests/test_daemon.py).
+OVERSIZED_HEADER = struct.pack(
+    "<IQQ", DaemonOp.WRITE_PARTITION, 0, MAX_FRAME_BYTES + 1
+)
 
 
 def fetch_frame(maps=FETCH_MAPS, reduces=FETCH_REDUCES) -> bytes:
@@ -71,6 +87,8 @@ def fixtures() -> dict:
         "06_fetch.bin": fetch_frame(),
         "07_remove_shuffle.bin": _frame(DaemonOp.REMOVE_SHUFFLE, {"shuffle_id": SHUFFLE_ID}),
         "08_fetch_aqe_maprange.bin": fetch_frame(AQE_MAPS, AQE_REDUCES),
+        "09_fetch_coalesced_empty.bin": fetch_frame(COALESCE_MAPS, COALESCE_REDUCES),
+        "10_oversized_frame.bin": OVERSIZED_HEADER,
     }
 
 

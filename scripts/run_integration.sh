@@ -73,6 +73,12 @@ run_jvm_shim_check() { # ci.yml jvm-shim job, runnable anywhere a JDK exists
   javac -d jvm/target/stub-classes $(find jvm/stubs -name '*.java')
   javac -cp jvm/target/stub-classes -d jvm/target/classes \
     $(find jvm/src -name '*.java')
+  echo "-- jvm shim: compile the Spark 2.4-signature leg (stubs24 shadows)"
+  mkdir -p jvm/target/classes24 jvm/target/stub24-classes
+  javac -cp jvm/target/stub-classes -d jvm/target/stub24-classes \
+    $(find jvm/stubs24 -name '*.java')
+  javac -cp jvm/target/stub24-classes:jvm/target/stub-classes:jvm/target/classes \
+    -d jvm/target/classes24 $(find jvm/src24 -name '*.java')
   echo "-- jvm shim: golden wire fixtures (Java side)"
   java -cp jvm/target/classes:jvm/target/stub-classes \
     org.apache.spark.shuffle.tpu.FixtureCheck jvm/fixtures

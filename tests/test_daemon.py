@@ -220,7 +220,9 @@ class TestGoldenWireFixtures:
             assert burn == [0, 1, 2]
             payload_m0 = b"\xaa" * 100
             payload_m3 = b"\xbb" * 300
+            payload_m1r6 = b"\xcc" * 77  # fixture 09's only reduce-6 block
             client.write_partition(burn[0], gen.REDUCE_ID, payload_m0)
+            client.write_partition(burn[1], 6, payload_m1r6)
             client.write_partition(burn[2], gen.REDUCE_ID, payload_m3)
 
             meta, _ = send_fixture("02_open_map_writer.bin")  # map 2 -> handle 3
@@ -269,6 +271,19 @@ class TestGoldenWireFixtures:
             assert sizes == [0, len(gen.WRITE_BODY)]
             assert body == gen.WRITE_BODY
 
+            # the AQE COALESCED read (09): reduce range 5..6 across EVERY
+            # mapper — present and empty cells mixed; empties must answer
+            # size 0 (a real committed-empty block), never -1 (a miss)
+            tag, count, sizes, body = raw_fetch("09_fetch_coalesced_empty.bin")
+            assert tag == gen.FETCH_TAG and count == len(gen.COALESCE_MAPS)
+            assert sizes == [
+                len(payload_m0), 0,              # map 0: r5 block, r6 empty
+                0, len(payload_m1r6),            # map 1: r5 empty, r6 block
+                len(gen.WRITE_BODY), 0,          # map 2: the fixture write
+                len(payload_m3), 0,              # map 3
+            ]
+            assert body == payload_m0 + payload_m1r6 + gen.WRITE_BODY + payload_m3
+
             send_fixture("07_remove_shuffle.bin")
             with pytest.raises(RuntimeError):
                 client.stats(gen.SHUFFLE_ID)
@@ -276,3 +291,78 @@ class TestGoldenWireFixtures:
             raw.close()
             client.close()
             d.close()
+
+
+class TestErrorEdges:
+    """The error/edge wire paths the first eight fixtures skipped
+    (VERDICT r4 item 6): oversized-frame rejection and daemon restart
+    mid-job."""
+
+    def test_oversized_frame_drops_connection_daemon_survives(self):
+        import socket
+
+        gen = TestGoldenWireFixtures._gen(self)
+        import os
+
+        oversized = open(
+            os.path.join(gen.FIXTURE_DIR, "10_oversized_frame.bin"), "rb"
+        ).read()
+        d = ShuffleDaemon(
+            TpuShuffleConf(staging_capacity_per_executor=1 << 18, num_executors=1),
+            num_executors=1,
+        )
+        try:
+            raw = socket.create_connection(d.address)
+            raw.sendall(oversized)
+            raw.settimeout(10)
+            # the daemon must refuse BEFORE reading/allocating the 2 GiB body:
+            # this connection is dropped (endpoint-eviction policy)
+            assert raw.recv(1) == b"", "daemon accepted an oversized frame"
+            raw.close()
+            # ...and keeps serving new connections
+            c = DaemonClient(d.address)
+            c.create_shuffle(55, 1, 1)
+            w = c.open_map_writer(55, 0)
+            c.write_partition(w, 0, b"alive")
+            c.commit_map(w)
+            c.run_exchange(55)
+            [blk] = c.fetch_blocks([ShuffleBlockId(55, 0, 0)])
+            assert blk == b"alive"
+            c.close()
+        finally:
+            d.close()
+
+    def test_daemon_restart_mid_job(self, rng):
+        """Kill the daemon after a partial map stage; a fresh daemon on a new
+        port serves the re-run job from clean state — the task-retry
+        discipline the reference never had (SURVEY §5.3: it only logs)."""
+        conf = TpuShuffleConf(staging_capacity_per_executor=1 << 18, num_executors=1)
+        d1 = ShuffleDaemon(conf, num_executors=1)
+        c1 = DaemonClient(d1.address)
+        c1.create_shuffle(9, 2, 2)
+        w = c1.open_map_writer(9, 0)
+        c1.write_partition(w, 0, b"lost-on-restart")
+        c1.commit_map(w)  # map 0 committed; map 1 never runs
+        d1.close()  # daemon dies mid-job
+        c1.close()
+
+        # driver-side retry: fresh daemon, SAME shuffle id, full re-run
+        d2 = ShuffleDaemon(conf, num_executors=1)
+        c2 = DaemonClient(d2.address)
+        try:
+            c2.create_shuffle(9, 2, 2)  # no stale state: re-create succeeds
+            oracle = {}
+            for m in range(2):
+                w = c2.open_map_writer(9, m)
+                for r in range(2):
+                    payload = rng.integers(0, 256, size=200, dtype=np.uint8).tobytes()
+                    oracle[(m, r)] = payload
+                    c2.write_partition(w, r, payload)
+                c2.commit_map(w)
+            c2.run_exchange(9)
+            bids = [ShuffleBlockId(9, m, r) for m in range(2) for r in range(2)]
+            for bid, blk in zip(bids, c2.fetch_blocks(bids)):
+                assert blk == oracle[(bid.map_id, bid.reduce_id)]
+        finally:
+            c2.close()
+            d2.close()
