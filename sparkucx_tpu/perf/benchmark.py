@@ -109,6 +109,13 @@ def _parse_args(argv):
         "conf spark.shuffle.tpu.partialAggregation)",
     )
     p.add_argument(
+        "--join-type", default="inner",
+        choices=["inner", "left_outer", "left_semi", "left_anti",
+                 "right_outer", "full_outer"],
+        help="join arm to benchmark (join mode); half the probe keys miss so "
+        "every arm's matched AND unmatched branches do real work",
+    )
+    p.add_argument(
         "--sort-impl", default="auto",
         choices=["auto", "single", "radix", "ragged", "dense"],
         help="sort lowering (sort mode); 'radix' = the Pallas LSD radix "
@@ -553,14 +560,16 @@ def run_groupby(args) -> None:
 
 def measure_join(
     executors: int, probe_rows: int, build_rows: int, iterations: int,
-    outstanding: int = 8, report=None,
+    outstanding: int = 8, report=None, join_type: str = "inner",
 ) -> float:
     """Measurement core of the ``join`` mode — the device-resident PK-FK hash
     join (TPC-H's plan shape, BASELINE.json configs[2]): ``build_rows``
     dimension rows with globally unique keys, ``probe_rows`` fact rows each
-    referencing one of them, so every probe row matches exactly once and the
-    oracle check is just the row count.  Returns best M probe rows/s;
-    ``report(it, seconds, rows, impl)`` per iteration."""
+    referencing a key in [0, 2*build_rows) — half the probes hit, so every
+    ``join_type`` arm (inner/left_outer/left_semi/left_anti/right_outer/
+    full_outer) has real work on both its matched and unmatched branches.
+    The expected output count is computed with numpy set logic and asserted.
+    Returns best M probe rows/s; ``report(it, seconds, rows, impl)``."""
     from sparkucx_tpu.parallel.mesh import apply_platform_env
 
     apply_platform_env()
@@ -568,9 +577,11 @@ def measure_join(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from sparkucx_tpu.ops.exchange import make_mesh
-    from sparkucx_tpu.ops.relational import JoinSpec, build_hash_join
-
-    from sparkucx_tpu.ops.relational import hash_owners_host
+    from sparkucx_tpu.ops.relational import (
+        JoinSpec,
+        build_hash_join,
+        plan_join_capacities,
+    )
 
     n = executors
     build_rows = build_rows or probe_rows // 4  # the CLI's documented default
@@ -579,18 +590,29 @@ def measure_join(
     rng = np.random.default_rng(0)
     nb = n * bcap
     bkeys_h = rng.permutation(nb).astype(np.uint32)  # unique PKs, shuffled
-    pkeys_h = bkeys_h[rng.integers(0, nb, size=n * pcap)]  # FKs into them
-    # Size receive buffers from the ACTUAL hash placement (host twin of the
-    # device hash): per-shard key granularity can concentrate rows well past
-    # any fixed headroom when the build keyspace is small relative to n.
-    # The asserts below then guard host/device placement agreement, not luck.
-    brecv = int(np.bincount(hash_owners_host(bkeys_h, n), minlength=n).max())
-    precv = int(np.bincount(hash_owners_host(pkeys_h, n), minlength=n).max())
+    # FK keyspace = [0, 2*nb): ~half the probe rows match a PK, half miss
+    pkeys_h = rng.integers(0, 2 * nb, size=n * pcap, dtype=np.uint64).astype(np.uint32)
+    # Exact per-shard receive/output capacities from the host twin of the
+    # device placement hash (plan_join_capacities) — the asserts below then
+    # guard host/device placement agreement, not skew luck.
+    brecv, precv, out_cap = plan_join_capacities(
+        bkeys_h, pkeys_h, n, join_type=join_type
+    )
+    probe_hits = int(np.isin(pkeys_h, bkeys_h).sum())
+    build_missed = int((~np.isin(bkeys_h, pkeys_h)).sum())
+    expect = {
+        "inner": probe_hits,
+        "left_outer": n * pcap,                       # misses null-extend
+        "left_semi": probe_hits,                      # unique PKs: 1 emit/hit
+        "left_anti": n * pcap - probe_hits,
+        "right_outer": probe_hits + build_missed,
+        "full_outer": n * pcap + build_missed,
+    }[join_type]
     spec = JoinSpec(
         num_executors=n,
         build_capacity=bcap, build_recv_capacity=brecv, build_width=8,
         probe_capacity=pcap, probe_recv_capacity=precv, probe_width=16,
-        out_capacity=precv,
+        out_capacity=out_cap, join_type=join_type,
     )
     mesh = make_mesh(n)
     fn = build_hash_join(mesh, spec)
@@ -616,8 +638,8 @@ def measure_join(
         f"join output overflowed out_capacity ({counts.max()} > {spec.out_capacity})"
     )
     matches = int(counts.sum())
-    assert matches == n * pcap, (
-        f"PK-FK join matched {matches} rows, expected {n * pcap}"
+    assert matches == expect, (
+        f"{join_type} join emitted {matches} rows, expected {expect}"
     )
     best = 0.0
     for it in range(iterations):
@@ -644,7 +666,7 @@ def run_join(args) -> None:
 
     measure_join(
         args.executors, args.num_blocks, args.build_rows, args.iterations,
-        outstanding=args.outstanding, report=report,
+        outstanding=args.outstanding, report=report, join_type=args.join_type,
     )
 
 
@@ -674,10 +696,10 @@ def run_sort(args) -> None:
         )
 
     if args.batches > 1:
-        if args.sort_impl == "radix":
+        if args.sort_impl == "radix" and args.executors != 1:
             raise SystemExit(
-                "--sort-impl radix is not supported with --batches > 1 yet "
-                "(the out-of-core driver resolves its own per-batch lowering)"
+                "--sort-impl radix needs --executors 1 (the radix kernel is "
+                "the n=1 local-sort lowering)"
             )
         run_sort_external(args)
         return
@@ -703,7 +725,7 @@ def run_sort_external(args) -> None:
     cap = -(-total // (args.batches * n))
     spec = SortSpec(
         num_executors=n, capacity=cap, recv_capacity=2 * cap if n > 1 else cap,
-        width=24,
+        width=24, impl=args.sort_impl,
     )
     mesh = make_mesh(n)
     rng = np.random.default_rng(0)

@@ -137,7 +137,10 @@ def _run_join_case(seed: int) -> None:
     pvals = rng.integers(-50, 50, size=(ptotal, pw)).astype(np.int32)
 
     mesh = make_mesh(n)
-    join_type = ["inner", "left_outer", "left_semi", "left_anti"][seed % 4]
+    join_type = [
+        "inner", "left_outer", "left_semi", "left_anti",
+        "right_outer", "full_outer",
+    ][seed % 6]
     # over-provisioned input capacities (bcap/pcap >= fill) keep the
     # padding/validity-mask paths under fuzz, not just the tight auto-sizing
     out = run_hash_join(
@@ -145,7 +148,7 @@ def _run_join_case(seed: int) -> None:
         build_capacity=bcap, probe_capacity=pcap, join_type=join_type,
     )
     want = oracle_join(bkeys, bvals, pkeys, pvals, join_type=join_type)
-    if join_type == "left_outer":
+    if join_type in ("left_outer", "right_outer", "full_outer"):
         got_rows = sorted(
             (int(k), tuple(b.tolist()), tuple(p.tolist()), bool(m))
             for k, b, p, m in zip(*out)
@@ -192,10 +195,16 @@ def _run_groupby_case(seed: int) -> None:
     # test_sentinel_key_is_a_real_group)
     distinct = int(rng.choice([1, 2, 16, 1 << 32]))
     n_aggs = int(rng.integers(0, 4))
-    aggs = tuple(rng.choice(["sum", "min", "max"]) for _ in range(n_aggs))
+    aggs = tuple(
+        rng.choice(["sum", "min", "max", "avg", "count_distinct"])
+        for _ in range(n_aggs)
+    )
+    # map-side partial aggregation fuzzes alongside the unfused path; it
+    # rejects count_distinct by contract (partials don't compose)
+    partial = bool(rng.integers(0, 2)) and "count_distinct" not in aggs
     spec = AggregateSpec(
         num_executors=n, capacity=cap,
-        recv_capacity=max(8, 2 * cap), aggs=aggs, impl="dense",
+        recv_capacity=max(8, 2 * cap), aggs=aggs, impl="dense", partial=partial,
     )
     keys = rng.integers(0, distinct, size=total, dtype=np.uint64).astype(np.uint32)
     values = rng.integers(-1000, 1000, size=(total, n_aggs)).astype(np.int32)
