@@ -101,6 +101,33 @@ def main() -> None:
         keys,
     )
 
+    # The round-5 contender: the Pallas LSD radix sort whose scatter moves
+    # key+payload together by segment DMA (ops/radix.py; PERF.md brackets it
+    # 35-70 M rows/s).  Mosaic-only — the interpreter path would measure the
+    # emulator, so off-TPU this section just says so.
+    if jax.devices()[0].platform == "tpu":
+        from sparkucx_tpu.ops.radix import build_radix_sort
+
+        fused = jax.jit(
+            lambda k, p: jnp.concatenate(
+                [jax.lax.bitcast_convert_type(k, jnp.int32)[:, None], p], axis=1
+            )
+        )
+        rows_fused = jax.block_until_ready(fused(keys, pay))
+        try:
+            timed(
+                "radix LSD 8x4bit, fused 100 B rows (Pallas)",
+                build_radix_sort(N, 25), rows_fused,
+            )
+            rspec = SortSpec(
+                num_executors=1, capacity=N, recv_capacity=N, width=24, impl="radix"
+            )
+            timed("full sort body (impl=radix)", build_distributed_sort(mesh, rspec), keys, pay, nv)
+        except Exception as e:  # first hardware run of the kernel: report, don't die
+            print(f"radix variant failed: {type(e).__name__}: {e}", flush=True)
+    else:
+        print("radix variants: skipped (Mosaic kernel; TPU only)", flush=True)
+
 
 if __name__ == "__main__":
     main()

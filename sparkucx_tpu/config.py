@@ -138,7 +138,9 @@ class TpuShuffleConf:
     #: cache does the rest.  ``'device'`` keeps NO host copy at all: fetches
     #: slice the HBM-resident shard and D2H only the requested block
     #: (requires ``keep_device_recv``) — the reference's serve-from-NVKV
-    #: mode, where host memory never holds the shuffle.
+    #: mode, where host memory never holds the shuffle.  The SPMD
+    #: multi-controller executor honors 'array'/'memmap' per host ('device'
+    #: raises there: it releases device shards after the collective).
     host_recv_mode: str = "array"
     #: Ragged block-gather lowering: 'auto' (pipelined DMA kernel on TPU, XLA
     #: gather elsewhere) | 'dma' | 'tiled' | 'xla'.

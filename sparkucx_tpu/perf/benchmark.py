@@ -695,12 +695,12 @@ def run_sort(args) -> None:
             flush=True,
         )
 
+    if args.sort_impl in ("radix", "single") and args.executors != 1:
+        raise SystemExit(
+            f"--sort-impl {args.sort_impl} needs --executors 1 (it is an n=1 "
+            "local-sort lowering)"
+        )
     if args.batches > 1:
-        if args.sort_impl == "radix" and args.executors != 1:
-            raise SystemExit(
-                "--sort-impl radix needs --executors 1 (the radix kernel is "
-                "the n=1 local-sort lowering)"
-            )
         run_sort_external(args)
         return
     measure_sort(

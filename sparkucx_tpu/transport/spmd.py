@@ -86,6 +86,17 @@ class SpmdShuffleExecutor:
         self._recv: Dict[int, Tuple[List[np.ndarray], List[np.ndarray]]] = {}
         self._meta: Dict[int, Tuple[int, int, List[Tuple[int, int]]]] = {}
         self._exchange_fns: Dict[int, object] = {}
+        #: memmap spill files per shuffle (host_recv_mode='memmap')
+        self._recv_spill: Dict[int, List[str]] = {}
+        self._recv_spill_bytes = 0  # charged against conf.spill_disk_cap_bytes
+        if self.conf.host_recv_mode not in ("array", "memmap"):
+            # fail at construction, not after round 0's collective has run on
+            # every host: 'device' needs retained HBM shards this executor
+            # releases after the collective; anything else is a typo
+            raise ValueError(
+                f"host_recv_mode {self.conf.host_recv_mode!r} is not supported "
+                "by the SPMD executor (array|memmap)"
+            )
 
     # -- control plane -----------------------------------------------------
 
@@ -207,7 +218,9 @@ class SpmdShuffleExecutor:
             my_rs = next(
                 np.asarray(s.data) for s in rs.addressable_shards if s.device == self.device
             )
-            recv_shards.append(my_recv.reshape(-1).view(np.uint8))
+            recv_shards.append(
+                self._host_shard(shuffle_id, rnd, my_recv.reshape(-1).view(np.uint8))
+            )
             recv_sizes_rows.append(my_rs.reshape(-1))
         self._recv[shuffle_id] = (recv_shards, recv_sizes_rows)
         logger.info("exchange done: shuffle=%d rounds=%d", shuffle_id, num_rounds)
@@ -244,8 +257,65 @@ class SpmdShuffleExecutor:
         start = chunk_start + region_rel
         return bytes(shards[rnd][start : start + length])
 
+    def _host_shard(self, shuffle_id: int, rnd: int, host: np.ndarray) -> np.ndarray:
+        """Apply ``conf.host_recv_mode`` to one received round: 'array' keeps
+        the RAM copy (historical behavior), 'memmap' spills it to a read-only
+        disk mapping so per-host RSS stays bounded by one round — the same
+        budget discipline as the single-controller cluster (transport/tpu.py
+        ``_memmap_round``): every spilled byte reserves against
+        ``spill_disk_cap_bytes`` up front and a failed write refunds and
+        unlinks (mode validity is checked at construction)."""
+        if self.conf.host_recv_mode == "array":
+            return host
+        import os
+        import tempfile
+
+        cap = self.conf.spill_disk_cap_bytes
+        nbytes = int(host.nbytes)
+        if cap and self._recv_spill_bytes + nbytes > cap:
+            raise TransportError(
+                f"received-shard spill would exceed spill_disk_cap_bytes "
+                f"({self._recv_spill_bytes + nbytes} > {cap}) on executor "
+                f"{self.executor_id}"
+            )
+        self._recv_spill_bytes += nbytes
+        spill_dir = self.conf.spill_dir
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+        fd, path = tempfile.mkstemp(
+            prefix=f"sparkucx_tpu_spmd_recv_s{shuffle_id}_r{rnd}_e{self.executor_id}_",
+            dir=spill_dir,
+        )
+        os.close(fd)
+        shape = host.shape
+        try:
+            mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=shape)
+            mm[:] = host
+            mm.flush()
+        except BaseException:
+            self._recv_spill_bytes -= nbytes
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
+        del mm, host  # drop the dirty mapping; reopen read-only (RSS falls)
+        self._recv_spill.setdefault(shuffle_id, []).append(path)
+        return np.memmap(path, dtype=np.uint8, mode="r", shape=shape)
+
     def remove_shuffle(self, shuffle_id: int) -> None:
         self.store.remove_shuffle(shuffle_id)
         self._recv.pop(shuffle_id, None)
         self._meta.pop(shuffle_id, None)
         self._mapper_infos.pop(shuffle_id, None)
+        import os
+
+        for path in self._recv_spill.pop(shuffle_id, []):
+            try:
+                size = os.path.getsize(path)
+                os.unlink(path)
+                self._recv_spill_bytes -= size
+            except FileNotFoundError:
+                pass  # already gone; its bytes were refunded or never written
+            except OSError:
+                pass  # still on disk: keep it charged

@@ -29,7 +29,12 @@ CHILD = textwrap.dedent(
     from sparkucx_tpu.transport.spmd import SpmdShuffleExecutor
 
     pid = int(sys.argv[1]); coord = sys.argv[2]; driver_host, driver_port = sys.argv[3].split(":")
-    conf = TpuShuffleConf(staging_capacity_per_executor=1 << 20, num_slices=int(os.environ.get("TEST_NUM_SLICES", "1")))
+    conf = TpuShuffleConf(
+        staging_capacity_per_executor=1 << 20,
+        num_slices=int(os.environ.get("TEST_NUM_SLICES", "1")),
+        host_recv_mode=os.environ.get("TEST_HOST_RECV_MODE", "array"),
+        spill_dir=os.environ.get("TEST_SPILL_DIR") or None,
+    )
     ex = SpmdShuffleExecutor(conf, coordinator_address=coord, num_processes=2, process_id=pid)
     assert ex.num_executors == 2, ex.num_executors
     addr = ex.init()
@@ -66,6 +71,14 @@ CHILD = textwrap.dedent(
             assert got == payload(m, r), f"mismatch at map={{m}} reduce={{r}}"
             checked += 1
     assert checked > 0
+    if conf.host_recv_mode == "memmap":
+        # the received rounds live on disk, not RAM, and are reclaimed
+        shards, _ = ex._recv[0]
+        assert shards and all(isinstance(s, np.memmap) for s in shards)
+        paths = list(ex._recv_spill.get(0, []))
+        assert paths and all(os.path.exists(p) for p in paths)
+        ex.remove_shuffle(0)
+        assert not any(os.path.exists(p) for p in paths), "spmd spill leaked"
     print(f"CHILD_PASS pid={{pid}} checked={{checked}}", flush=True)
     ex.close(); ep.close()
     """
@@ -117,6 +130,38 @@ def test_two_process_spmd_exchange_two_slices():
     driver_addr = f"{driver.address[0]}:{driver.address[1]}"
     env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     env["TEST_NUM_SLICES"] = "2"
+    script = CHILD.format(root=ROOT)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(pid), coord, driver_addr],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=ROOT, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"child {pid} failed:\n{out[-3000:]}"
+            assert f"CHILD_PASS pid={pid}" in out, out[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        driver.close()
+
+
+def test_two_process_spmd_exchange_memmap(tmp_path):
+    """Multi-controller + host_recv_mode='memmap': each process spills its
+    received rounds to read-only disk mappings (the per-host memory budget of
+    transport/tpu.py's memmap mode) and reclaims them on remove_shuffle."""
+    from sparkucx_tpu.parallel.bootstrap import DriverEndpoint
+
+    driver = DriverEndpoint()
+    coord = f"127.0.0.1:{_free_port()}"
+    driver_addr = f"{driver.address[0]}:{driver.address[1]}"
+    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["TEST_HOST_RECV_MODE"] = "memmap"
+    env["TEST_SPILL_DIR"] = str(tmp_path)
     script = CHILD.format(root=ROOT)
     procs = [
         subprocess.Popen(
