@@ -409,3 +409,112 @@ def test_q4_order_priority_semi_join(mesh, rng):
     want_k, want_c = np.unique(o_priority[exists], return_counts=True)
     assert np.array_equal(gk, want_k.astype(np.uint32))
     assert np.array_equal(gc, want_c)
+
+
+def test_q16_supplier_count_distinct_with_exclusion(mesh, rng):
+    """q16 shape: COUNT(DISTINCT ps_suppkey) GROUP BY part attributes, after
+    excluding complained-about suppliers — a NOT IN anti join feeding a
+    count-distinct aggregation (both round-5 vocabulary arms, composed the
+    way the real plan composes them)."""
+    from sparkucx_tpu.ops.relational import (
+        oracle_aggregate,
+        run_grouped_aggregate,
+        run_hash_join,
+    )
+
+    n_parts, n_suppliers = 40, 60
+    rows = 800
+    # partsupp: (partkey, suppkey) pairs with duplication
+    partkey = rng.integers(0, n_parts, size=rows, dtype=np.uint64).astype(np.uint32)
+    suppkey = rng.integers(0, n_suppliers, size=rows).astype(np.int32)
+    # suppliers with complaints (the NOT IN subquery's result)
+    complained = rng.choice(n_suppliers, size=12, replace=False).astype(np.uint32)
+
+    # stage 1: partsupp ANTI JOIN complaints ON suppkey (probe keyed by supp)
+    jk, jb, jp = run_hash_join(
+        mesh,
+        complained, np.zeros((len(complained), 1), np.int32),
+        suppkey.astype(np.uint32), np.stack([partkey.astype(np.int32), suppkey], axis=1),
+        impl="dense", join_type="left_anti",
+    )
+    surv_part = jp[:, 0].astype(np.uint32)
+    surv_supp = jp[:, 1][:, None].astype(np.int32)
+
+    # stage 2: COUNT(DISTINCT suppkey) GROUP BY partkey over the survivors
+    spec = AggregateSpec(
+        num_executors=N, capacity=max(1, -(-len(surv_part) // N)) + 8,
+        recv_capacity=4 * CAP, aggs=("count_distinct",),
+    )
+    gk, gv, gc = run_grouped_aggregate(mesh, spec, surv_part, surv_supp)
+
+    keep = ~np.isin(suppkey, complained.astype(np.int64))
+    wk, wv, wc = oracle_aggregate(
+        partkey[keep], suppkey[keep][:, None], ("count_distinct",)
+    )
+    np.testing.assert_array_equal(gk, wk)
+    np.testing.assert_array_equal(gv, wv)
+    np.testing.assert_array_equal(gc, wc)  # per-group COUNT(*) rides along
+    # and against the SQL meaning directly
+    for k, cnt in zip(gk, gv[:, 0]):
+        m = (partkey == k) & keep
+        assert cnt == len(np.unique(suppkey[m]))
+
+
+def test_q22_global_sales_opportunity(mesh, rng):
+    """q22 shape: customers with above-average account balance and NO orders —
+    a scalar AVG subquery (fused avg), a WHERE filter against it, and a NOT
+    EXISTS anti join, then COUNT/SUM per country code."""
+    from sparkucx_tpu.ops.relational import (
+        oracle_aggregate,
+        run_grouped_aggregate,
+        run_hash_join,
+    )
+
+    n_cust = 300
+    custkey = np.arange(n_cust, dtype=np.uint32)
+    country = rng.integers(10, 17, size=n_cust).astype(np.uint32)  # cntrycode
+    acctbal = rng.integers(-500, 5000, size=n_cust).astype(np.int32)
+    # orders: ~half the customers have at least one
+    order_cust = rng.choice(n_cust, size=n_cust // 2, replace=False).astype(np.uint32)
+
+    # stage 1: scalar subquery AVG(acctbal) WHERE acctbal > 0 — one global
+    # group through the fused-avg aggregation
+    pos = acctbal > 0
+    # ONE global group: every surviving row lands on a single shard, so the
+    # receive buffer must hold all n_cust rows up front (a smaller bound
+    # would deterministically retry-recompile)
+    spec_avg = AggregateSpec(
+        num_executors=N, capacity=max(1, -(-n_cust // N)) + 8,
+        recv_capacity=n_cust, aggs=("avg",), with_filter=True,
+    )
+    ak, av, ac = run_grouped_aggregate(
+        mesh, spec_avg, np.zeros(n_cust, np.uint32), acctbal[:, None], mask=pos
+    )
+    threshold = float(av[0, 0])
+    assert threshold == acctbal[pos].astype(np.float64).mean()
+
+    # stage 2: customers above threshold ANTI JOIN orders (NOT EXISTS)
+    rich = acctbal.astype(np.float64) > threshold
+    jk, jb, jp = run_hash_join(
+        mesh,
+        order_cust, np.zeros((len(order_cust), 1), np.int32),
+        custkey[rich], np.stack([country[rich].astype(np.int32), acctbal[rich]], axis=1),
+        impl="dense", join_type="left_anti",
+    )
+
+    # stage 3: COUNT(*), SUM(acctbal) GROUP BY cntrycode
+    spec_f = AggregateSpec(
+        num_executors=N, capacity=max(1, -(-max(len(jk), 1) // N)) + 8,
+        recv_capacity=2 * CAP, aggs=("sum",),
+    )
+    gk, gv, gc = run_grouped_aggregate(
+        mesh, spec_f, jp[:, 0].astype(np.uint32), jp[:, 1][:, None]
+    )
+
+    want_mask = rich & ~np.isin(custkey, order_cust)
+    wk, wv, wc = oracle_aggregate(
+        country[want_mask], acctbal[want_mask][:, None], ("sum",)
+    )
+    np.testing.assert_array_equal(gk, wk)
+    np.testing.assert_array_equal(gv, wv)
+    np.testing.assert_array_equal(gc, wc)
