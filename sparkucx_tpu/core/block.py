@@ -158,13 +158,16 @@ class Block(ABC):
 
     def memory_view(self) -> Optional[np.ndarray]:
         """Zero-copy serving hook: a stable uint8 view of the block's bytes,
-        or None when the block must be materialized (file-backed).  Serving
-        paths capture the view under ``self.lock``; a concurrent ``mutate``
-        swaps the backing array but the captured view keeps the old one alive
-        — the same consistent-at-capture semantics as ``get_memory_block``.
-        Memory-backed blocks should override: materializing a fresh buffer
-        per fetch was the measured wall of the peer-serving path (allocation
-        + copy + page faults per request, docs/PERF.md peer row)."""
+        or None when no such view exists (an unmappable source — the server
+        then materializes via ``get_memory_block``).  Serving paths capture
+        the view under ``self.lock``; a concurrent ``mutate`` swaps the
+        backing array but the captured view keeps the old one alive — the
+        same consistent-at-capture semantics as ``get_memory_block``.
+        Subclasses should override where a stable view is possible
+        (BytesBlock: the payload array; FileBackedBlock: a cached read-only
+        mmap): materializing a fresh buffer per fetch was the measured wall
+        of the peer-serving path (allocation + copy + page faults per
+        request, docs/PERF.md peer row)."""
         return None
 
 
@@ -197,6 +200,12 @@ class FileBackedBlock(Block):
 
     Counterpart of ``FileBackedMemoryBlock`` + the resolver's registered blocks that
     do positioned ``FileChannel.read`` (CommonUcxShuffleBlockResolver.scala:37-61).
+    Serving goes through a lazily created read-only ``np.memmap`` of the
+    segment (``memory_view``), so the peer server's vectored ``sendmsg``
+    transmits straight from the page cache — the mmap analogue of
+    ``UnsafeUtils.mmap`` (UnsafeUtils.scala:38-56), with no per-fetch read
+    or copy.  ``get_block`` stays a plain positioned read for callers that
+    want bytes in their own buffer.
     """
 
     def __init__(self, path: str, offset: int, length: int) -> None:
@@ -204,6 +213,7 @@ class FileBackedBlock(Block):
         self.path = path
         self.offset = int(offset)
         self.length = int(length)
+        self._mm: Optional[np.ndarray] = None
 
     def get_size(self) -> int:
         return self.length
@@ -214,3 +224,16 @@ class FileBackedBlock(Block):
             f.seek(self.offset)
             data = f.read(self.length)
         view[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+
+    def memory_view(self) -> Optional[np.ndarray]:
+        if self.length == 0:
+            return np.empty(0, dtype=np.uint8)
+        if self._mm is None:
+            try:
+                self._mm = np.memmap(
+                    self.path, dtype=np.uint8, mode="r",
+                    offset=self.offset, shape=(self.length,),
+                )
+            except (OSError, ValueError):
+                return None  # unmappable (e.g. pipe): materialize instead
+        return self._mm

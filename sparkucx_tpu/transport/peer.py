@@ -176,12 +176,14 @@ class BlockServer:
     def _resolve_one(self, bid: ShuffleBlockId):
         """Resolve to a ``(buffer, offset, length)`` view or None.
 
-        Memory-backed registry blocks serve their stable ``memory_view``
-        zero-copy (materializing a fresh buffer per fetch — alloc + copy +
-        page faults — was the measured wall of this path); file-backed ones
-        materialize under the block lock.  Store blocks serve a zero-copy
-        view of host staging.  Either way the reply path sends the view
-        without another copy."""
+        Registry blocks serve their stable ``memory_view`` zero-copy —
+        memory-backed blocks hand back their payload array, file-backed ones
+        a cached read-only mmap of the segment (materializing a fresh buffer
+        per fetch — alloc + copy + page faults — was the measured wall of
+        this path); only blocks with no mappable view (``memory_view() is
+        None``) materialize under the block lock.  Store blocks serve a
+        zero-copy view of host staging.  Either way the reply path sends the
+        view without another copy."""
         if self.registry_lookup is not None:
             blk = self.registry_lookup(bid)
             if blk is not None:

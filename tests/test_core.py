@@ -78,6 +78,21 @@ class TestBlocks:
         out = np.zeros(5, dtype=np.uint8)
         blk.get_block(out)
         assert out.tobytes() == b"23456"
+        # zero-copy serving view: a read-only mmap of just the segment,
+        # created once (the peer server sends straight from the page cache)
+        view = blk.memory_view()
+        assert view.tobytes() == b"23456" and not view.flags.writeable
+        assert blk.memory_view() is view  # cached, not re-mapped per fetch
+
+    def test_file_backed_block_arbitrary_offset_and_empty(self, tmp_path):
+        p = tmp_path / "odd.bin"
+        payload = bytes(range(256)) * 40
+        p.write_bytes(payload)
+        # offsets far from any page boundary must still map correctly
+        blk = FileBackedBlock(str(p), offset=4097, length=300)
+        assert blk.memory_view().tobytes() == payload[4097 : 4097 + 300]
+        empty = FileBackedBlock(str(p), offset=8, length=0)
+        assert empty.memory_view().size == 0
 
 
 class TestRequest:
