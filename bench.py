@@ -69,7 +69,10 @@ PROBE_RESERVE = float(os.environ.get("BENCH_PROBE_RESERVE", "420"))
 # optional hard cap on probe attempts (0 = keep going until the reserve);
 # lets an operator fail fast without waiting out the deadline budget
 PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "0"))
-DEADLINE = float(os.environ.get("BENCH_DEADLINE", "1200"))
+# 900 s: ~8 min of probe retries before the measurement reserve — deep
+# enough to ride out short tunnel flaps, conservative enough to emit the
+# JSON line before any outer harness timeout could cut the process down
+DEADLINE = float(os.environ.get("BENCH_DEADLINE", "900"))
 SKIP_SUBMETRICS = os.environ.get("BENCH_SKIP_SUBMETRICS", "") == "1"
 
 RESULT = {
