@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# One-shot hardware measurement session — runs the full deferred on-chip
+# queue the moment the axon tunnel answers, appending everything to a log so
+# a brief tunnel window still captures a complete record.
+#
+# Queue (docs/PERF.md "Not yet measured on hardware"):
+#   1. bench.py           — headline + groupby/partial/radix sub-metrics
+#   2. profile_sort.py    — sort-lowering head-to-head incl. the radix kernel
+#   3. benchmark sort --sort-impl radix   — the A/B at CLI scale
+#   4. benchmark groupby [--partial] / join --join-type ... / sort --batches
+#   5. tpu_smoke.py       — the 8 oracle drives on the real chip
+#
+# Usage:  bash scripts/hw_session.sh [logfile]   (default: hw_session_r5.log)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+LOG="${1:-hw_session_r5.log}"
+
+say() { echo "== $* ==" | tee -a "$LOG"; }
+run() {  # run <timeout-s> <label> <cmd...>; failures are logged, not fatal
+  local t="$1" label="$2"; shift 2
+  say "$label ($(date -u +%H:%M:%SZ))"
+  timeout "$t" "$@" >>"$LOG" 2>&1
+  echo "-- rc=$? $label" | tee -a "$LOG"
+}
+
+say "probe"
+if ! timeout 60 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" >>"$LOG" 2>&1; then
+  say "tunnel DOWN — nothing captured"
+  exit 1
+fi
+
+run 900 "bench.py (headline + sub-metrics)" python bench.py
+run 600 "profile_sort (incl. radix head-to-head)" python scripts/profile_sort.py
+run 600 "sort radix A/B" python -m sparkucx_tpu.perf.benchmark sort \
+  --executors 1 -n 2097152 -i 3 -o 8 --sort-impl radix
+run 600 "groupby" python -m sparkucx_tpu.perf.benchmark groupby \
+  --executors 1 -n 2097152 -i 3 --keys 100
+run 600 "groupby --partial" python -m sparkucx_tpu.perf.benchmark groupby \
+  --executors 1 -n 2097152 -i 3 --keys 100 --partial
+run 600 "join inner" python -m sparkucx_tpu.perf.benchmark join \
+  --executors 1 -n 2097152 --build-rows 524288 -i 3
+run 600 "join full_outer" python -m sparkucx_tpu.perf.benchmark join \
+  --executors 1 -n 2097152 --build-rows 524288 -i 3 --join-type full_outer
+run 900 "sort --batches 4 (out-of-core)" python -m sparkucx_tpu.perf.benchmark sort \
+  --executors 1 -n 4194304 --batches 4 -i 2
+run 600 "tpu_smoke (8 drives on chip)" python scripts/tpu_smoke.py
+say "session complete — results in $LOG"
