@@ -33,6 +33,11 @@ run 900 "bench.py (headline + sub-metrics)" python bench.py
 run 600 "profile_sort (incl. radix head-to-head)" python scripts/profile_sort.py
 run 600 "sort radix A/B" python -m sparkucx_tpu.perf.benchmark sort \
   --executors 1 -n 2097152 -i 3 -o 8 --sort-impl radix
+for tile in 4096 16384; do  # tile sweep: DMA segment size vs VMEM/search width
+  run 600 "sort radix tile=$tile" env SPARKUCX_RADIX_TILE="$tile" \
+    python -m sparkucx_tpu.perf.benchmark sort \
+    --executors 1 -n 2097152 -i 2 -o 8 --sort-impl radix
+done
 run 600 "groupby" python -m sparkucx_tpu.perf.benchmark groupby \
   --executors 1 -n 2097152 -i 3 --keys 100
 run 600 "groupby --partial" python -m sparkucx_tpu.perf.benchmark groupby \

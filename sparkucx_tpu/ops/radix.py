@@ -52,6 +52,7 @@ TPU target to pin Mosaic compatibility without a chip.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -67,7 +68,31 @@ BITS = 4
 NUM_BUCKETS = 1 << BITS
 NUM_PASSES = 32 // BITS
 
-DEFAULT_TILE_ROWS = 8192
+def _default_tile_rows() -> int:
+    """Rows per kernel tile, overridable via SPARKUCX_RADIX_TILE for on-chip
+    tuning sweeps (scripts/hw_session.sh) — the trade is DMA segment size
+    (tile/16 rows per bucket) vs VMEM footprint and per-tile search width.
+    A malformed or out-of-range value must not torch a scarce hardware
+    window with an import-time traceback: warn and fall back to 8192."""
+    raw = os.environ.get("SPARKUCX_RADIX_TILE")
+    if raw is None:
+        return 8192
+    try:
+        val = int(raw)
+    except ValueError:
+        val = -1
+    if val < 8 or val % 8:
+        import warnings
+
+        warnings.warn(
+            f"SPARKUCX_RADIX_TILE={raw!r} is not a multiple of 8 >= 8; "
+            "using the 8192 default"
+        )
+        return 8192
+    return val
+
+
+DEFAULT_TILE_ROWS = _default_tile_rows()
 
 
 def _cumsum_lanes(x: jnp.ndarray) -> jnp.ndarray:
