@@ -388,6 +388,12 @@ def main():
             # sort_radix_error while the argsort number stands.
             if budget_left() < 120:
                 raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            if platform != "tpu":
+                # ops/sort.py falls back to the Pallas interpreter off-TPU
+                # (fine for the unit suite's tiny shapes, hours at 2M rows) —
+                # an honest skip beats the watchdog truncating every
+                # sub-metric queued behind this one
+                raise RuntimeError(f"skipped: radix interprets on {platform}")
             RESULT["sort_radix_mrows_s"] = round(
                 measure_sort(1, 1 << 21, REPEATS, sort_impl="radix"), 3
             )
@@ -434,6 +440,23 @@ def main():
                 RESULT["groupby_wire_reduction"] = round(gb_rows / wire_p[0], 1)
         except Exception as e:
             RESULT["groupby_partial_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            # Multi-round (spilled) shuffle with host staging in the loop, at
+            # pipeline depths 1/2/3 (transport/pipeline.py): depth 1 is the
+            # serial engine, deeper rings overlap H2D staging, the collective,
+            # and the D2H drain.  Through the chip tunnel the D2H leg
+            # dominates, which is exactly the latency the ring hides — the
+            # depth-2/depth-1 ratio is the tentpole's headline.
+            if budget_left() < 120:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            from sparkucx_tpu.perf.benchmark import measure_pipeline
+
+            pl = measure_pipeline(1, 8 << 20, 6, REPEATS)
+            RESULT["pipeline"] = {f"depth{d}": round(v, 3) for d, v in pl.items()}
+            if pl.get(1) and pl.get(2):
+                RESULT["pipeline_overlap_speedup"] = round(pl[2] / pl[1], 3)
+        except Exception as e:
+            RESULT["pipeline_error"] = f"{type(e).__name__}: {e}"[:200]
 
     emit_once()
 

@@ -36,14 +36,16 @@ def groupby(mesh, n: int) -> None:
     from sparkucx_tpu.config import TpuShuffleConf
 
     total, num_keys = 20_000, 100
-    partial = TpuShuffleConf().partial_aggregation
+    conf = TpuShuffleConf()
     rng = np.random.default_rng(5)
     keys = rng.integers(0, num_keys, size=total).astype(np.uint32)
     values = rng.integers(0, 1000, size=(total, 2)).astype(np.int32)
-    spec = AggregateSpec(
+    spec = AggregateSpec.from_conf(
+        conf,
         num_executors=n, capacity=-(-total // n), recv_capacity=4 * -(-total // n),
-        aggs=("sum", "max"), partial=partial,
+        aggs=("sum", "max"),
     )
+    partial = spec.partial
     gk, gv, gc = run_grouped_aggregate(mesh, spec, keys, values)
     wk, wv, wc = oracle_aggregate(keys, values, spec.aggs)
     assert np.array_equal(gk, wk) and np.array_equal(gv, wv) and np.array_equal(gc, wc)

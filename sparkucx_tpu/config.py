@@ -145,13 +145,24 @@ class TpuShuffleConf:
     #: Ragged block-gather lowering: 'auto' (pipelined DMA kernel on TPU, XLA
     #: gather elsewhere) | 'dma' | 'tiled' | 'xla'.
     gather_impl: str = "auto"
-    #: Map-side partial aggregation below the exchange for GROUP BY jobs
-    #: (AggregateSpec.partial) — Spark's HashAggregateExec(partial) under the
-    #: ShuffleExchange, on by default exactly as in Spark.  Shrinks exchange
-    #: traffic by the group-reduction factor and bounds hot-key skew to one
-    #: partial row per (sender, key); disable to force the raw-row exchange
+    #: Map-side partial aggregation below the exchange for GROUP BY jobs —
+    #: Spark's HashAggregateExec(partial) under the ShuffleExchange, on by
+    #: default exactly as in Spark.  Consumed by ``AggregateSpec.from_conf``
+    #: (ops/relational.py), which defaults ``AggregateSpec.partial`` to this
+    #: value; specs built directly ignore the conf.  Shrinks exchange traffic
+    #: by the group-reduction factor and bounds hot-key skew to one partial
+    #: row per (sender, key); disable to force the raw-row exchange
     #: (count_distinct plans do so automatically — partials don't compose).
     partial_aggregation: bool = True
+
+    #: Superstep pipelining across spill rounds: how many rounds may be in
+    #: flight at once in the multi-round exchange (transport/tpu.py /
+    #: transport/spmd.py).  At depth d, round k's collective overlaps round
+    #: k+1's host assembly + H2D staging and round k-1's D2H drain, at the
+    #: cost of (d-1) extra in-flight receive buffers of HBM/RAM.  1 = the
+    #: strictly serial engine (bit-identical results either way; the pipeline
+    #: only reorders WHEN stages run, never what they compute).
+    pipeline_depth: int = 2
 
     # instrumentation
     collect_stats: bool = True
@@ -216,6 +227,7 @@ class TpuShuffleConf:
             ("spillDir", "spill_dir", str),
             ("spillDiskCap", "spill_disk_cap_bytes", parse_size),
             ("reduceMemoryBudget", "reduce_memory_budget", parse_size),
+            ("pipelineDepth", "pipeline_depth", int),
         ]:
             v = get(name)
             if v is not None:
@@ -243,6 +255,8 @@ class TpuShuffleConf:
             raise ValueError("num_slices must be positive")
         if self.num_slices > 1 and self.num_executors % self.num_slices:
             raise ValueError("num_executors must be divisible by num_slices")
+        if self.pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1 (1 = serial engine)")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

@@ -19,6 +19,7 @@ Differences by design (TPU-first):
 from __future__ import annotations
 
 import struct
+import sys
 import threading
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
@@ -170,6 +171,12 @@ class Block(ABC):
         request, docs/PERF.md peer row)."""
         return None
 
+    def close(self) -> None:
+        """Release resources held for serving (mappings, fds).  Called by the
+        transports on block unregistration / shuffle removal; must be safe to
+        call more than once, and the block must still be servable afterwards
+        (a later ``memory_view``/``get_block`` may recreate the resource)."""
+
 
 class BytesBlock(Block):
     """A block backed by an in-memory byte buffer (test/loopback helper)."""
@@ -237,3 +244,23 @@ class FileBackedBlock(Block):
             except (OSError, ValueError):
                 return None  # unmappable (e.g. pipe): materialize instead
         return self._mm
+
+    def close(self) -> None:
+        """Drop the cached mapping so its fd and pages are released now, not
+        never — without this every served spill segment pins an open fd for
+        the life of the process (the leak: unregistration never dropped
+        ``self._mm``).  The map is unmapped eagerly only when this block holds
+        the sole reference; numpy 2.x lets ``mmap.close()`` succeed with live
+        views, so closing under an in-flight fetch would turn its captured
+        view into a use-after-unmap.  With views outstanding the reference is
+        merely dropped and CPython refcounting closes the fd the moment the
+        last view dies.  A later ``memory_view`` simply remaps."""
+        with self.lock:
+            mm, self._mm = self._mm, None
+            if mm is None or not isinstance(mm, np.memmap):
+                return
+            if sys.getrefcount(mm) == 2:  # only `mm` + getrefcount's argument
+                try:
+                    mm._mmap.close()
+                except (AttributeError, BufferError):
+                    pass  # numpy internals moved / exporter alive: defer to GC

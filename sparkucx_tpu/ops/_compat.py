@@ -1,0 +1,101 @@
+"""JAX version-compat resolver for the ops layer.
+
+One place where API moves between pinned JAX versions are absorbed:
+
+* ``shard_map`` — jax >= 0.6 exports ``jax.shard_map`` with the replication
+  check spelled ``check_vma``; jax 0.4.x-0.5.x ships it as
+  ``jax.experimental.shard_map.shard_map`` with the same semantics spelled
+  ``check_rep``.  Eight call sites
+  (exchange/hierarchy/relational/sort/columnar/tc) bind through here.
+* ``ragged_all_to_all`` — absent before jax 0.5; ``HAS_RAGGED_ALL_TO_ALL``
+  lets callers (and tests) gate the ragged lowering, and the fallback binding
+  raises a targeted error instead of an AttributeError mid-trace.
+* ``tpu_compiler_params`` — Pallas renamed ``pltpu.TPUCompilerParams`` to
+  ``pltpu.CompilerParams`` and grew fields (``has_side_effects``); the helper
+  builds whichever class exists, dropping kwargs the old dataclass lacks.
+* ``enable_cpu_cross_process_collectives`` — multi-process CPU runs need the
+  gloo cross-process collectives backend selected before the backend client
+  exists; older jaxlibs otherwise fail with "Multiprocess computations aren't
+  implemented on the CPU backend".
+
+The resolver is computed once at import (CI runs it under the pinned JAX so a
+future API break fails fast at the import step, not deep inside a trace).
+``SHARD_MAP_SOURCE`` records which spelling was bound — surfaced by the CI
+compat step and useful in bug reports.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+    SHARD_MAP_SOURCE = "jax.shard_map"
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    SHARD_MAP_SOURCE = "jax.experimental.shard_map.shard_map"
+
+#: the replication-check kwarg was renamed check_rep -> check_vma; bind to
+#: whichever this JAX accepts (signature-inspected, not version-sniffed)
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map_impl).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across JAX versions (kwarg-for-kwarg the modern API)."""
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **{_CHECK_KW: check_vma}
+    )
+
+
+#: True when this JAX can trace the ragged collective at all (added in 0.5).
+HAS_RAGGED_ALL_TO_ALL = hasattr(jax.lax, "ragged_all_to_all")
+
+if HAS_RAGGED_ALL_TO_ALL:
+    ragged_all_to_all = jax.lax.ragged_all_to_all
+else:
+
+    def ragged_all_to_all(
+        operand, output, input_offsets, send_sizes, output_offsets, recv_sizes, *, axis_name
+    ):
+        raise NotImplementedError(
+            f"jax.lax.ragged_all_to_all is not available in jax {jax.__version__} "
+            "(added in 0.5); the ragged exchange lowering cannot trace here — "
+            "use impl='dense' (what resolve_impl picks on CPU) or upgrade jax"
+        )
+
+
+def tpu_compiler_params(**kwargs):
+    """Build ``pltpu.CompilerParams`` (``TPUCompilerParams`` before the rename).
+
+    Fields the running version's dataclass lacks (e.g. ``has_side_effects`` on
+    jax 0.4.x) are dropped: they are advisory compiler hints, and every kernel
+    here consumes its outputs so DCE protection is not load-bearing.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    accepted = inspect.signature(cls.__init__).parameters
+    return cls(**{k: v for k, v in kwargs.items() if k in accepted})
+
+
+def enable_cpu_cross_process_collectives() -> bool:
+    """Select the gloo cross-process collectives backend for the CPU client.
+
+    Must run before the CPU backend client is created (i.e. before
+    ``jax.distributed.initialize`` triggers backend init).  Without it, older
+    jaxlibs reject multi-process CPU programs outright.  Returns False when
+    this JAX has no such knob (in which case multi-process CPU either works
+    natively or is genuinely unsupported).
+    """
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        return False
+    return True

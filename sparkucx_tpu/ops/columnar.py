@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkucx_tpu.ops._compat import ragged_all_to_all, shard_map
 from sparkucx_tpu.ops.exchange import exclusive_cumsum, gather_rows, ragged_params
 
 
@@ -89,7 +90,7 @@ def _sort_and_sizes(spec: ColumnarSpec, rows: jnp.ndarray, owners: jnp.ndarray):
 def columnar_shard_ragged(spec: ColumnarSpec, payload, send_sizes, recv_sizes, output_offsets):
     input_offsets = exclusive_cumsum(send_sizes)
     out = jnp.zeros((spec.recv_capacity, payload.shape[1]), dtype=payload.dtype)
-    out = jax.lax.ragged_all_to_all(
+    out = ragged_all_to_all(
         payload,
         out,
         input_offsets.astype(jnp.int32),
@@ -157,7 +158,7 @@ def build_columnar_shuffle(mesh: Mesh, spec: ColumnarSpec):
     spec = spec.resolve_impl(platform=mesh.devices.reshape(-1)[0].platform)
     ax = spec.axis_name
 
-    shard = jax.shard_map(
+    shard = shard_map(
         functools.partial(columnar_body, spec),
         mesh=mesh,
         in_specs=(P(ax, None), P(ax)),

@@ -58,6 +58,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkucx_tpu.ops._compat import ragged_all_to_all, shard_map
+
 
 def exclusive_cumsum(x, axis: int = -1, xp=jnp):
     return xp.cumsum(x, axis=axis) - x
@@ -203,7 +205,7 @@ def _exchange_shard_ragged(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.
         sizes, me, spec.slot_rows
     )
     out = jnp.zeros((spec.recv_rows, spec.lane), dtype=data.dtype)
-    out = jax.lax.ragged_all_to_all(
+    out = ragged_all_to_all(
         data,
         out,
         input_offsets,
@@ -300,7 +302,7 @@ def build_exchange(mesh: Mesh, spec: ExchangeSpec):
     ax = spec.axis_name
     body = _exchange_shard_ragged if spec.impl == "ragged" else _exchange_shard_dense
 
-    shard = jax.shard_map(
+    shard = shard_map(
         functools.partial(body, spec),
         mesh=mesh,
         in_specs=(P(ax, None), P(ax, None)),
@@ -310,7 +312,12 @@ def build_exchange(mesh: Mesh, spec: ExchangeSpec):
     data_sharding = NamedSharding(mesh, P(ax, None))
     sizes_sharding = NamedSharding(mesh, P(ax, None))
     # Donating the staging buffer halves peak HBM when the recv buffer can alias
-    # it (same shape/dtype); XLA can't alias mismatched sizes, so only donate then.
+    # it (same shape/dtype); XLA can't alias mismatched sizes, so only donate
+    # then.  This is what lets the pipelined multi-round engine
+    # (transport/pipeline.py) run a ring of in-flight rounds without
+    # accumulating one extra staging buffer per round: each round's staging
+    # HBM is recycled into its own receive buffer.  The size matrix (argnum 1)
+    # is NEVER donated — callers chain exchanges reusing one sizes array.
     donate = (0,) if spec.send_rows == spec.recv_rows else ()
     fn = jax.jit(
         shard,
@@ -325,6 +332,53 @@ def build_exchange(mesh: Mesh, spec: ExchangeSpec):
 # ----------------------------------------------------------------------------
 # Host-side planning helpers (used by the writer/transport and by tests)
 # ----------------------------------------------------------------------------
+
+
+def bucket_send_rows(send_rows: int, num_executors: int) -> int:
+    """Capacity bucketing for the compiled-exchange cache: round the per-peer
+    slot capacity up to the next power of two and rescale to a full staging
+    size.
+
+    Shuffles of varying size then share one compiled executable per bucket
+    (the transports key ``_exchange_cache`` on the bucketed value and zero-pad
+    payloads up to it) instead of recompiling per distinct ``send_rows`` —
+    the same trick ``_gather_fn`` plays with request sizes.  The result is
+    always a ``num_executors`` multiple, so the slot layout invariant
+    (``send_rows % n == 0``) survives bucketing; padding rows carry zero
+    sizes and never cross the wire under the ragged lowering."""
+    if send_rows <= 0:
+        raise ValueError("send_rows must be positive")
+    slot = -(-send_rows // num_executors)  # ceil: tolerate non-multiples
+    bucket = 1
+    while bucket < slot:
+        bucket <<= 1
+    return bucket * num_executors
+
+
+def rebucket_slots(payload, num_executors: int, bucketed_rows: int, *, xp=np):
+    """Relocate a ``(send_rows, lane)`` slot-layout staging payload into a
+    ``(bucketed_rows, lane)`` buffer for a bucketed exchange.
+
+    Padding must be inserted PER SLOT, not appended at the tail: the exchange
+    reads peer j's chunk at row ``j * slot_rows`` with ``slot_rows`` derived
+    from the (bucketed) capacity, so each region has to move to its new slot
+    origin.  Zero rows fill the grown slot tails; the size matrix still counts
+    only used rows, so under the ragged lowering the padding never crosses the
+    wire.  ``xp`` selects the array namespace: ``np`` relocates host-side,
+    ``jnp`` on a committed device array relocates on that device (no host
+    round-trip for device-sealed payloads)."""
+    rows, lane = payload.shape
+    if rows == bucketed_rows:
+        return payload
+    n = num_executors
+    if rows % n or bucketed_rows % n or bucketed_rows < rows:
+        raise ValueError(
+            f"cannot rebucket {rows} rows to {bucketed_rows} over {n} executors "
+            "(both must be executor multiples, and buckets only grow)"
+        )
+    grid = payload.reshape(n, rows // n, lane)
+    padded = xp.pad(grid, ((0, 0), (0, (bucketed_rows - rows) // n), (0, 0)))
+    return padded.reshape(bucketed_rows, lane)
 
 
 def pack_chunks_slots(

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkucx_tpu.ops._compat import shard_map
 from sparkucx_tpu.ops.exchange import ExchangeSpec, exclusive_cumsum
 
 
@@ -137,7 +138,7 @@ def build_hierarchical_exchange(mesh: Mesh, spec: ExchangeSpec):
         )
     spec.validate()
 
-    shard = jax.shard_map(
+    shard = shard_map(
         functools.partial(_hier_shard, spec, num_slices, chips),
         mesh=mesh,
         in_specs=(P(("dcn", "ici"), None), P(("dcn", "ici"), None)),

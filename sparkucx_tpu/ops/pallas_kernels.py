@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparkucx_tpu.ops._compat import tpu_compiler_params
+
 # Pipelining depth of the dynamic-DMA path: how many block copies may be in
 # flight at once (the numIoThreads analogue, UcxShuffleConf.scala:66-71).
 DMA_PIPELINE_DEPTH = 8
@@ -166,7 +168,7 @@ def _pallas_gather(kernel, interpret: bool, out_rows: int, starts, counts, outs,
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
             scratch_shapes=[sem_shape],
         ),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=tpu_compiler_params(has_side_effects=True),
         interpret=interpret,
     )(starts, counts, outs, src)
     return out[:out_rows]

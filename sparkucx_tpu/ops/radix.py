@@ -59,6 +59,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparkucx_tpu.ops._compat import tpu_compiler_params
+
 #: Digit width per pass.  4 bits = 16 buckets x 8 passes: the widest digit
 #: whose per-(tile, bucket) DMA segments stay large (tile_rows/16 rows) while
 #: the flat cumsum/search band (B * tile_rows lanes) stays a few hundred KiB
@@ -293,9 +295,17 @@ def _radix_pass(rows: jnp.ndarray, shift: int, tile_rows: int, interpret: bool):
                 pltpu.SemaphoreType.DMA((NUM_BUCKETS,)),
             ],
         ),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=tpu_compiler_params(has_side_effects=True),
         interpret=interpret,
     )(dests, rows)
+
+
+def clamped_tile_rows(tile_rows: int, n: int) -> int:
+    """Shrink an oversized tile toward ``n`` while staying a sublane (8-row)
+    multiple — ``min(tile_rows, n)`` alone can produce a tile (e.g. 1001) that
+    the module's own SPARKUCX_RADIX_TILE validation would reject and whose
+    sublane layout Mosaic can't express."""
+    return min(tile_rows, -(-max(8, n) // 8) * 8)
 
 
 def radix_sort_rows(
@@ -313,7 +323,7 @@ def radix_sort_rows(
     and appended padding stays behind equal-keyed real rows.
     """
     n = rows.shape[0]
-    tile_rows = min(tile_rows, max(8, n))
+    tile_rows = clamped_tile_rows(tile_rows, n)
     padded = -(-n // tile_rows) * tile_rows
     if padded != n:
         # KEY_MAX pad keys must be BITCAST into the row dtype — a value cast

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkucx_tpu.ops._compat import shard_map
 from sparkucx_tpu.ops.columnar import (
     ColumnarSpec,
     columnar_body,
@@ -181,6 +182,22 @@ class AggregateSpec:
     @property
     def width(self) -> int:
         return len(self.aggs)
+
+    @classmethod
+    def from_conf(cls, conf, **kwargs) -> "AggregateSpec":
+        """Build a spec with cluster-level defaults taken from a
+        ``TpuShuffleConf``: ``partial`` from ``conf.partial_aggregation`` (the
+        ``partialAggregation`` Spark key — this is where that knob enters the
+        plan), ``num_executors``/``axis_name`` from the conf unless given.
+        Explicit kwargs always win.  count_distinct plans default to
+        ``partial=False`` regardless of the conf (distinct counts do not
+        compose by sum — validate() would reject the combination)."""
+        if "count_distinct" in kwargs.get("aggs", ()):
+            kwargs.setdefault("partial", False)
+        kwargs.setdefault("partial", bool(conf.partial_aggregation))
+        kwargs.setdefault("num_executors", conf.num_executors)
+        kwargs.setdefault("axis_name", conf.mesh_axis_name)
+        return cls(**kwargs)
 
     def resolve_impl(self, platform: Optional[str] = None) -> "AggregateSpec":
         if self.impl != "auto":
@@ -382,7 +399,7 @@ def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
     spec.validate()
     ax = spec.axis_name
 
-    shard = jax.shard_map(
+    shard = shard_map(
         functools.partial(_aggregate_body, spec),
         mesh=mesh,
         in_specs=((P(ax), P(ax, None), P(ax)) + ((P(ax),) if spec.with_filter else ())),
@@ -644,7 +661,7 @@ def build_hash_join(mesh: Mesh, spec: JoinSpec):
 
     extra_in = (P(ax), P(ax)) if spec.with_filters else ()
     extra_out = (P(ax),) if spec.join_type in OUTER_JOIN_TYPES else ()
-    shard = jax.shard_map(
+    shard = shard_map(
         functools.partial(_join_body, spec),
         mesh=mesh,
         in_specs=(P(ax), P(ax, None), P(ax)) * 2 + extra_in,

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkucx_tpu.ops._compat import shard_map
 from sparkucx_tpu.ops.columnar import (
     ColumnarSpec,
     columnar_shard_dense,
@@ -266,7 +267,7 @@ def build_distributed_sort(mesh: Mesh, spec: SortSpec):
         body = functools.partial(_sort_body_radix, interpret=interpret)
     else:
         body = _sort_body_single if spec.impl == "single" else _sort_body
-    shard = jax.shard_map(
+    shard = shard_map(
         functools.partial(body, spec),
         mesh=mesh,
         in_specs=(P(ax), P(ax, None), P(ax)),

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkucx_tpu.ops._compat import shard_map
 from sparkucx_tpu.ops.columnar import ColumnarSpec
 from sparkucx_tpu.ops.relational import exchange_keyed_rows, expand_matches, padded_keys
 from sparkucx_tpu.ops.sort import KEY_MAX
@@ -192,7 +193,7 @@ def build_tc_prep(mesh: Mesh, spec: TcSpec):
     outputs to every ``build_tc_step`` call."""
     spec = _resolve(mesh, spec)
     ax = spec.axis_name
-    shard = jax.shard_map(
+    shard = shard_map(
         functools.partial(_tc_prep_body, spec),
         mesh=mesh,
         in_specs=(P(ax), P(ax), P(ax)),
@@ -228,7 +229,7 @@ def build_tc_step(mesh: Mesh, spec: TcSpec):
     spec = _resolve(mesh, spec)
     ax = spec.axis_name
 
-    shard = jax.shard_map(
+    shard = shard_map(
         functools.partial(_tc_step_body, spec),
         mesh=mesh,
         in_specs=(P(ax), P(ax), P(ax)) * 2,

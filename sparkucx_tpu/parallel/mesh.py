@@ -128,6 +128,10 @@ def init_distributed(
     import jax
 
     if jax.process_count() == 1 and (coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")):
+        if (jax.config.jax_platforms or "").startswith("cpu"):
+            from sparkucx_tpu.ops._compat import enable_cpu_cross_process_collectives
+
+            enable_cpu_cross_process_collectives()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -27,6 +27,10 @@ Modes:
   (UcxPerfBenchmark.scala:100-154, bandwidth print :140-143).
 * ``superstep`` — the TPU-only mode with no reference counterpart: time the
   collective exchange on the local mesh (what bench.py wraps).
+* ``pipeline`` — multi-round (spilled) shuffle throughput with host staging in
+  the loop, at pipeline depths 1/2/3 (transport/pipeline.py): -n rounds of -s
+  bytes each through H2D -> collective -> D2H; depth 1 is the serial engine,
+  deeper rings overlap the three stages.  Prints GB/s per depth.
 * ``gather`` — time the device-side ragged block gather (ops/pallas_kernels.py),
   the reply-packing hot path (UcxWorkerWrapper.scala:397-448 analogue): -n
   blocks of -s bytes scattered through a source buffer, packed into one HBM
@@ -74,8 +78,8 @@ def _parse_args(argv):
     p.add_argument(
         "mode",
         choices=[
-            "server", "client", "superstep", "gather", "sort", "columnar",
-            "groupby", "join",
+            "server", "client", "superstep", "pipeline", "gather", "sort",
+            "columnar", "groupby", "join",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -124,6 +128,10 @@ def _parse_args(argv):
     p.add_argument(
         "--batches", type=int, default=1,
         help="device batches for the out-of-core sort driver (sort mode)",
+    )
+    p.add_argument(
+        "--depths", default="1,2,3",
+        help="comma-separated pipeline depths to compare (pipeline mode)",
     )
     return p.parse_args(argv)
 
@@ -255,6 +263,78 @@ def run_superstep(args) -> None:
         )
 
 
+def measure_pipeline(
+    executors: int, round_bytes: int, rounds: int, iterations: int,
+    depths=(1, 2, 3), report=None,
+) -> dict:
+    """Measurement core of the ``pipeline`` mode — multi-round (spilled)
+    shuffle throughput WITH host staging in the loop, at several pipeline
+    depths.  Unlike ``superstep`` (HBM-resident payloads chained K deep),
+    every round here pays the full H2D -> collective -> D2H path the spill
+    engine drives; depth d overlaps round k's collective with round k+1's
+    staging and round k-1's drain (transport/pipeline.py — the tentpole
+    overlap).  Returns ``{depth: best GB/s of payload moved}``;
+    ``report(depth, it, seconds, bytes)`` is called per iteration when given.
+    Shared by the CLI and bench.py."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.exchange import (
+        ExchangeSpec, bucket_send_rows, build_exchange, make_mesh,
+    )
+    from sparkucx_tpu.transport.pipeline import RoundPipeline
+
+    n = executors
+    rows_per_peer = max(1, round_bytes // (512 * n))
+    send_rows = bucket_send_rows(n * rows_per_peer, n)
+    spec = ExchangeSpec(
+        num_executors=n, send_rows=send_rows, recv_rows=send_rows, lane=128
+    )
+    mesh = make_mesh(n)
+    fn = build_exchange(mesh, spec)
+    sharding = NamedSharding(mesh, P("ex", None))
+    rng = np.random.default_rng(0)
+    host_rounds = [
+        rng.integers(-100, 100, size=(n * send_rows, 128), dtype=np.int32)
+        for _ in range(rounds)
+    ]
+    sizes = np.full((n, n), rows_per_peer, dtype=np.int32)
+    moved_per_round = n * n * rows_per_peer * 512
+    results = {}
+    for depth in depths:
+        size_mat = jax.device_put(sizes, sharding)  # never donated: hoist
+
+        def submit(rnd):
+            data = jax.device_put(host_rounds[rnd], sharding)  # H2D (async)
+            recv, _ = fn(data, size_mat)                       # collective
+            shards = [s.data for s in recv.addressable_shards]
+            for a in shards:
+                a.copy_to_host_async()                         # D2H kick-off
+            return shards
+
+        def drain(rnd, shards):
+            for a in shards:
+                np.asarray(a)  # observe completion: materialize host-side
+            return None
+
+        pipe = RoundPipeline(depth, submit, drain, name=f"bench.pipeline.d{depth}")
+        pipe.run(rounds)  # warmup: compile + first H2D/D2H
+        best = 0.0
+        for it in range(iterations):
+            t0 = time.perf_counter()
+            pipe.run(rounds)
+            dt = time.perf_counter() - t0
+            tot = moved_per_round * rounds
+            best = max(best, tot / dt / 1e9)
+            if report is not None:
+                report(depth, it, dt, tot)
+        results[depth] = best
+    return results
+
+
 def measure_gather(
     num_blocks: int,
     block_bytes: int,
@@ -304,6 +384,27 @@ def measure_gather(
         if report is not None:
             report(it, dt, tot, fn.impl)
     return best
+
+
+def run_pipeline(args) -> None:
+    size = parse_size(args.block_size)
+    depths = tuple(int(d) for d in args.depths.split(","))
+
+    def report(depth, it, dt, tot):
+        print(
+            f"depth {depth} iter {it}: {args.num_blocks} rounds x {size} B in "
+            f"{dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    results = measure_pipeline(
+        args.executors, size, args.num_blocks, args.iterations,
+        depths=depths, report=report,
+    )
+    base = results.get(1)
+    for depth, gbps in sorted(results.items()):
+        speedup = f" ({gbps / base:.2f}x vs serial)" if base and depth != 1 else ""
+        print(f"pipeline depth {depth}: {gbps:.2f} GB/s{speedup}", flush=True)
 
 
 def run_gather(args) -> None:
@@ -753,6 +854,8 @@ def main(argv=None) -> None:
         run_server(args)
     elif args.mode == "client":
         run_client(args)
+    elif args.mode == "pipeline":
+        run_pipeline(args)
     elif args.mode == "gather":
         run_gather(args)
     elif args.mode == "sort":

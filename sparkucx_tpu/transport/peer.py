@@ -655,15 +655,19 @@ class PeerTransport(ShuffleTransport):
 
     def unregister(self, block_id: BlockId) -> None:
         with self._registry_lock:
-            self._registry.pop(block_id, None)
+            block = self._registry.pop(block_id, None)
+        if block is not None:
+            block.close()  # release serving resources (cached mmaps) eagerly
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._registry_lock:
-            for b in [
+            doomed = [
                 b for b in self._registry
                 if isinstance(b, ShuffleBlockId) and b.shuffle_id == shuffle_id
-            ]:
-                del self._registry[b]
+            ]
+            blocks = [self._registry.pop(b) for b in doomed]
+        for block in blocks:
+            block.close()
         self.store.remove_shuffle(shuffle_id)
 
     def registered_block(self, block_id: BlockId) -> Optional[Block]:

@@ -1,0 +1,147 @@
+"""Pipelined multi-round superstep engine — overlap H2D, collective, and D2H.
+
+A spilled shuffle runs one collective per staging round.  The serial engine
+(the historical behavior) executed rounds strictly back-to-back::
+
+    assemble(k) -> device_put(k) -> collective(k) -> block_until_ready -> drain(k)
+
+so the ICI links idled while round k's shards crossed PCIe back to the host and
+round k+1's payload was still being assembled.  This module replaces the
+per-round hard sync with *completion tracking per in-flight round*: while round
+k's collective runs on device, round k+1 is assembled and staged H2D (JAX async
+dispatch), and round k-1's received shards drain D2H on a background worker —
+their ``copy_to_host_async`` was already issued at submit time, so the worker's
+``np.asarray`` mostly just observes completion.
+
+The engine is deliberately transport-agnostic: callers hand it two callbacks,
+
+* ``submit(round) -> ticket`` — assemble the round's payload, dispatch H2D and
+  the collective, kick off the async D2H, and return whatever the drain needs
+  (device arrays, typically).  Runs on the caller's thread, in round order.
+* ``drain(round, ticket) -> result`` — complete the round host-side (materialize
+  arrays, write spill memmaps, retain device shards).  Runs on the drain worker
+  for ``depth > 1``; inline for ``depth == 1``.
+
+``run(num_rounds)`` returns the drain results in round order.  ``depth`` bounds
+the in-flight window: at most ``depth`` rounds are submitted whose drains have
+not completed, so peak memory is ~``depth`` receive buffers (device) plus the
+transient host copies — the "ring of staging buffers".  ``depth == 1`` is the
+bit-for-bit serial engine: submit then drain inline, one round at a time.
+
+Failure contract: exceptions from either callback propagate out of ``run()``
+(submit errors first, then the earliest-round drain error), so callers see the
+same ``TransportError`` surface as the serial engine — a disk-cap overflow in a
+round's spill still raises from ``run_exchange``, it is just discovered up to
+``depth - 1`` rounds later.
+
+Observability: every stage is wrapped in a ``utils.trace`` span
+(``<name>.submit`` / ``<name>.drain``, tagged with the round and depth) and,
+when a ``StatsAggregator`` is given, recorded as an operation of the same kind
+— ``stats.summary("<name>.drain").total_ns`` over the run's wall time is the
+drain lane's occupancy.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional
+
+from sparkucx_tpu.core.operation import OperationStats
+from sparkucx_tpu.utils.stats import StatsAggregator
+from sparkucx_tpu.utils.trace import span
+
+
+class RoundPipeline:
+    """Run ``num_rounds`` submit/drain pairs with up to ``depth`` in flight."""
+
+    def __init__(
+        self,
+        depth: int,
+        submit: Callable[[int], Any],
+        drain: Callable[[int, Any], Any],
+        *,
+        name: str = "pipeline",
+        stats: Optional[StatsAggregator] = None,
+        result_bytes: Optional[Callable[[Any], int]] = None,
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._submit_cb = submit
+        self._drain_cb = drain
+        self.name = name
+        self.stats = stats
+        self._result_bytes = result_bytes
+
+    # -- instrumented stage wrappers --------------------------------------
+
+    def _submit(self, rnd: int) -> Any:
+        op = OperationStats()
+        with span(f"{self.name}.submit", round=rnd, depth=self.depth):
+            ticket = self._submit_cb(rnd)
+        op.mark_done()
+        if self.stats is not None:
+            self.stats.record(f"{self.name}.submit", op)
+        return ticket
+
+    def _drain(self, rnd: int, ticket: Any) -> Any:
+        op = OperationStats()
+        with span(f"{self.name}.drain", round=rnd, depth=self.depth):
+            result = self._drain_cb(rnd, ticket)
+        op.mark_done(
+            recv_size=self._result_bytes(result) if self._result_bytes else 0
+        )
+        if self.stats is not None:
+            self.stats.record(f"{self.name}.drain", op)
+        return result
+
+    # -- the engine --------------------------------------------------------
+
+    def run(self, num_rounds: int) -> List[Any]:
+        if num_rounds < 0:
+            raise ValueError(f"num_rounds must be >= 0, got {num_rounds}")
+        depth = min(self.depth, max(num_rounds, 1))
+        if depth <= 1:
+            # Serial engine: identical op order to the historical loop (and
+            # the reference both pipeline depths must be bit-identical to).
+            return [self._drain(rnd, self._submit(rnd)) for rnd in range(num_rounds)]
+        return self._run_pipelined(num_rounds, depth)
+
+    def _run_pipelined(self, num_rounds: int, depth: int) -> List[Any]:
+        results: List[Any] = [None] * num_rounds
+        inflight: deque = deque()  # (round, ticket) submitted, drain not queued
+        futures: List = []         # (round, Future) in round order
+        submit_exc: Optional[BaseException] = None
+        pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix=f"{self.name}-drain")
+        try:
+            for rnd in range(num_rounds):
+                # Backpressure: round k submits only once round k-depth has
+                # fully drained, so host+device memory stays bounded by the
+                # ring of `depth` rounds, not by the round count.  (During the
+                # loop futures[i] is exactly round i — rounds are handed to the
+                # worker in order.  result() is cached, so re-collecting below
+                # is free; a drain error here aborts further submission.)
+                if rnd >= depth:
+                    futures[rnd - depth][1].result()
+                inflight.append((rnd, self._submit(rnd)))
+                if len(inflight) >= depth:
+                    r0, t0 = inflight.popleft()
+                    futures.append((r0, pool.submit(self._drain, r0, t0)))
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            submit_exc = e
+        if submit_exc is None:
+            while inflight:
+                r0, t0 = inflight.popleft()
+                futures.append((r0, pool.submit(self._drain, r0, t0)))
+        pool.shutdown(wait=True)
+        exc = submit_exc
+        for r0, fut in futures:
+            try:
+                results[r0] = fut.result()
+            except BaseException as e:  # noqa: BLE001
+                if exc is None:
+                    exc = e  # earliest round's failure wins, like the serial loop
+        if exc is not None:
+            raise exc
+        return results

@@ -32,10 +32,17 @@ from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.definitions import MapperInfo
 from sparkucx_tpu.core.operation import TransportError
 from sparkucx_tpu.core.transport import ExecutorId
-from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange
+from sparkucx_tpu.ops.exchange import (
+    ExchangeSpec,
+    bucket_send_rows,
+    build_exchange,
+    rebucket_slots,
+)
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
 from sparkucx_tpu.transport.peer import PeerTransport
+from sparkucx_tpu.transport.pipeline import RoundPipeline
 from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.stats import StatsAggregator
 
 logger = get_logger("transport.spmd")
 
@@ -62,6 +69,12 @@ class SpmdShuffleExecutor:
             from jax._src import distributed as _dist
 
             if _dist.global_state.client is None:
+                if (jax.config.jax_platforms or "").startswith("cpu"):
+                    # CPU multi-controller (tests, dryruns) needs the gloo
+                    # collectives backend picked before the client exists.
+                    from sparkucx_tpu.ops._compat import enable_cpu_cross_process_collectives
+
+                    enable_cpu_cross_process_collectives()
                 jax.distributed.initialize(
                     coordinator_address, num_processes=num_processes, process_id=process_id
                 )
@@ -86,9 +99,12 @@ class SpmdShuffleExecutor:
         self._recv: Dict[int, Tuple[List[np.ndarray], List[np.ndarray]]] = {}
         self._meta: Dict[int, Tuple[int, int, List[Tuple[int, int]]]] = {}
         self._exchange_fns: Dict[int, object] = {}
-        #: memmap spill files per shuffle (host_recv_mode='memmap')
-        self._recv_spill: Dict[int, List[str]] = {}
+        #: memmap spill files per shuffle as (path, charged nbytes) —
+        #: host_recv_mode='memmap'; the refund uses the tracked charge
+        self._recv_spill: Dict[int, List[Tuple[str, int]]] = {}
         self._recv_spill_bytes = 0  # charged against conf.spill_disk_cap_bytes
+        #: per-stage pipeline timings (same occupancy view as the cluster's)
+        self.stats = StatsAggregator()
         if self.conf.host_recv_mode not in ("array", "memmap"):
             # fail at construction, not after round 0's collective has run on
             # every host: 'device' needs retained HBM shards this executor
@@ -158,12 +174,16 @@ class SpmdShuffleExecutor:
         n = self.num_executors
         ax = self.conf.mesh_axis_name
         send_rows, lane = int(rounds[0][0].shape[0]), int(rounds[0][0].shape[1])
+        # Capacity bucketing (same discipline as the cluster's _exchange_fn):
+        # varying-size shuffles share one compiled exchange per power-of-two
+        # slot bucket; payloads relocate into the bucketed slot layout below.
+        bucketed = bucket_send_rows(send_rows, n)
 
-        key = (send_rows, lane, self.conf.num_slices)
+        key = (bucketed, lane, self.conf.num_slices)
         fn = self._exchange_fns.get(key)
         if fn is None:
             spec = ExchangeSpec(
-                num_executors=n, send_rows=send_rows, recv_rows=send_rows,
+                num_executors=n, send_rows=bucketed, recv_rows=bucketed,
                 lane=lane, axis_name=ax,
             )
             if self.conf.num_slices > 1:
@@ -196,34 +216,58 @@ class SpmdShuffleExecutor:
         )
         num_rounds = int(np.max(jax.jit(lambda x: jnp.max(x), out_shardings=None)(rc)))
 
-        recv_shards, recv_sizes_rows = [], []
-        for rnd in range(num_rounds):
+        def _submit(rnd):
+            """Assemble + H2D + collective dispatch for one round (all JAX
+            async dispatch — SPMD order is preserved because every process
+            submits rounds in the same order, whatever the depth)."""
             if rnd < len(rounds):
                 payload, sizes = rounds[rnd]
+                payload = rebucket_slots(np.asarray(payload), n, bucketed)
             else:
-                payload = np.zeros((send_rows, lane), dtype=np.int32)
+                payload = np.zeros((bucketed, lane), dtype=np.int32)
                 sizes = np.zeros(n, dtype=np.int32)
-            local_payload = jax.device_put(np.asarray(payload), self.device)
+            local_payload = jax.device_put(payload, self.device)
             local_sizes = jax.device_put(sizes[None, :].astype(np.int32), self.device)
             data = jax.make_array_from_single_device_arrays(
-                (n * send_rows, lane), data_sharding, [local_payload]
+                (n * bucketed, lane), data_sharding, [local_payload]
             )
             size_mat = jax.make_array_from_single_device_arrays(
                 (n, n), sizes_sharding, [local_sizes]
             )
             recv, rs = fn(data, size_mat)
             my_recv = next(
-                np.asarray(s.data) for s in recv.addressable_shards if s.device == self.device
+                s.data for s in recv.addressable_shards if s.device == self.device
             )
             my_rs = next(
-                np.asarray(s.data) for s in rs.addressable_shards if s.device == self.device
+                s.data for s in rs.addressable_shards if s.device == self.device
             )
-            recv_shards.append(
-                self._host_shard(shuffle_id, rnd, my_recv.reshape(-1).view(np.uint8))
+            # start D2H of this process's shard while later rounds run
+            my_recv.copy_to_host_async()
+            my_rs.copy_to_host_async()
+            return my_recv, my_rs
+
+        def _drain(rnd, ticket):
+            """Host-side completion: materialize this process's shard and
+            apply host_recv_mode (memmap spill runs on the drain worker)."""
+            my_recv, my_rs = ticket
+            shard = self._host_shard(
+                shuffle_id, rnd, np.asarray(my_recv).reshape(-1).view(np.uint8)
             )
-            recv_sizes_rows.append(my_rs.reshape(-1))
+            return shard, np.asarray(my_rs).reshape(-1)
+
+        depth = max(1, int(self.conf.pipeline_depth))
+        pipe = RoundPipeline(
+            depth, _submit, _drain, name="exchange.pipeline", stats=self.stats,
+            result_bytes=lambda r: int(r[1].sum()) * self.conf.block_alignment,
+        )
+        results = pipe.run(num_rounds)
+        recv_shards = [shard for shard, _ in results]
+        recv_sizes_rows = [sizes for _, sizes in results]
         self._recv[shuffle_id] = (recv_shards, recv_sizes_rows)
-        logger.info("exchange done: shuffle=%d rounds=%d", shuffle_id, num_rounds)
+        logger.info(
+            "exchange done: shuffle=%d rounds=%d depth=%d",
+            shuffle_id, num_rounds, depth,
+        )
 
     # -- post-exchange reads ----------------------------------------------
 
@@ -300,7 +344,10 @@ class SpmdShuffleExecutor:
                 pass
             raise
         del mm, host  # drop the dirty mapping; reopen read-only (RSS falls)
-        self._recv_spill.setdefault(shuffle_id, []).append(path)
+        # track the CHARGED bytes with the path: the refund must mirror the
+        # charge, not os.path.getsize (block-size rounding / sparse files /
+        # truncation by an operator would drift _recv_spill_bytes permanently)
+        self._recv_spill.setdefault(shuffle_id, []).append((path, nbytes))
         return np.memmap(path, dtype=np.uint8, mode="r", shape=shape)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
@@ -310,12 +357,11 @@ class SpmdShuffleExecutor:
         self._mapper_infos.pop(shuffle_id, None)
         import os
 
-        for path in self._recv_spill.pop(shuffle_id, []):
+        for path, nbytes in self._recv_spill.pop(shuffle_id, []):
             try:
-                size = os.path.getsize(path)
                 os.unlink(path)
-                self._recv_spill_bytes -= size
+                self._recv_spill_bytes -= nbytes
             except FileNotFoundError:
-                pass  # already gone; its bytes were refunded or never written
+                self._recv_spill_bytes -= nbytes  # already gone: still refund
             except OSError:
                 pass  # still on disk: keep it charged

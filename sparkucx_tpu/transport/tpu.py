@@ -52,8 +52,16 @@ from sparkucx_tpu.core.operation import (
     TransportError,
 )
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
-from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
+from sparkucx_tpu.ops.exchange import (
+    ExchangeSpec,
+    bucket_send_rows,
+    build_exchange,
+    make_mesh,
+    rebucket_slots,
+)
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
+from sparkucx_tpu.transport.pipeline import RoundPipeline
+from sparkucx_tpu.utils.stats import StatsAggregator
 from sparkucx_tpu.utils.trace import instant, span
 
 
@@ -110,6 +118,8 @@ class TpuShuffleCluster:
         self._meta: Dict[int, _ShuffleMeta] = {}
         self._exchange_cache: Dict[Tuple[int, int, str], Callable] = {}
         self._lock = threading.RLock()
+        #: aggregate per-stage pipeline/exchange timings (occupancy view)
+        self.stats = StatsAggregator()
         #: bytes of received-shard spill currently on disk (host_recv_mode=
         #: 'memmap'), charged against conf.spill_disk_cap_bytes like the
         #: store's staging spill
@@ -135,9 +145,13 @@ class TpuShuffleCluster:
         num_mappers: int,
         num_reducers: int,
         map_owner: Optional[Sequence[ExecutorId]] = None,
+        capacity: Optional[int] = None,
     ) -> _ShuffleMeta:
         """Declare a shuffle cluster-wide: reducer ownership is contiguous ranges
-        over executors; map tasks are assigned round-robin unless given."""
+        over executors; map tasks are assigned round-robin unless given.
+        ``capacity`` overrides ``conf.staging_capacity_per_executor`` for this
+        shuffle only — right-sizing small shuffles; capacity bucketing in
+        ``_exchange_fn`` keeps nearby sizes on one compiled exchange."""
         n = self.num_executors
         owners = list(map_owner) if map_owner is not None else [m % n for m in range(num_mappers)]
         if len(owners) != num_mappers:
@@ -149,7 +163,9 @@ class TpuShuffleCluster:
                 raise TransportError(f"shuffle {shuffle_id} already exists")
             self._meta[shuffle_id] = meta
         for t in self.transports:
-            t.store.create_shuffle(shuffle_id, num_mappers, num_reducers, peer_ranges=ranges)
+            t.store.create_shuffle(
+                shuffle_id, num_mappers, num_reducers, peer_ranges=ranges, capacity=capacity
+            )
         return meta
 
     def remove_shuffle(self, shuffle_id: int) -> None:
@@ -193,6 +209,12 @@ class TpuShuffleCluster:
         return self.conf.block_alignment
 
     def _exchange_fn(self, send_rows: int):
+        # Capacity bucketing: round the per-peer slot up to the next power of
+        # two so shuffles of varying staging size share one compiled
+        # executable per bucket (the caller relocates payloads into the
+        # bucketed slot layout — rebucket_slots; padding rows carry zero sizes
+        # and never cross the wire under the ragged lowering).
+        send_rows = bucket_send_rows(send_rows, self.num_executors)
         key = (self.num_executors, send_rows, self.row_bytes, self.conf.num_slices)
         with self._lock:
             fn = self._exchange_cache.get(key)
@@ -256,62 +278,117 @@ class TpuShuffleCluster:
         first_payload = sealed[0][0][0]
         send_rows, lane = int(first_payload.shape[0]), int(first_payload.shape[1])
         fn = self._exchange_fn(send_rows)
+        bucketed = bucket_send_rows(send_rows, self.num_executors)
 
         ax = self.conf.mesh_axis_name
         n = self.num_executors
         data_sharding = NamedSharding(self.mesh, P(ax, None))
         devices = list(self.mesh.devices.reshape(-1))
-        meta.recv_shards, meta.recv_sizes = [], []
-        for rnd in range(num_rounds):
+        keep_device = self.conf.keep_device_recv
+
+        def _assemble(rnd):
+            """Stage round ``rnd``: gather per-executor payloads (zero
+            contribution for executors with fewer spill rounds), relocate into
+            the bucketed slot layout, and start the H2D transfer (async)."""
             payloads, size_rows = [], []
             for s in sealed:
                 if rnd < len(s):
                     payloads.append(s[rnd][0])
                     size_rows.append(s[rnd][1])
                 else:  # executor had fewer spill rounds: empty contribution
-                    payloads.append(np.zeros((send_rows, lane), dtype=np.int32))
+                    payloads.append(None)
                     size_rows.append(np.zeros(n, dtype=np.int32))
             if all(isinstance(p, jax.Array) for p in payloads):
                 # Shards were sealed straight onto their executors' devices —
-                # assemble the global array without any host round-trip.
+                # assemble the global array without any host round-trip (the
+                # slot relocation, if the bucket grew, runs on each device).
+                if bucketed != send_rows:
+                    import jax.numpy as jnp
+
+                    payloads = [rebucket_slots(p, n, bucketed, xp=jnp) for p in payloads]
                 data = jax.make_array_from_single_device_arrays(
-                    (n * send_rows, lane), data_sharding, payloads
+                    (n * bucketed, lane), data_sharding, payloads
                 )
             else:
-                data = jax.device_put(
-                    np.concatenate([np.asarray(p) for p in payloads]), data_sharding
-                )
+                host = np.zeros((n * bucketed, lane), dtype=np.int32)
+                for i, p in enumerate(payloads):
+                    if p is not None:
+                        host[i * bucketed : (i + 1) * bucketed] = rebucket_slots(
+                            np.asarray(p), n, bucketed
+                        )
+                data = jax.device_put(host, data_sharding)
             size_mat = jax.device_put(
                 np.stack(size_rows).astype(np.int32), NamedSharding(self.mesh, P(ax, None))
             )
-            with span("exchange.collective", shuffle_id=shuffle_id, round=rnd, rows=send_rows):
+            return data, size_mat
+
+        def _submit(rnd):
+            """H2D + collective dispatch + async D2H kick-off for one round.
+            Everything here is JAX async dispatch: round rnd's collective is
+            still in flight when round rnd+1 assembles."""
+            data, size_mat = _assemble(rnd)
+            with span("exchange.collective", shuffle_id=shuffle_id, round=rnd, rows=bucketed):
                 recv, recv_sizes = fn(data, size_mat)
-                jax.block_until_ready(recv)
+            # Pin the per-device shard objects HERE (addressable_shards builds
+            # fresh wrappers per call — reusing these keeps the async-copy
+            # cache) and start their D2H now, while later rounds keep the
+            # device busy; the drain's np.asarray then observes completion
+            # instead of initiating the copy.
             shard_by_device = {s.device: s.data for s in recv.addressable_shards}
+            if mode != "device":
+                for a in shard_by_device.values():
+                    a.copy_to_host_async()
+            recv_sizes.copy_to_host_async()
+            return recv, recv_sizes, shard_by_device
+
+        def _drain(rnd, ticket):
+            """Complete one round host-side (drain-worker thread at depth>1)."""
+            recv, recv_sizes, shard_by_device = ticket
+            sizes_host = np.asarray(recv_sizes)
             if mode == "device":
                 # No host copy at all: fetches slice the retained HBM shard
                 # and D2H only the requested block (locate_received_block).
-                pass
+                jax.block_until_ready(recv)
+                shards = None
             elif mode == "memmap":
                 # One D2H per shard, streamed straight into a disk-backed
                 # mapping; the round's RAM is released once pages flush, so
-                # host RSS stays bounded by ~one round however many rounds
-                # the shuffle spills (the store's own disk tier discipline).
+                # host RSS stays bounded by ~one in-flight window however many
+                # rounds the shuffle spills.
                 with span("exchange.d2h_memmap", shuffle_id=shuffle_id, round=rnd):
-                    meta.recv_shards.append(
-                        self._memmap_round(meta, rnd, shard_by_device, devices, n)
-                    )
+                    shards = self._memmap_round(meta, rnd, shard_by_device, devices, n)
             else:
                 # One D2H per executor shard; fetches then slice host memory.
                 with span("exchange.d2h", shuffle_id=shuffle_id, round=rnd):
-                    meta.recv_shards.append(
-                        [np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8) for j in range(n)]
-                    )
-            meta.recv_sizes.append(np.asarray(recv_sizes))
-            if self.conf.keep_device_recv:
+                    shards = [
+                        np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
+                        for j in range(n)
+                    ]
+            dev_shards = (
+                [shard_by_device[devices[j]] for j in range(n)] if keep_device else None
+            )
+            return shards, sizes_host, dev_shards
+
+        depth = max(1, int(self.conf.pipeline_depth))
+        pipe = RoundPipeline(
+            depth,
+            _submit,
+            _drain,
+            name="exchange.pipeline",
+            stats=self.stats,
+            result_bytes=lambda r: int(r[1].sum()) * self.row_bytes,
+        )
+        results = pipe.run(num_rounds)
+
+        meta.recv_shards, meta.recv_sizes = [], []
+        for shards, sizes_host, dev_shards in results:
+            if shards is not None:
+                meta.recv_shards.append(shards)
+            meta.recv_sizes.append(sizes_host)
+            if dev_shards is not None:
                 if meta.recv_device is None:
                     meta.recv_device = []
-                meta.recv_device.append([shard_by_device[devices[j]] for j in range(n)])
+                meta.recv_device.append(dev_shards)
         if mode == "device":
             meta.recv_shards = None  # explicit no-host-copy marker
         meta.exchanged = True
@@ -574,7 +651,9 @@ class TpuShuffleTransport(ShuffleTransport):
 
     def unregister(self, block_id: BlockId) -> None:
         with self._registry_lock:
-            self._registry.pop(block_id, None)
+            block = self._registry.pop(block_id, None)
+        if block is not None:
+            block.close()  # release serving resources (cached mmaps) eagerly
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._registry_lock:
@@ -582,8 +661,9 @@ class TpuShuffleTransport(ShuffleTransport):
                 b for b in self._registry
                 if isinstance(b, ShuffleBlockId) and b.shuffle_id == shuffle_id
             ]
-            for b in doomed:
-                del self._registry[b]
+            blocks = [self._registry.pop(b) for b in doomed]
+        for block in blocks:
+            block.close()
 
     def registered_block(self, block_id: BlockId) -> Optional[Block]:
         with self._registry_lock:

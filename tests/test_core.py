@@ -84,6 +84,44 @@ class TestBlocks:
         assert view.tobytes() == b"23456" and not view.flags.writeable
         assert blk.memory_view() is view  # cached, not re-mapped per fetch
 
+    def test_file_backed_block_close_releases_mapping(self, tmp_path):
+        """close() must release the cached mmap's fd NOW (unregistration used
+        to just drop the registry entry, leaking one fd per served spill
+        segment for the life of the process) and stay reusable after."""
+        p = tmp_path / "data.bin"
+        p.write_bytes(b"0123456789")
+        blk = FileBackedBlock(str(p), offset=0, length=10)
+        view = blk.memory_view()
+        mapping = view._mmap  # the mmap.mmap owning the fd
+        assert not mapping.closed
+        del view
+        blk.close()
+        assert mapping.closed, "close() left the mapping (and its fd) open"
+        blk.close()  # idempotent
+        # the block is still servable: a fresh mapping is created on demand
+        assert blk.memory_view().tobytes() == b"0123456789"
+        # with an exported view alive, close() defers to GC instead of raising
+        survivor = blk.memory_view()
+        blk.close()
+        assert survivor.tobytes() == b"0123456789"
+
+    def test_unregister_closes_file_backed_blocks(self, tmp_path):
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+        cluster = TpuShuffleCluster(TpuShuffleConf(num_executors=1), num_executors=1)
+        t = cluster.transport(0)
+        p = tmp_path / "seg.bin"
+        p.write_bytes(b"x" * 64)
+        blk = FileBackedBlock(str(p), offset=0, length=64)
+        bid = ShuffleBlockId(7, 0, 0)
+        t.register(bid, blk)
+        view = blk.memory_view()
+        mapping = view._mmap
+        del view
+        t.unregister(bid)
+        assert mapping.closed, "unregister left the block's mmap open"
+
     def test_file_backed_block_arbitrary_offset_and_empty(self, tmp_path):
         p = tmp_path / "odd.bin"
         payload = bytes(range(256)) * 40

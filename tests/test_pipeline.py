@@ -1,0 +1,271 @@
+"""Pipelined multi-round superstep engine (transport/pipeline.py).
+
+Covers the engine's contract (ordering, bounded in-flight window, error
+propagation), bit-identical results across pipeline depths for every
+host_recv_mode, uneven per-executor spill rounds, and the capacity bucketing
+that lets varying-size shuffles share one compiled exchange.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.operation import TransportError
+from sparkucx_tpu.ops.exchange import bucket_send_rows, rebucket_slots
+from sparkucx_tpu.transport.pipeline import RoundPipeline
+from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+
+class TestRoundPipeline:
+    def test_results_in_round_order_all_depths(self):
+        for depth in (1, 2, 3, 8):
+            out = RoundPipeline(depth, lambda r: r * 10, lambda r, t: t + r).run(6)
+            assert out == [r * 11 for r in range(6)]
+
+    def test_depth_one_is_strictly_serial(self):
+        events = []
+        pipe = RoundPipeline(
+            1, lambda r: events.append(("submit", r)), lambda r, t: events.append(("drain", r))
+        )
+        pipe.run(3)
+        assert events == [
+            ("submit", 0), ("drain", 0), ("submit", 1), ("drain", 1),
+            ("submit", 2), ("drain", 2),
+        ]
+
+    def test_depth_two_overlaps_submit_with_drain(self):
+        # Round 1 must be submitted before round 0's (slow) drain completes.
+        order = []
+        lock = threading.Lock()
+
+        def submit(r):
+            with lock:
+                order.append(("submit", r))
+            return r
+
+        def drain(r, t):
+            time.sleep(0.02)
+            with lock:
+                order.append(("drain", r))
+            return t
+
+        RoundPipeline(2, submit, drain).run(3)
+        assert order.index(("submit", 1)) < order.index(("drain", 0))
+        assert [e for e in order if e[0] == "drain"] == [("drain", r) for r in range(3)]
+
+    def test_backpressure_bounds_inflight_window(self):
+        # With depth d, round k may not be submitted until round k-d drained.
+        depth = 2
+        inflight = []
+        peak = []
+        lock = threading.Lock()
+
+        def submit(r):
+            with lock:
+                inflight.append(r)
+                peak.append(len(inflight))
+            return r
+
+        def drain(r, t):
+            time.sleep(0.01)
+            with lock:
+                inflight.remove(r)
+            return t
+
+        RoundPipeline(depth, submit, drain).run(8)
+        assert max(peak) <= depth + 1  # the submitting round plus the ring
+
+    def test_drain_error_propagates_earliest_first(self):
+        def drain(r, t):
+            if r in (1, 3):
+                raise TransportError(f"boom round {r}")
+            return t
+
+        with pytest.raises(TransportError, match="boom round 1"):
+            RoundPipeline(3, lambda r: r, drain).run(5)
+
+    def test_submit_error_propagates(self):
+        def submit(r):
+            if r == 2:
+                raise ValueError("submit died")
+            return r
+
+        for depth in (1, 3):
+            with pytest.raises(ValueError, match="submit died"):
+                RoundPipeline(depth, submit, lambda r, t: t).run(4)
+
+    def test_zero_rounds_and_depth_validation(self):
+        assert RoundPipeline(4, lambda r: r, lambda r, t: t).run(0) == []
+        with pytest.raises(ValueError, match="depth"):
+            RoundPipeline(0, lambda r: r, lambda r, t: t)
+
+
+class TestBucketHelpers:
+    def test_bucket_send_rows(self):
+        assert bucket_send_rows(200, 2) == 256   # slot 100 -> 128
+        assert bucket_send_rows(256, 2) == 256   # already a pow2 slot: identity
+        assert bucket_send_rows(1, 1) == 1
+        assert bucket_send_rows(100, 1) == 128
+        assert bucket_send_rows(7, 4) == 8       # ceil slot 2 -> 2, x4
+        with pytest.raises(ValueError):
+            bucket_send_rows(0, 2)
+
+    def test_rebucket_slots_relocates_regions(self):
+        n, old_slot, new_slot, lane = 3, 4, 8, 2
+        payload = np.arange(n * old_slot * lane, dtype=np.int32).reshape(n * old_slot, lane)
+        out = rebucket_slots(payload, n, n * new_slot)
+        assert out.shape == (n * new_slot, lane)
+        for j in range(n):
+            region = payload[j * old_slot : (j + 1) * old_slot]
+            assert np.array_equal(out[j * new_slot : j * new_slot + old_slot], region)
+            assert not out[j * new_slot + old_slot : (j + 1) * new_slot].any()
+
+    def test_rebucket_slots_identity_and_validation(self):
+        p = np.ones((8, 2), np.int32)
+        assert rebucket_slots(p, 2, 8) is p
+        with pytest.raises(ValueError):
+            rebucket_slots(p, 2, 6)  # buckets only grow
+        with pytest.raises(ValueError):
+            rebucket_slots(np.ones((7, 2), np.int32), 2, 8)  # not an executor multiple
+
+
+def _run_spill_shuffle(n, depth, mode, *, uneven=False, shuffle_id=0):
+    """One multi-round (spilled) shuffle end-to-end; returns
+    (num_rounds, recv_sizes per round, {(m, r): block bytes})."""
+    conf = TpuShuffleConf(
+        staging_capacity_per_executor=n * 4096,  # 4 KiB per peer region
+        block_alignment=128,
+        num_executors=n,
+        pipeline_depth=depth,
+        host_recv_mode=mode,
+        keep_device_recv=(mode == "device"),
+    )
+    cluster = TpuShuffleCluster(conf, num_executors=n)
+    M, R = 3 * n, 2 * n
+    meta = cluster.create_shuffle(shuffle_id, M, R)
+    rng = np.random.default_rng(7)  # same data at every depth
+    oracle = {}
+    for m in range(M):
+        t = cluster.transport(meta.map_owner[m])
+        w = t.store.map_writer(shuffle_id, m)
+        for r in range(R):
+            # uneven: executor 0's maps write ~4x more, so it spills more
+            # rounds than its peers and the round-count agreement pads
+            size = 2000 if (not uneven or meta.map_owner[m] == 0) else 500
+            payload = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+            oracle[(m, r)] = payload
+            w.write_partition(r, payload)
+        t.commit_block(w.commit().pack())
+    per_exec_rounds = [t.store.num_rounds(shuffle_id) for t in cluster.transports]
+    assert max(per_exec_rounds) > 1, "test should actually spill"
+    if uneven and n > 1:
+        assert per_exec_rounds[0] > min(per_exec_rounds), "rounds should be uneven"
+    cluster.run_exchange(shuffle_id)
+    blocks = {}
+    for (m, r) in oracle:
+        consumer = meta.owner_of_reduce(r)
+        view, length = cluster.locate_received_block(consumer, shuffle_id, m, r)
+        blocks[(m, r)] = bytes(view[:length])
+        assert blocks[(m, r)] == oracle[(m, r)], f"block {(m, r)} corrupted"
+    sizes = [np.asarray(s).copy() for s in cluster.meta(shuffle_id).recv_sizes]
+    cluster.remove_shuffle(shuffle_id)
+    return max(per_exec_rounds), sizes, blocks
+
+
+class TestBitIdenticalAcrossDepths:
+    @pytest.mark.parametrize("mode", ["array", "memmap", "device"])
+    def test_depths_match_serial(self, mode):
+        base_rounds, base_sizes, base_blocks = _run_spill_shuffle(8, 1, mode)
+        for depth in (2, 3):
+            rounds, sizes, blocks = _run_spill_shuffle(8, depth, mode)
+            assert rounds == base_rounds
+            assert len(sizes) == len(base_sizes)
+            for a, b in zip(sizes, base_sizes):
+                assert np.array_equal(a, b)
+            assert blocks == base_blocks
+
+    @pytest.mark.parametrize("mode", ["array", "memmap"])
+    def test_single_executor(self, mode):
+        base = _run_spill_shuffle(1, 1, mode)
+        for depth in (2, 3):
+            got = _run_spill_shuffle(1, depth, mode)
+            assert got[0] == base[0]
+            assert got[2] == base[2]
+
+    def test_uneven_spill_rounds(self):
+        base = _run_spill_shuffle(4, 1, "array", uneven=True)
+        for depth in (2, 3):
+            got = _run_spill_shuffle(4, depth, "array", uneven=True)
+            assert got[0] == base[0] and got[2] == base[2]
+            for a, b in zip(got[1], base[1]):
+                assert np.array_equal(a, b)
+
+
+class TestCapacityBucketing:
+    def test_two_row_counts_one_compile(self):
+        # 100-row and 120-row slots both bucket to 128: ONE cache entry.
+        n = 2
+        conf = TpuShuffleConf(block_alignment=512, num_executors=n, pipeline_depth=2)
+        cluster = TpuShuffleCluster(conf, num_executors=n)
+        rng = np.random.default_rng(3)
+        oracle = {}
+        for sid, slot_rows in ((0, 100), (1, 120)):
+            meta = cluster.create_shuffle(sid, n, n, capacity=n * slot_rows * 512)
+            for m in range(n):
+                t = cluster.transport(meta.map_owner[m])
+                w = t.store.map_writer(sid, m)
+                for r in range(n):
+                    payload = rng.integers(0, 256, size=700 + 100 * sid, dtype=np.uint8).tobytes()
+                    oracle[(sid, m, r)] = payload
+                    w.write_partition(r, payload)
+                t.commit_block(w.commit().pack())
+            cluster.run_exchange(sid)
+        assert len(cluster._exchange_cache) == 1, (
+            "different send_rows in one slot bucket must share a compiled exchange"
+        )
+        for (sid, m, r), expect in oracle.items():
+            consumer = cluster.meta(sid).owner_of_reduce(r)
+            view, length = cluster.locate_received_block(consumer, sid, m, r)
+            assert bytes(view[:length]) == expect
+
+    def test_distinct_buckets_compile_separately(self):
+        n = 2
+        conf = TpuShuffleConf(block_alignment=512, num_executors=n)
+        cluster = TpuShuffleCluster(conf, num_executors=n)
+        for sid, slot_rows in ((0, 100), (1, 300)):  # buckets 128 vs 512
+            meta = cluster.create_shuffle(sid, n, n, capacity=n * slot_rows * 512)
+            for m in range(n):
+                t = cluster.transport(meta.map_owner[m])
+                w = t.store.map_writer(sid, m)
+                for r in range(n):
+                    w.write_partition(r, b"x" * 600)
+                t.commit_block(w.commit().pack())
+            cluster.run_exchange(sid)
+        assert len(cluster._exchange_cache) == 2
+
+
+class TestPipelineStats:
+    def test_stage_stats_recorded(self):
+        conf = TpuShuffleConf(
+            staging_capacity_per_executor=2 * 4096, block_alignment=128,
+            num_executors=2, pipeline_depth=2,
+        )
+        cluster = TpuShuffleCluster(conf, num_executors=2)
+        meta = cluster.create_shuffle(0, 2, 2)
+        rng = np.random.default_rng(1)
+        for m in range(2):
+            t = cluster.transport(meta.map_owner[m])
+            w = t.store.map_writer(0, m)
+            for r in range(2):
+                w.write_partition(r, rng.integers(0, 256, size=2000, dtype=np.uint8).tobytes())
+            t.commit_block(w.commit().pack())
+        cluster.run_exchange(0)
+        kinds = cluster.stats.kinds()
+        assert "exchange.pipeline.submit" in kinds
+        assert "exchange.pipeline.drain" in kinds
+        drain = cluster.stats.summary("exchange.pipeline.drain")
+        assert drain.ops == max(t.store.num_rounds(0) for t in cluster.transports)
+        assert drain.bytes > 0  # received bytes attributed to the drain lane

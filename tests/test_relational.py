@@ -789,3 +789,44 @@ class TestSemiAntiJoin:
             mesh, bkeys, bvals, pkeys, pvals, impl="dense", join_type="left_semi"
         )
         assert sorted(jp[:, 0].tolist()) == [1, 2]  # the two key-7 probe rows, once each
+
+
+class TestAggregateSpecFromConf:
+    """conf.partial_aggregation enters plans through from_conf — the
+    partialAggregation Spark key must actually change the compiled spec."""
+
+    def test_conf_defaults_flow_into_spec(self):
+        from sparkucx_tpu.config import TpuShuffleConf
+
+        conf = TpuShuffleConf(num_executors=4)
+        spec = AggregateSpec.from_conf(conf, capacity=8, recv_capacity=32, aggs=("sum",))
+        assert spec.partial is True  # the documented on-by-default
+        assert spec.num_executors == 4
+        assert spec.axis_name == conf.mesh_axis_name
+        off = AggregateSpec.from_conf(
+            TpuShuffleConf(partial_aggregation=False),
+            num_executors=2, capacity=8, recv_capacity=32, aggs=("sum",),
+        )
+        assert off.partial is False
+        spec.resolve_impl("cpu").validate()
+        off.resolve_impl("cpu").validate()
+
+    def test_explicit_kwargs_win(self):
+        from sparkucx_tpu.config import TpuShuffleConf
+
+        spec = AggregateSpec.from_conf(
+            TpuShuffleConf(), num_executors=2, capacity=8, recv_capacity=32,
+            aggs=("sum",), partial=False,
+        )
+        assert spec.partial is False
+
+    def test_count_distinct_auto_disables_partial(self):
+        from sparkucx_tpu.config import TpuShuffleConf
+
+        spec = AggregateSpec.from_conf(
+            TpuShuffleConf(), num_executors=2, capacity=8, recv_capacity=32,
+            aggs=("sum", "count_distinct"),
+        )
+        assert spec.partial is False
+        # must not raise despite conf partial_aggregation=True
+        spec.resolve_impl("cpu").validate()

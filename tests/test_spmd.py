@@ -75,10 +75,13 @@ CHILD = textwrap.dedent(
         # the received rounds live on disk, not RAM, and are reclaimed
         shards, _ = ex._recv[0]
         assert shards and all(isinstance(s, np.memmap) for s in shards)
-        paths = list(ex._recv_spill.get(0, []))
-        assert paths and all(os.path.exists(p) for p in paths)
+        spilled = list(ex._recv_spill.get(0, []))
+        assert spilled and all(os.path.exists(p) for p, _ in spilled)
+        # the refund is the charged nbytes, not getsize: budget returns to 0
+        assert ex._recv_spill_bytes == sum(nb for _, nb in spilled)
         ex.remove_shuffle(0)
-        assert not any(os.path.exists(p) for p in paths), "spmd spill leaked"
+        assert not any(os.path.exists(p) for p, _ in spilled), "spmd spill leaked"
+        assert ex._recv_spill_bytes == 0, "spill budget not fully refunded"
     print(f"CHILD_PASS pid={{pid}} checked={{checked}}", flush=True)
     ex.close(); ep.close()
     """
