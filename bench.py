@@ -457,6 +457,22 @@ def main():
                 RESULT["pipeline_overlap_speedup"] = round(pl[2] / pl[1], 3)
         except Exception as e:
             RESULT["pipeline_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            # Map-output staging: host byte path (memcpy into host staging +
+            # seal's H2D) vs the device staging path (write_partition_device +
+            # block-scatter kernel, seal returns the HBM payload directly).
+            # On real TPUs the device path skips the PCIe round trip entirely;
+            # through the CPU tunnel it mainly measures kernel overhead.
+            if budget_left() < 90:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            from sparkucx_tpu.perf.benchmark import measure_write
+
+            wr = measure_write(8, 1 << 20, REPEATS)
+            RESULT["write"] = {impl: round(v, 3) for impl, v in wr.items()}
+            if wr.get("host") and wr.get("device"):
+                RESULT["write_device_speedup"] = round(wr["device"] / wr["host"], 3)
+        except Exception as e:
+            RESULT["write_error"] = f"{type(e).__name__}: {e}"[:200]
 
     emit_once()
 

@@ -155,6 +155,16 @@ class TpuShuffleConf:
     #: (count_distinct plans do so automatically — partials don't compose).
     partial_aggregation: bool = True
 
+    #: Device-resident map-output staging (store/hbm_store.py device rounds +
+    #: ops/pallas_kernels.build_block_scatter): device-born map output is
+    #: written as ``(rows, lane)`` int32 device arrays and placed into the
+    #: HBM staging array by the block-scatter kernel, so seal returns the
+    #: exchange payload with zero D2H -> host memcpy -> H2D round trip.
+    #: Gates ``write_partition_device`` / ``DeviceMapWriter``
+    #: (shuffle/writer.py).  Default off: the host byte path stays the
+    #: reference-faithful default.
+    device_staging: bool = False
+
     #: Superstep pipelining across spill rounds: how many rounds may be in
     #: flight at once in the multi-round exchange (transport/tpu.py /
     #: transport/spmd.py).  At depth d, round k's collective overlaps round
@@ -228,6 +238,7 @@ class TpuShuffleConf:
             ("spillDiskCap", "spill_disk_cap_bytes", parse_size),
             ("reduceMemoryBudget", "reduce_memory_budget", parse_size),
             ("pipelineDepth", "pipeline_depth", int),
+            ("deviceStaging", "device_staging", lambda v: str(v).lower() == "true"),
         ]:
             v = get(name)
             if v is not None:

@@ -1,4 +1,5 @@
-"""Pallas TPU kernels for the block data plane — ragged block gather ("fetch pack").
+"""Pallas TPU kernels for the block data plane — ragged block gather ("fetch
+pack") and its inverse, the ragged block scatter ("device staging write").
 
 The hot serving primitive of the reference is packing many variable-length
 shuffle blocks into ONE contiguous registered buffer and shipping that single
@@ -10,7 +11,14 @@ the exchange collective (transport/tpu.py), so the equivalent primitive is a
 HBM-resident source into one packed HBM destination, without the bytes ever
 visiting the host.
 
-Three interchangeable lowerings (bit-identical results):
+``build_block_scatter`` is the write-side inverse (the NvkvHandler.write
+analogue for device-born map output, store/hbm_store.py device staging): copy
+B variable-length row runs out of ONE packed device buffer into their
+slot-layout staging positions in an HBM-resident staging array, so map output
+produced on the chip reaches the exchange without a D2H -> host memcpy -> H2D
+round trip.
+
+Three interchangeable lowerings each (bit-identical results):
 
 * ``impl='dma'`` — Pallas kernel, one *dynamic-size* HBM->HBM DMA per block,
   K-deep pipelined on a rotating semaphore ring (the DMA engine streams block
@@ -21,8 +29,9 @@ Three interchangeable lowerings (bit-identical results):
 * ``impl='tiled'`` — Pallas kernel with *static-size* tile DMAs (full tiles +
   an overlapping shifted tail, single-row DMAs for sub-tile blocks).  Portable
   to ``interpret=True``, which is how CI tests the kernel structure on CPU.
-* ``impl='xla'`` — pure jnp row gather (searchsorted + take), the portable
-  fallback and the oracle the Pallas paths are tested against.
+* ``impl='xla'`` — pure jnp fallback: searchsorted + take for the gather,
+  masked ``dynamic_update_slice`` windows for the scatter; the portable path
+  and the oracle the Pallas paths are tested against.
 
 Sizes here are **rows** of ``lane`` 32-bit elements — the exchange's wire unit
 (one row = the store's block alignment; ops/exchange.py module docstring).
@@ -225,6 +234,212 @@ def build_block_gather(
         fn = jax.jit(functools.partial(_pallas_gather, kernel, interpret, out_rows))
     else:
         raise ValueError(f"unknown impl {impl!r}")
+    fn.impl = impl
+    return fn
+
+
+def _scatter_dma_kernel(starts_ref, counts_ref, outs_ref, src_ref, dst_ref, out_ref, sems):
+    """Inverse of ``_gather_dma_kernel``: packed src -> scattered dst slots.
+
+    ``dst_ref`` is aliased to ``out_ref`` (input_output_aliases), so rows not
+    covered by any block keep their prior staging contents — that is what makes
+    this an *append* into a partially-filled staging round rather than a
+    rebuild.  Same K-deep rotating-semaphore pipeline as the gather.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    del dst_ref  # present only to carry the alias; all writes go through out_ref
+    num_blocks = starts_ref.shape[0]
+    k = DMA_PIPELINE_DEPTH
+
+    def get_dma(i):
+        return pltpu.make_async_copy(
+            src_ref.at[pl.ds(outs_ref[i], counts_ref[i])],
+            out_ref.at[pl.ds(starts_ref[i], counts_ref[i])],
+            sems.at[jax.lax.rem(i, k)],
+        )
+
+    def body(i, _):
+        @pl.when(jnp.logical_and(i >= k, counts_ref[jnp.maximum(i - k, 0)] > 0))
+        def _wait_prev():
+            get_dma(i - k).wait()
+
+        @pl.when(counts_ref[i] > 0)
+        def _start():
+            get_dma(i).start()
+
+        return 0
+
+    jax.lax.fori_loop(0, num_blocks, body, 0)
+
+    def drain(i, _):
+        @pl.when(counts_ref[i] > 0)
+        def _wait():
+            get_dma(i).wait()
+
+        return 0
+
+    jax.lax.fori_loop(jnp.maximum(num_blocks - k, 0), num_blocks, drain, 0)
+
+
+def _scatter_tiled_kernel(starts_ref, counts_ref, outs_ref, src_ref, dst_ref, out_ref, sem):
+    """Static-size-DMA scatter, portable to ``interpret=True`` (CI's path).
+
+    Mirrors ``_gather_tiled_kernel`` with the copy direction reversed: full
+    tiles, an overlapping shifted tail when count >= TILE_ROWS (safe — src and
+    dst shift together), single-row DMAs below one tile.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    del dst_ref  # aliased to out_ref
+    num_blocks = starts_ref.shape[0]
+
+    def copy(src_row, dst_row, rows):
+        dma = pltpu.make_async_copy(
+            src_ref.at[pl.ds(src_row, rows)],
+            out_ref.at[pl.ds(dst_row, rows)],
+            sem,
+        )
+        dma.start()
+        dma.wait()
+
+    def block_body(b, _):
+        start, count, out = starts_ref[b], counts_ref[b], outs_ref[b]
+        full = count // TILE_ROWS
+
+        def tile_body(t, _):
+            copy(out + t * TILE_ROWS, start + t * TILE_ROWS, TILE_ROWS)
+            return 0
+
+        jax.lax.fori_loop(0, full, tile_body, 0)
+
+        tail = count - full * TILE_ROWS
+
+        @pl.when(jnp.logical_and(tail > 0, count >= TILE_ROWS))
+        def _shifted_tail():
+            copy(out + count - TILE_ROWS, start + count - TILE_ROWS, TILE_ROWS)
+
+        @pl.when(count < TILE_ROWS)
+        def _tiny_block():
+            def row_body(r, _):
+                copy(out + r, start + r, 1)
+                return 0
+
+            jax.lax.fori_loop(0, count, row_body, 0)
+
+        return 0
+
+    jax.lax.fori_loop(0, num_blocks, block_body, 0)
+
+
+def _pallas_scatter(kernel, interpret: bool, out_rows: int, starts, counts, outs, src, dst):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    sem_shape = (
+        pltpu.SemaphoreType.DMA((DMA_PIPELINE_DEPTH,))
+        if kernel is _scatter_dma_kernel
+        else pltpu.SemaphoreType.DMA
+    )
+    alloc_rows = max(out_rows, TILE_ROWS)
+    if dst.shape[0] != alloc_rows:
+        dst = jnp.pad(dst, ((0, alloc_rows - dst.shape[0]), (0, 0)))
+    # The packed src can hold fewer than TILE_ROWS rows (tiny rounds); the
+    # tiled kernel's TILE_ROWS-sized copies need the operand itself to be at
+    # least one tile tall even though the guarded reads never leave the
+    # packed region at runtime.
+    if src.shape[0] < TILE_ROWS:
+        src = jnp.pad(src, ((0, TILE_ROWS - src.shape[0]), (0, 0)))
+    # dst is operand 4 of the FULL input tuple (scalar-prefetch args included in
+    # the alias numbering), aliased to output 0: untouched rows pass through.
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((alloc_rows, src.shape[1]), src.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[sem_shape],
+        ),
+        input_output_aliases={4: 0},
+        compiler_params=tpu_compiler_params(has_side_effects=True),
+        interpret=interpret,
+    )(starts, counts, outs, src, dst)
+    return out[:out_rows]
+
+
+def _xla_scatter(window: int, out_rows: int, starts, counts, outs, src, dst):
+    """Portable lowering: one masked ``dynamic_update_slice`` window per block.
+
+    Each scan step reads a fixed ``window``-row slice of dst at the block's
+    start, overwrites the first ``count`` rows from the packed src, and writes
+    it back.  Both arrays are padded by ``window`` rows so XLA's slice-start
+    clamping can never shift a window (a clamped start would silently copy the
+    wrong src rows); zero-count blocks degenerate to read-modify-write no-ops,
+    so pow2 batch padding needs no monotonicity trick here.
+    """
+    lane = src.shape[1]
+    src = jnp.pad(src, ((0, window), (0, 0)))
+    dst = jnp.pad(dst, ((0, out_rows + window - dst.shape[0]), (0, 0)))
+    row_in_window = jnp.arange(window, dtype=jnp.int32)[:, None]
+
+    def body(d, block):
+        start, count, out = block
+        src_win = jax.lax.dynamic_slice(src, (out, 0), (window, lane))
+        cur = jax.lax.dynamic_slice(d, (start, 0), (window, lane))
+        new = jnp.where(row_in_window < count, src_win, cur)
+        return jax.lax.dynamic_update_slice(d, new, (start, 0)), None
+
+    d, _ = jax.lax.scan(body, dst, (starts, counts, outs))
+    return d[:out_rows]
+
+
+def build_block_scatter(
+    num_blocks: int,
+    out_rows: int,
+    impl: Optional[str] = None,
+    interpret: bool = False,
+    max_block_rows: Optional[int] = None,
+):
+    """Compile a ragged block scatter: ``fn(starts, counts, outs, src, dst) -> dst'``.
+
+    The inverse of :func:`build_block_gather` — the device staging write path
+    (store/hbm_store.py ``write_partition_device``):
+
+    * ``starts``: (num_blocks,) int32 — *destination* slot-layout row per block
+      (``j * slot_rows + used_j`` in the staging geometry).
+    * ``counts``: (num_blocks,) int32 — rows per block; zero-count entries are
+      no-ops (how pow2 batch padding is expressed).
+    * ``outs``: (num_blocks,) int32 — *source* row offsets in the packed
+      buffer; must be the exclusive cumsum of ``counts`` (pack_plan layout).
+    * ``src``: (S, lane) int32 — packed device buffer of block payloads.
+    * ``dst``: (out_rows, lane) int32 — the staging array; returns a new array
+      with the blocks placed and every uncovered row carried over unchanged
+      (Pallas paths alias dst to the output; the xla path read-modify-writes).
+
+    ``max_block_rows`` bounds the largest single block (xla path window size;
+    defaults to ``out_rows``).  ``impl`` as in ``build_block_gather``.  On TPU
+    ``dst`` is donated, making the append in-place.
+    """
+    if impl is None:
+        impl = "dma" if jax.devices()[0].platform == "tpu" else "xla"
+    if impl == "xla":
+        window = max(1, max_block_rows if max_block_rows is not None else out_rows)
+        f = functools.partial(_xla_scatter, window, out_rows)
+    elif impl in ("dma", "tiled"):
+        kernel = _scatter_dma_kernel if impl == "dma" else _scatter_tiled_kernel
+        f = functools.partial(_pallas_scatter, kernel, interpret, out_rows)
+    else:
+        raise ValueError(f"unknown impl {impl!r}")
+    # Donating dst turns the aliasing into a true in-place append; on CPU
+    # donation is unimplemented and would warn every call, so gate it.
+    donate = (4,) if jax.devices()[0].platform == "tpu" else ()
+    fn = jax.jit(f, donate_argnums=donate)
     fn.impl = impl
     return fn
 

@@ -79,7 +79,7 @@ def _parse_args(argv):
         "mode",
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
-            "columnar", "groupby", "join",
+            "columnar", "groupby", "join", "write",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -96,8 +96,9 @@ def _parse_args(argv):
         help="factor the superstep mesh into this many slices (two-phase ICI+DCN route)",
     )
     p.add_argument(
-        "--impl", default="auto", choices=["auto", "dma", "tiled", "xla"],
-        help="block-gather lowering (gather mode)",
+        "--impl", default="auto",
+        help="block-gather lowering: auto|dma|tiled|xla (gather mode), or a "
+        "comma list of staging paths to compare: host,device (write mode)",
     )
     p.add_argument(
         "--keys", type=int, default=100,
@@ -427,6 +428,111 @@ def run_gather(args) -> None:
         impl=None if args.impl == "auto" else args.impl,
         report=report,
     )
+
+
+def measure_write(
+    num_blocks: int,
+    block_bytes: int,
+    iterations: int,
+    impls=("host", "device"),
+    report=None,
+) -> dict:
+    """Measurement core of the ``write`` mode — map-output staging throughput,
+    host byte path vs device staging path (ISSUE 2's tentpole comparison).
+
+    ``host``: ``MapWriter.write_partition`` copies bytes into host staging and
+    ``seal`` uploads the whole buffer H2D — the reference-faithful shape
+    (NvkvHandler.scala:213-242 pinned-buffer staging).  ``device``:
+    ``write_partition_device`` keeps the blocks device-resident and ``seal``
+    places them with the block-scatter kernel, returning the HBM payload with
+    no host round trip.  One map task writes ``num_blocks`` partitions of
+    ``block_bytes`` each into a fresh shuffle per iteration; the clock covers
+    write -> seal -> payload ready.  Returns ``{impl: best GB/s}``;
+    ``report(impl, it, seconds, bytes)`` per iteration.  Shared by the CLI and
+    bench.py."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+
+    from sparkucx_tpu.store.hbm_store import HbmBlockStore
+
+    row = 512
+    rows_each = max(1, block_bytes // row)
+    total = num_blocks * rows_each * row
+    conf = TpuShuffleConf(
+        device_staging=True,
+        staging_capacity_per_executor=max(2 * total, 1 << 20),
+        spill_to_disk=False,
+    )
+    device = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    host_blocks = [
+        rng.integers(0, 256, size=rows_each * row, dtype=np.uint8).tobytes()
+        for _ in range(num_blocks)
+    ]
+    dev_blocks = [
+        jax.device_put(
+            np.frombuffer(b, np.uint8).view(np.int32).reshape(rows_each, row // 4),
+            device,
+        )
+        for b in host_blocks
+    ]
+    jax.block_until_ready(dev_blocks)
+    results = {}
+    for impl in impls:
+        if impl not in ("host", "device"):
+            raise ValueError(f"unknown write impl {impl!r} (host|device)")
+        store = HbmBlockStore(conf, device=device)
+        best = 0.0
+        for it in range(iterations + 1):  # iteration 0 = warmup (compiles)
+            sid = it
+            store.create_shuffle(sid, 1, num_blocks)
+            t0 = time.perf_counter()
+            w = store.map_writer(sid, 0)
+            for r in range(num_blocks):
+                if impl == "host":
+                    w.write_partition(r, host_blocks[r])
+                else:
+                    w.write_partition_device(r, dev_blocks[r])
+            w.commit()
+            payload = store.seal(sid)[-1][0]
+            jax.block_until_ready(payload)
+            np.asarray(payload[0, :4])  # force completion through async tunnels
+            dt = time.perf_counter() - t0
+            store.remove_shuffle(sid)
+            if it == 0:
+                continue
+            best = max(best, total / dt / 1e9)
+            if report is not None:
+                report(impl, it - 1, dt, total)
+        results[impl] = best
+    return results
+
+
+def run_write(args) -> None:
+    size = parse_size(args.block_size)
+    impls = (
+        ("host", "device")
+        if args.impl == "auto"
+        else tuple(s.strip() for s in args.impl.split(",") if s.strip())
+    )
+
+    def report(impl, it, dt, tot):
+        print(
+            f"iter {it}: staged {args.num_blocks} x {size} B via {impl} path in "
+            f"{dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    results = measure_write(
+        args.num_blocks, size, args.iterations, impls=impls, report=report
+    )
+    host = results.get("host")
+    for impl in impls:
+        gbps = results[impl]
+        speedup = f" ({gbps / host:.2f}x vs host)" if host and impl == "device" else ""
+        print(f"write {impl}: {gbps:.2f} GB/s{speedup}", flush=True)
 
 
 def measure_sort(
@@ -858,6 +964,8 @@ def main(argv=None) -> None:
         run_pipeline(args)
     elif args.mode == "gather":
         run_gather(args)
+    elif args.mode == "write":
+        run_write(args)
     elif args.mode == "sort":
         run_sort(args)
     elif args.mode == "columnar":

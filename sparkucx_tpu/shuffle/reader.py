@@ -47,10 +47,54 @@ class ShuffleReadMetrics:
     spills: int = 0
 
 
-@dataclass
 class BlockFetchResult:
-    block_id: ShuffleBlockId
-    data: bytes
+    """One fetched block.
+
+    ``data`` is served zero-copy: a read-only memoryview of the fetch buffer,
+    valid while the result is attached to it.  The streaming ``read()`` path
+    calls ``release()`` once the block's deserializer is exhausted, so record
+    decoding never copies the payload a second time.  When the fetch iterator
+    advances past a result nobody released, it ``detach()``es it — copying the
+    bytes out only if the buffer is pooled (about to be recycled), so the
+    ``data`` *property* stays valid for collect-into-list consumers; only a
+    captured memoryview object itself goes stale at that point.  Constructing
+    with a plain ``bytes`` payload keeps the old copying contract."""
+
+    __slots__ = ("block_id", "_data", "_buf", "_pooled")
+
+    def __init__(
+        self,
+        block_id: ShuffleBlockId,
+        data,
+        buf: Optional[MemoryBlock] = None,
+        pooled: bool = False,
+    ) -> None:
+        self.block_id = block_id
+        self._data = data
+        self._buf = buf
+        self._pooled = pooled
+
+    @property
+    def data(self):
+        return self._data
+
+    def release(self) -> None:
+        """Consumer is done with ``data``: hand the fetch buffer back without
+        any copy.  ``data`` must not be touched afterwards."""
+        buf, self._buf = self._buf, None
+        if buf is not None:
+            if self._pooled:
+                self._data = b""
+            buf.close()
+
+    def detach(self) -> None:
+        """Make ``data`` outlive the buffer: copy it out if (and only if) the
+        buffer is pooled, then hand the buffer back.  Idempotent."""
+        buf, self._buf = self._buf, None
+        if buf is not None:
+            if self._pooled:
+                self._data = bytes(self._data)
+            buf.close()
 
 
 def default_deserializer(payload: bytes) -> Iterable[Any]:
@@ -199,15 +243,28 @@ class TpuShuffleReader:
                         park(0.002)
             self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
 
-            for bid, buf, req in requests:
-                result = req.wait(0)
-                if result.status != OperationStatus.SUCCESS:
-                    result = self._retry_fetch(bid, buf, result)
-                payload = bytes(buf.host_view()[: result.stats.recv_size])
-                self.metrics.remote_bytes_read += len(payload)
-                self.metrics.remote_blocks_fetched += 1
-                buf.close()
-                yield BlockFetchResult(bid, payload)
+            prev: Optional[BlockFetchResult] = None
+            try:
+                for bid, buf, req in requests:
+                    result = req.wait(0)
+                    if result.status != OperationStatus.SUCCESS:
+                        result = self._retry_fetch(bid, buf, result)
+                    # Zero-copy hand-off: a read-only view of the recv bytes.
+                    # The old `bytes(...)` here copied every fetched block a
+                    # second time; now the copy happens only in detach(), and
+                    # only for pooled buffers nobody released in time.
+                    view = buf.host_view()[: result.stats.recv_size]
+                    view.flags.writeable = False
+                    self.metrics.remote_bytes_read += int(result.stats.recv_size)
+                    self.metrics.remote_blocks_fetched += 1
+                    prev = BlockFetchResult(
+                        bid, memoryview(view), buf, pooled=self.pool is not None
+                    )
+                    yield prev
+                    prev.detach()
+            finally:
+                if prev is not None:
+                    prev.detach()
 
     def _retry_fetch(self, bid: ShuffleBlockId, buf: MemoryBlock, failed):
         """Per-block pull-path retry — the straggler/failure escape hatch next
@@ -255,9 +312,17 @@ class TpuShuffleReader:
         role the reference's pipeline delegates to Spark — so a reduce
         partition larger than memory streams through sorted disk runs instead
         of OOMing."""
-        records: Iterator[Any] = (
-            rec for blk in self.fetch_blocks() for rec in self.deserializer(blk.data)
-        )
+        def stream() -> Iterator[Any]:
+            # Release each block as soon as its deserializer is exhausted:
+            # the decoder reads straight out of the fetch buffer (zero-copy)
+            # and the pooled buffer recycles without the detach() copy.
+            for blk in self.fetch_blocks():
+                try:
+                    yield from self.deserializer(blk.data)
+                finally:
+                    blk.release()
+
+        records: Iterator[Any] = stream()
 
         def counted(it):
             for rec in it:

@@ -73,6 +73,30 @@ class TpuShufflePartitionWriter:
         return self._stream.count if self._stream is not None else 0
 
 
+class DeviceMapWriter:
+    """Device-resident per-map writer (conf.device_staging): partitions arrive
+    as ``(rows, lane)`` int32 device arrays and never visit host memory — the
+    block-scatter kernel places the whole round into HBM staging at seal
+    (store/hbm_store.py ``MapWriter.write_partition_device``).  Same sequential
+    protocol and first-commit-wins retry semantics as the host ``MapWriter``;
+    this wrapper is the writer-layer surface that enforces the conf gate."""
+
+    def __init__(self, store: HbmBlockStore, shuffle_id: int, map_id: int) -> None:
+        if not store.conf.device_staging:
+            raise TransportError(
+                "device staging disabled — set spark.shuffle.tpu.deviceStaging=true"
+            )
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.map_writer: MapWriter = store.map_writer(shuffle_id, map_id)
+
+    def write_partition(self, reduce_id: int, rows, length: Optional[int] = None) -> None:
+        self.map_writer.write_partition_device(reduce_id, rows, length=length)
+
+    def commit(self):
+        return self.map_writer.commit()
+
+
 class TpuShuffleMapOutputWriter:
     """One map task's output writer.  Sequential partition protocol enforced by
     the underlying store writer (NvkvShuffleMapOutputWriter.scala:108)."""
@@ -89,6 +113,7 @@ class TpuShuffleMapOutputWriter:
         self.map_id = map_id
         self.num_partitions = num_partitions
         self._transport = transport
+        self._conf = store.conf
         #: public: the friend writer/stream classes above drive this handle
         self.map_writer: MapWriter = store.map_writer(shuffle_id, map_id)
         self._partition_lengths = np.zeros(num_partitions, dtype=np.int64)
@@ -107,6 +132,31 @@ class TpuShuffleMapOutputWriter:
             raise ValueError(f"reduce_id {reduce_id} out of range")
         self._last_partition = reduce_id
         return TpuShufflePartitionWriter(self, reduce_id)
+
+    def write_partition_device(self, reduce_id: int, rows, length: Optional[int] = None) -> None:
+        """Device-path partition write: ``rows`` is a ``(r, lane)`` int32
+        device array staged without a host round trip (requires
+        spark.shuffle.tpu.deviceStaging=true).  Follows the same increasing
+        reduce-order protocol as ``get_partition_writer`` and records the true
+        byte length for the commit message."""
+        if not self._conf.device_staging:
+            raise TransportError(
+                "device staging disabled — set spark.shuffle.tpu.deviceStaging=true"
+            )
+        if self._committed:
+            raise TransportError("writer already committed")
+        if reduce_id <= self._last_partition:
+            raise TransportError(
+                f"partitions must be requested in increasing order "
+                f"(got {reduce_id} after {self._last_partition})"
+            )
+        if not (0 <= reduce_id < self.num_partitions):
+            raise ValueError(f"reduce_id {reduce_id} out of range")
+        self.map_writer.write_partition_device(reduce_id, rows, length=length)
+        self._last_partition = reduce_id
+        self._partition_lengths[reduce_id] = (
+            length if length is not None else int(rows.shape[0]) * (rows.shape[1] * 4)
+        )
 
     def record_partition_length(self, reduce_id: int, count: int) -> None:
         """Called by PartitionWriterStream.close() with the partition's byte

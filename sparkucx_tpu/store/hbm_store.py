@@ -94,9 +94,18 @@ class _ShuffleState:
         if staging is not None:
             if staging.size < n * self.region_size:
                 raise ValueError("provided staging buffer too small")
-            self.staging = staging[: n * self.region_size]
+            self._staging = staging[: n * self.region_size]
         else:
-            self.staging = np.zeros(n * self.region_size, dtype=np.uint8)
+            self._staging = None  # allocated lazily on first host-path touch
+        #: Write-path mode latch: None until the first partition lands, then
+        #: False (host MapWriter.write) or True (write_partition_device) — a
+        #: shuffle is host- or device-staged, never both.
+        self.device_mode: Optional[bool] = None
+        #: Current device round's blocks awaiting scatter materialization:
+        #: (dst_row, rows, payload) triples in append order, plus a per-block
+        #: map for serving reads of the not-yet-sealed round.
+        self.device_pending: List[Tuple[int, int, object]] = []
+        self.device_blocks: Dict[Tuple[int, int], object] = {}
         #: Multi-round spill state: when a region fills, the whole staging epoch
         #: is snapshotted and writing continues in a fresh round — the exchange
         #: then runs one collective per round.  This is the data-volume scaling
@@ -111,6 +120,26 @@ class _ShuffleState:
         self.committed_maps: set = set()
         self.sealed_payload: Optional[object] = None  # jax.Array | np.ndarray
         self._range_starts = [r[0] for r in peer_ranges]
+
+    @property
+    def staging(self) -> Optional[np.ndarray]:
+        """Host staging buffer, allocated on first touch.  Device-staged
+        shuffles never read this property, so the buffer is never allocated
+        for them — the observable form of the tentpole's "no host round trip"
+        guarantee (``HbmBlockStore.host_staging_allocated``)."""
+        if self._staging is None:
+            self._staging = np.zeros(
+                len(self.peer_ranges) * self.region_size, dtype=np.uint8
+            )
+        return self._staging
+
+    @staging.setter
+    def staging(self, value: Optional[np.ndarray]) -> None:
+        self._staging = value
+
+    @property
+    def host_staging_allocated(self) -> bool:
+        return self._staging is not None
 
     def owner_of(self, reduce_id: int) -> int:
         if not (0 <= reduce_id < self.num_reducers):
@@ -189,6 +218,12 @@ class MapWriter:
         if not self._discard:
             padded = -(-self._written // st.alignment) * st.alignment
             with self._store._lock:
+                if st.device_mode:
+                    raise TransportError(
+                        f"shuffle {st.shuffle_id} already has device-staged rounds — "
+                        "host and device writes cannot mix"
+                    )
+                st.device_mode = False
                 # Allocate in the current round; roll the staging epoch when the
                 # region can't take this partition (multi-round spill).
                 if int(st.region_used[peer]) + padded > st.region_size:
@@ -217,6 +252,72 @@ class MapWriter:
         if data:
             self.write(data)
         self.close_partition()
+
+    def write_partition_device(self, reduce_id: int, rows, length: Optional[int] = None) -> None:
+        """Device-path partition write (conf.device_staging): ``rows`` is a
+        ``(r, lane)`` int32 device array — one row per ``alignment`` bytes,
+        already the exchange's wire unit.  The payload never visits host
+        memory: it stays device-resident until the block-scatter kernel places
+        the whole round into HBM staging at seal (or at D2H rollover, the one
+        point where a host copy is unavoidable).  Same protocol and offset
+        table as the host path: increasing reduce order, one write per
+        partition, first commit wins.  ``length`` is the true payload byte
+        count when the last row is padding-tailed (defaults to the full
+        ``rows`` extent)."""
+        if self._open_reduce is not None:
+            raise TransportError("previous partition still open")
+        if reduce_id <= self._last_reduce:
+            raise TransportError(
+                f"partitions must be opened in increasing reduce order "
+                f"(got {reduce_id} after {self._last_reduce})"
+            )
+        st = self._state
+        peer = st.owner_of(reduce_id)
+        lane = st.alignment // 4
+        if getattr(rows, "ndim", 0) != 2 or rows.shape[1] != lane:
+            raise TransportError(
+                f"device partition must be (rows, {lane}) int32, got shape "
+                f"{getattr(rows, 'shape', None)}"
+            )
+        nrows = int(rows.shape[0])
+        padded = nrows * st.alignment
+        if length is None:
+            length = padded
+        min_len = (nrows - 1) * st.alignment + 1 if nrows else 0
+        if not (min_len <= length <= padded):
+            raise TransportError(
+                f"length {length} inconsistent with {nrows} staged rows of "
+                f"{st.alignment} B each"
+            )
+        if not self._discard:
+            if padded > st.region_size:
+                raise TransportError(
+                    f"single partition ({self.map_id},{reduce_id}) exceeds a "
+                    f"whole region ({st.region_size} B) — raise stagingCapacity"
+                )
+            with self._store._lock:
+                if st.device_mode is False:
+                    raise TransportError(
+                        f"shuffle {st.shuffle_id} already has host-staged blocks — "
+                        "host and device writes cannot mix"
+                    )
+                st.device_mode = True
+                if int(st.region_used[peer]) + padded > st.region_size:
+                    if st.staging_closer is not None:
+                        raise TransportError(
+                            "region overflow with shm staging — multi-round spill "
+                            "requires private staging; raise stagingCapacity"
+                        )
+                    self._store._rollover_device(st)
+                start = peer * st.region_size + int(st.region_used[peer])
+                if nrows:
+                    st.device_pending.append((start // st.alignment, nrows, rows))
+                    st.device_blocks[(self.map_id, reduce_id)] = rows
+                st.blocks[(self.map_id, reduce_id)] = _BlockEntry(
+                    offset=start, length=length, padded=padded, round=st.round
+                )
+                st.region_used[peer] += padded
+        self._last_reduce = reduce_id
 
     def commit(self) -> MapperInfo:
         """Commit this map task's outputs — the ``commitAllPartitions`` packing
@@ -260,6 +361,10 @@ class HbmBlockStore:
         # disk round tier accounting (conf.spill_to_disk)
         self._spill_dir: Optional[str] = None
         self._spill_bytes = 0
+        #: build_block_scatter compile cache keyed by pow2-bucketed geometry —
+        #: the _gather_fn discipline (transport/tpu.py) applied to the write
+        #: path, so varying-shape device rounds share a handful of compiles.
+        self._scatter_cache: Dict[Tuple[int, int, int], object] = {}
 
     def _shm_staging(self, shuffle_id: int, nbytes: int):
         """Shared-memory staging for single-host zero-copy serving
@@ -349,13 +454,29 @@ class HbmBlockStore:
         as a RAM snapshot (bounded by host memory)."""
         snap = st.staging
         if self.conf.spill_to_disk:
-            snap = self._spill_round(st)
+            snap = self._spill_round(st, snap)
         st.prev_rounds.append((snap, st.region_used))
         st.staging = np.zeros_like(st.staging)
         st.region_used = np.zeros_like(st.region_used)
         st.round += 1
 
-    def _spill_round(self, st: _ShuffleState) -> np.ndarray:
+    def _rollover_device(self, st: _ShuffleState) -> None:
+        """Device-round analogue of ``_rollover``: materialize the full round
+        in HBM via the scatter kernel, pull it D2H ONCE as the round snapshot
+        (the spill boundary is where a host copy is unavoidable — HBM cannot
+        hold every round), and continue in a fresh device round (caller holds
+        self._lock).  The lazy host staging buffer stays unallocated."""
+        payload = self._materialize_device_round(st)
+        snap = np.asarray(payload).reshape(-1).view(np.uint8)
+        if self.conf.spill_to_disk:
+            snap = self._spill_round(st, snap)
+        st.prev_rounds.append((snap, st.region_used))
+        st.region_used = np.zeros_like(st.region_used)
+        st.device_pending = []
+        st.device_blocks = {}
+        st.round += 1
+
+    def _spill_round(self, st: _ShuffleState, staging: np.ndarray) -> np.ndarray:
         """Write the current round's staging to the disk tier; returns the
         memmap that replaces the RAM snapshot (caller holds self._lock).
 
@@ -381,12 +502,12 @@ class HbmBlockStore:
                 f"{nbytes} B round > spillDiskCap {cap} B"
             )
         path = os.path.join(self._spill_dir, f"s{st.shuffle_id}_r{st.round}.bin")
-        mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=st.staging.shape)
+        mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=staging.shape)
         for p in range(len(st.peer_ranges)):
             used = int(st.region_used[p])
             if used:
                 start = p * st.region_size
-                mm[start : start + used] = st.staging[start : start + used]
+                mm[start : start + used] = staging[start : start + used]
         mm.flush()
         st.spill_files.append((path, nbytes))
         self._spill_bytes += nbytes
@@ -417,6 +538,62 @@ class HbmBlockStore:
                 pass  # non-empty (foreign files) or already gone
             else:
                 self._spill_dir = None
+
+    # -- device staging rounds (conf.device_staging) -----------------------
+
+    def _scatter_fn(self, num_blocks: int, max_rows: int, out_rows: int):
+        """Compiled block scatter for the staging geometry, pow2-bucketed on
+        batch size and largest-block window so varying device rounds reuse a
+        handful of compiles (the exchange's ``_gather_fn`` discipline).
+        Returns ``(fn, bucketed_num_blocks)``; callers pad the plan arrays to
+        the bucket with zero-count entries."""
+        b = max(1 << max(num_blocks - 1, 0).bit_length(), 1)
+        w = max(1 << max(max_rows - 1, 0).bit_length(), 1)
+        key = (b, w, out_rows)
+        fn = self._scatter_cache.get(key)
+        if fn is None:
+            from sparkucx_tpu.ops.pallas_kernels import build_block_scatter
+
+            fn = build_block_scatter(b, out_rows, max_block_rows=w)
+            self._scatter_cache[key] = fn
+        return fn, b
+
+    def _materialize_device_round(self, st: _ShuffleState):
+        """Place the current device round's pending blocks into one
+        HBM-resident slot-layout array via the block-scatter kernel (caller
+        holds self._lock).  This is the zero-round-trip write path: the result
+        is exactly the ``(total_rows, lane)`` payload ``seal`` would otherwise
+        build on the host and ``device_put`` — but no host byte ever moves."""
+        import jax
+        import jax.numpy as jnp
+
+        lane = st.alignment // 4
+        total_rows = len(st.peer_ranges) * (st.region_size // st.alignment)
+        dst = jnp.zeros((total_rows, lane), dtype=jnp.int32)
+        if self.device is not None:
+            dst = jax.device_put(dst, self.device)
+        pending = st.device_pending
+        if not pending:
+            return dst
+        starts = np.asarray([p[0] for p in pending], dtype=np.int32)
+        counts = np.asarray([p[1] for p in pending], dtype=np.int32)
+        outs = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
+        total = int(counts.sum())
+        blocks = [p[2] for p in pending]
+        packed = blocks[0] if len(blocks) == 1 else jnp.concatenate(blocks, axis=0)
+        fn, b = self._scatter_fn(len(pending), int(counts.max()), total_rows)
+        if b > len(pending):
+            pad = b - len(pending)
+            starts = np.pad(starts, (0, pad))
+            counts = np.pad(counts, (0, pad))
+            outs = np.pad(outs, (0, pad), constant_values=total)
+        # One (3, B) upload instead of three tiny H2D transfers (same trick as
+        # the fetch path's plan upload, transport/tpu.py).
+        plan = np.stack([starts, counts, outs])
+        if self.device is not None:
+            plan = jax.device_put(plan, self.device)
+            packed = jax.device_put(packed, self.device)
+        return fn(plan[0], plan[1], plan[2], packed, dst)
 
     # -- write path --------------------------------------------------------
 
@@ -464,23 +641,31 @@ class HbmBlockStore:
             if st.sealed:
                 raise TransportError(f"shuffle {shuffle_id} already sealed")
             lane = st.alignment // 4
-            rounds = st.prev_rounds + [(st.staging, st.region_used)]
             out = []
-            # Staging (all rounds) stays host-resident until remove_shuffle — it
-            # is the shuffle's backing store, the same retention contract as
-            # Spark's map-output files on disk.  HBM is only committed one round
-            # at a time: the single-round common case seals straight to device;
-            # multi-round payloads are uploaded per-round by the exchange so
-            # device memory stays bounded by one round.
-            device_put_here = self.device is not None and len(rounds) == 1
-            for staging, used in rounds:
+            # Staging (completed rounds) stays host-resident until
+            # remove_shuffle — it is the shuffle's backing store, the same
+            # retention contract as Spark's map-output files on disk.  HBM is
+            # only committed one round at a time: the single-round common case
+            # seals straight to device; multi-round payloads are uploaded
+            # per-round by the exchange so device memory stays bounded by one
+            # round.
+            device_put_here = self.device is not None and not st.prev_rounds
+            for staging, used in st.prev_rounds:
                 payload = staging.view(np.int32).reshape(-1, lane)
-                sizes = (used // st.alignment).astype(np.int32)
+                out.append((payload, (used // st.alignment).astype(np.int32)))
+            final_sizes = (st.region_used // st.alignment).astype(np.int32)
+            if st.device_mode:
+                # Device write path: the final round seals as the HBM-resident
+                # scatter output — zero device_put, zero host staging; the
+                # per-block device arrays in device_blocks back read_block.
+                payload = self._materialize_device_round(st)
+            else:
+                payload = st.staging.view(np.int32).reshape(-1, lane)
                 if device_put_here:
                     import jax
 
                     payload = jax.device_put(payload, self.device)
-                out.append((payload, sizes))
+            out.append((payload, final_sizes))
             st.sealed_payload = [p for p, _ in out]
         return out
 
@@ -496,6 +681,13 @@ class HbmBlockStore:
         """Per-peer region size in bytes — public form of the staging geometry
         the transports need for offset math (was reached via ``_state``)."""
         return self._state(shuffle_id).region_size
+
+    def host_staging_allocated(self, shuffle_id: int) -> bool:
+        """True when the host staging buffer exists for this shuffle.  The
+        device write path's no-host-round-trip guarantee is observable here:
+        it stays False for device-staged shuffles (rollover snapshots live in
+        ``prev_rounds`` / the memmap spill tier, never in host staging)."""
+        return self._state(shuffle_id).host_staging_allocated
 
     def committed_map_ids(self, shuffle_id: int) -> frozenset:
         """Snapshot of map ids with a successful commit (getPartitonOffset-table
@@ -549,6 +741,16 @@ class HbmBlockStore:
         with self._lock:
             if e.round < len(st.prev_rounds):
                 staging = st.prev_rounds[e.round][0]
+            elif st.device_mode:
+                # Current device round: serve straight from the per-block
+                # device array (one tiny D2H) — there is no host staging.
+                rows = st.device_blocks.get((map_id, reduce_id))
+                if rows is None:
+                    raise TransportError(
+                        f"device block ({shuffle_id},{map_id},{reduce_id}) no longer resident"
+                    )
+                flat = np.asarray(rows).reshape(-1).view(np.uint8)
+                return flat[: e.length].tobytes()
             else:
                 staging = st.staging
             if staging is None:
@@ -569,6 +771,14 @@ class HbmBlockStore:
         if e is None:
             return None
         with self._lock:
+            if e.round >= len(st.prev_rounds) and st.device_mode:
+                rows = st.device_blocks.get((map_id, reduce_id))
+                if rows is None:
+                    return None
+                # Current device round: hand out a private host copy of the
+                # block (the device array can be superseded by a rollover).
+                flat = np.array(np.asarray(rows).reshape(-1).view(np.uint8)[: e.length])
+                return flat, 0, e.length
             staging = (
                 st.prev_rounds[e.round][0] if e.round < len(st.prev_rounds) else st.staging
             )
@@ -606,4 +816,6 @@ class HbmBlockStore:
             "region_size": st.region_size,
             "committed_maps": sorted(st.committed_maps),
             "sealed": st.sealed,
+            "device_mode": st.device_mode,
+            "host_staging_allocated": st.host_staging_allocated,
         }

@@ -93,7 +93,12 @@ class SpmdShuffleExecutor:
         )
         self.device = per_proc[self.executor_id]
 
-        self.store = HbmBlockStore(self.conf, executor_id=self.executor_id)
+        # The store seals onto this process's lead device, so device-staged
+        # rounds (conf.device_staging) hand the exchange an HBM-resident
+        # payload with no host round trip.
+        self.store = HbmBlockStore(
+            self.conf, device=self.device, executor_id=self.executor_id
+        )
         self.peer = PeerTransport(self.conf, executor_id=self.executor_id, store=self.store)
         self._mapper_infos: Dict[int, Dict[int, MapperInfo]] = {}
         self._recv: Dict[int, Tuple[List[np.ndarray], List[np.ndarray]]] = {}
@@ -222,7 +227,13 @@ class SpmdShuffleExecutor:
             submits rounds in the same order, whatever the depth)."""
             if rnd < len(rounds):
                 payload, sizes = rounds[rnd]
-                payload = rebucket_slots(np.asarray(payload), n, bucketed)
+                if isinstance(payload, jax.Array):
+                    # Sealed straight onto the device (device staging or the
+                    # single-round host seal): relocate slots on-device, no
+                    # host round trip; device_put is then a no-op pin.
+                    payload = rebucket_slots(payload, n, bucketed, xp=jnp)
+                else:
+                    payload = rebucket_slots(np.asarray(payload), n, bucketed)
             else:
                 payload = np.zeros((bucketed, lane), dtype=np.int32)
                 sizes = np.zeros(n, dtype=np.int32)

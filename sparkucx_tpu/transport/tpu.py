@@ -277,6 +277,18 @@ class TpuShuffleCluster:
         num_rounds = max(len(s) for s in sealed)
         first_payload = sealed[0][0][0]
         send_rows, lane = int(first_payload.shape[0]), int(first_payload.shape[1])
+        # Every executor's every round must share one (rows, lane) shape — the
+        # assembly below slices the global array at bucketed-row strides, so a
+        # divergent store geometry would mis-slice silently, not fail.
+        for eid, s in enumerate(sealed):
+            for rnd, (payload, _) in enumerate(s):
+                shape = (int(payload.shape[0]), int(payload.shape[1]))
+                if shape != (send_rows, lane):
+                    raise TransportError(
+                        f"executor {eid} sealed round {rnd} with shape {shape}, "
+                        f"expected {(send_rows, lane)} — mismatched staging "
+                        "geometry (stagingCapacity/blockAlignment) across executors"
+                    )
         fn = self._exchange_fn(send_rows)
         bucketed = bucket_send_rows(send_rows, self.num_executors)
 
@@ -585,12 +597,10 @@ class TpuShuffleCluster:
                 outs = np.pad(outs, (0, pad), constant_values=total)
             src = meta.recv_device[rnd][consumer]
             dev = src.device
-            packed = fn(
-                jax.device_put(starts, dev),
-                jax.device_put(counts, dev),
-                jax.device_put(outs, dev),
-                src,
-            )
+            # One (3, B) H2D upload for the whole gather plan instead of three
+            # tiny per-array transfers; split back on device (views, no copy).
+            plan = jax.device_put(np.stack([starts, counts, outs]), dev)
+            packed = fn(plan[0], plan[1], plan[2], src)
             segments.append(packed[:total])
             base += total
         if not segments:

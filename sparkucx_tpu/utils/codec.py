@@ -154,7 +154,7 @@ def _decode(payload: bytes, pos: int, depth: int = 0):
         if tag == b"j":
             return int.from_bytes(raw, "big", signed=True), pos
         if tag == b"s":
-            return raw.decode("utf-8"), pos
+            return str(raw, "utf-8"), pos
         return bytes(raw), pos
     if tag in (b"t", b"l", b"m"):
         _need(payload, pos, 4)
@@ -179,12 +179,14 @@ def _decode(payload: bytes, pos: int, depth: int = 0):
             item, pos = _decode(payload, pos, depth + 1)
             items.append(item)
         return (tuple(items) if tag == b"t" else items), pos
-    raise ValueError(f"unknown record tag {tag!r} at offset {pos - 1}")
+    raise ValueError(f"unknown record tag {bytes(tag)!r} at offset {pos - 1}")
 
 
-def decode_records(payload: bytes) -> Iterator[Any]:
+def decode_records(payload) -> Iterator[Any]:
     """Decode a stream of records; raises ``ValueError`` on any malformation
-    (unknown tag, truncation, over-deep nesting) — never executes anything."""
+    (unknown tag, truncation, over-deep nesting) — never executes anything.
+    ``payload`` may be any bytes-like (``bytes`` or a read-only ``memoryview``
+    served zero-copy by the fetch iterator, shuffle/reader.py)."""
     pos = 0
     n = len(payload)
     while pos < n:

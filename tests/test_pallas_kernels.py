@@ -13,7 +13,11 @@ import pytest
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import ShuffleBlockId
 from sparkucx_tpu.core.operation import TransportError
-from sparkucx_tpu.ops.pallas_kernels import build_block_gather, pack_plan
+from sparkucx_tpu.ops.pallas_kernels import (
+    build_block_gather,
+    build_block_scatter,
+    pack_plan,
+)
 from sparkucx_tpu.transport.tpu import TpuShuffleCluster
 
 ROW = 512
@@ -82,6 +86,115 @@ class TestGatherLowering:
     def test_unknown_impl(self):
         with pytest.raises(ValueError, match="unknown impl"):
             build_block_gather(1, 1, impl="bogus")
+
+
+OUT_ROWS = 256
+
+# (dst slot row, row count) pairs — non-overlapping dst windows, with empties
+SCATTER_PLANS = [
+    [(3, 5), (40, 0), (64, 8), (200, 3)],
+    [(0, 8), (16, 16), (250, 1)],
+    [(95, 5)],
+    [(0, 0)],
+]
+
+
+def _scatter_oracle(dst, src, starts, counts, outs):
+    exp = np.asarray(dst).copy()
+    s = np.asarray(src)
+    for start, count, out in zip(starts, counts, outs):
+        exp[start : start + count] = s[out : out + count]
+    return exp
+
+
+def _scatter_args(plan):
+    starts = np.asarray([s for s, _ in plan], dtype=np.int32)
+    counts = np.asarray([c for _, c in plan], dtype=np.int32)
+    outs = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
+    return starts, counts, outs, int(counts.sum())
+
+
+class TestScatterLowering:
+    """build_block_scatter — the inverse kernel: packed src -> slot-layout dst.
+
+    Every case pre-fills dst with a sentinel pattern and asserts both the
+    placed blocks AND that uncovered dst rows survive untouched (scatter is a
+    read-modify-write; a lowering that zeroes the staging buffer would pass a
+    blocks-only check while destroying earlier writes in the same round)."""
+
+    def _dst(self):
+        rng = np.random.default_rng(23)
+        return jax.numpy.asarray(
+            rng.integers(0, 1 << 30, size=(OUT_ROWS, LANE), dtype=np.int32)
+        )
+
+    @pytest.mark.parametrize("plan", SCATTER_PLANS)
+    def test_xla_matches_oracle(self, src, plan):
+        starts, counts, outs, total = _scatter_args(plan)
+        dst = self._dst()
+        fn = build_block_scatter(len(plan), OUT_ROWS, impl="xla")
+        out = np.asarray(fn(starts, counts, outs, src[: max(total, 1)], dst))
+        assert np.array_equal(out, _scatter_oracle(dst, src, starts, counts, outs))
+
+    @pytest.mark.parametrize("plan", SCATTER_PLANS[:3])
+    def test_tiled_interpret_matches_oracle(self, src, plan):
+        starts, counts, outs, total = _scatter_args(plan)
+        dst = self._dst()
+        fn = build_block_scatter(len(plan), OUT_ROWS, impl="tiled", interpret=True)
+        out = np.asarray(fn(starts, counts, outs, src[: max(total, 1)], dst))
+        assert np.array_equal(out, _scatter_oracle(dst, src, starts, counts, outs))
+
+    def test_tiled_covers_all_tail_shapes(self, src):
+        # every residue mod TILE_ROWS, including counts < TILE_ROWS
+        plan = [(i * 20, i + 1) for i in range(12)]
+        starts, counts, outs, total = _scatter_args(plan)
+        dst = self._dst()
+        fn = build_block_scatter(len(plan), OUT_ROWS, impl="tiled", interpret=True)
+        out = np.asarray(fn(starts, counts, outs, src[:total], dst))
+        assert np.array_equal(out, _scatter_oracle(dst, src, starts, counts, outs))
+
+    def test_xla_window_clamp_at_buffer_edge(self, src):
+        # regression: a block ending exactly at the last dst row must not have
+        # its dynamic_slice window clamped backwards (would shift src rows)
+        plan = [(OUT_ROWS - 7, 7)]
+        starts, counts, outs, total = _scatter_args(plan)
+        dst = self._dst()
+        fn = build_block_scatter(1, OUT_ROWS, impl="xla", max_block_rows=7)
+        out = np.asarray(fn(starts, counts, outs, src[:total], dst))
+        assert np.array_equal(out, _scatter_oracle(dst, src, starts, counts, outs))
+
+    def test_zero_count_padding_entries_are_noops(self, src):
+        # cache-bucket padding appends (0, 0, total) entries; they must not
+        # disturb dst row 0
+        starts = np.asarray([10, 0, 0], dtype=np.int32)
+        counts = np.asarray([4, 0, 0], dtype=np.int32)
+        outs = np.asarray([0, 4, 4], dtype=np.int32)
+        dst = self._dst()
+        for impl, interp in (("xla", False), ("tiled", True)):
+            fn = build_block_scatter(3, OUT_ROWS, impl=impl, interpret=interp)
+            out = np.asarray(fn(starts, counts, outs, src[:4], dst))
+            assert np.array_equal(
+                out, _scatter_oracle(dst, src, starts, counts, outs)
+            ), impl
+
+    def test_unknown_impl(self):
+        with pytest.raises(ValueError, match="unknown impl"):
+            build_block_scatter(1, 1, impl="bogus")
+
+    def test_dma_lowers_aot_for_tpu(self):
+        # AOT Mosaic lowering: the dma kernel must export for the tpu platform
+        # even from the CPU test mesh (catches pallas lowering regressions
+        # without hardware; same pattern as the radix-sort AOT test)
+        from jax import export as jax_export
+
+        import jax.numpy as jnp
+
+        fn = build_block_scatter(8, OUT_ROWS, impl="dma")
+        i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+        exported = jax_export.export(jax.jit(fn), platforms=["tpu"])(
+            i32(8), i32(8), i32(8), i32(64, LANE), i32(OUT_ROWS, LANE)
+        )
+        assert len(exported.mlir_module_serialized) > 0
 
 
 N_EXEC = 4
@@ -232,3 +345,18 @@ class TestDmaOnTpu:
         fn = build_block_gather(len(plan), total, impl="dma")
         out = np.asarray(fn(starts, counts, outs, src))
         assert np.array_equal(out[:total], _oracle(src, starts, counts))
+
+    def test_dma_scatter_matches_oracle(self, src):
+        plan = SCATTER_PLANS[0] + SCATTER_PLANS[1]
+        starts = np.asarray([s for s, _ in plan], dtype=np.int32)
+        counts = np.asarray([c for _, c in plan], dtype=np.int32)
+        outs = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
+        total = int(counts.sum())
+        rng = np.random.default_rng(23)
+        dst = jax.numpy.asarray(
+            rng.integers(0, 1 << 30, size=(OUT_ROWS, LANE), dtype=np.int32)
+        )
+        expect = _scatter_oracle(dst, src, starts, counts, outs)
+        fn = build_block_scatter(len(plan), OUT_ROWS, impl="dma")
+        out = np.asarray(fn(starts, counts, outs, src[:total], dst))
+        assert np.array_equal(out, expect)
