@@ -1,0 +1,17 @@
+#!/usr/bin/env python3
+"""Shuffle invariant analyzer CLI — thin wrapper over ``sparkucx_tpu.analysis``.
+
+Equivalent to ``python -m sparkucx_tpu.analysis``; exists so the gate is
+runnable from scripts/ like the rest of the repo tooling.  See
+docs/ANALYSIS.md for the pass catalogue and the allowlist policy.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sparkucx_tpu.analysis.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())

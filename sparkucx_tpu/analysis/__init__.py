@@ -1,0 +1,49 @@
+"""Shuffle invariant analyzer (static passes + shared allowlist).
+
+PR 1 and PR 2 made the hot path fast by adopting exactly the idioms that fail
+*silently* when misused: donated jit buffers, zero-copy pooled memoryviews,
+and a threaded round pipeline — the hazard classes SparkUCX manages by hand
+around registered RDMA memory and its progress thread.  This package keeps
+those invariants true mechanically as future PRs refactor freely.
+
+Pure stdlib (``ast`` + ``re``): importing it never imports jax or numpy, so
+the CLI (``python -m sparkucx_tpu.analysis``) runs on a bare interpreter and
+the fixture tests in ``tests/test_analysis.py`` stay jax-free.
+
+Passes (see docs/ANALYSIS.md for the conventions each one enforces):
+
+================  ==========================================================
+use-after-donate  reads of a local after it was passed into a donating jit
+                  call (``build_exchange`` arg 0, ``build_block_scatter``
+                  arg 4, literal ``donate_argnums``)
+lock-discipline   fields annotated ``#: guarded by self._lock`` mutated
+                  outside a ``with <lock>:`` block
+host-sync         blocking host syncs (``block_until_ready``, ``np.asarray``
+                  on non-literals, ``jax.device_get``) inside RoundPipeline
+                  submit/drain stages or code reachable from ``_run_exchange``
+cache-hygiene     raw shape/capacity parameters flowing into a compile cache
+                  key without pow2 bucketing (recompile-bomb detector)
+private-access    cross-object ``expr._name`` access (ex lint_private_access)
+required-surface  load-bearing public methods must keep existing (ex lint)
+================  ==========================================================
+
+The runtime half of this PR — the buffer sanitizer — lives in
+``sparkucx_tpu/memory/sanitizer.py`` (``spark.shuffle.tpu.sanitize``).
+"""
+
+from sparkucx_tpu.analysis.base import (  # noqa: F401
+    Finding,
+    analyze_tree,
+    is_allowlisted,
+    registered_passes,
+    run_source,
+)
+
+# Importing the pass modules registers them (base.register side effect).
+from sparkucx_tpu.analysis import (  # noqa: F401,E402
+    cache,
+    donation,
+    hostsync,
+    locks,
+    private,
+)

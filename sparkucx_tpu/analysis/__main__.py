@@ -1,0 +1,76 @@
+"""CLI: ``python -m sparkucx_tpu.analysis [--ci]``.
+
+Runs every registered pass over ``sparkucx_tpu/`` and exits non-zero on any
+finding not covered by a reviewed allowlist entry (analysis/config.py).
+Imports no jax/numpy — safe on a bare interpreter and cheap in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from sparkucx_tpu.analysis import analyze_tree, registered_passes
+from sparkucx_tpu.analysis.config import ALLOWLIST
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sparkucx_tpu.analysis",
+        description="sparkucx_tpu shuffle invariant analyzer",
+    )
+    parser.add_argument("--ci", action="store_true",
+                        help="quiet on success; non-zero exit on violations (same as default)")
+    parser.add_argument("--root", default=None,
+                        help="directory to analyze (default: the installed sparkucx_tpu/)")
+    parser.add_argument("--passes", default=None,
+                        help="comma-separated pass subset (default: all)")
+    parser.add_argument("--list-passes", action="store_true")
+    parser.add_argument("--show-allowlisted", action="store_true",
+                        help="also print findings suppressed by the allowlist")
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for name in sorted(registered_passes()):
+            print(name)
+        return 0
+
+    passes = args.passes.split(",") if args.passes else None
+    if passes:
+        unknown = sorted(set(passes) - set(registered_passes()))
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    violations, suppressed, num_files = analyze_tree(root=args.root, passes=passes)
+
+    if args.show_allowlisted:
+        for finding, entry in suppressed:
+            print(f"{finding.render()}  [allowlisted: {entry}]")
+    for finding in violations:
+        print(finding.render())
+
+    # an allowlist entry nothing matches is stale — surface it (warn, not fail)
+    if passes is None and args.root is None:
+        used = {entry for _, entry in suppressed}
+        for entry in sorted(ALLOWLIST - used):
+            print(f"warning: unused allowlist entry {entry}", file=sys.stderr)
+
+    npass = len(passes) if passes else len(registered_passes())
+    if violations:
+        print(
+            f"\n{len(violations)} violation(s) across {num_files} files "
+            f"({npass} passes, {len(suppressed)} allowlisted)",
+            file=sys.stderr,
+        )
+        return 1
+    if not args.ci:
+        print(
+            f"analysis clean: {num_files} files, {npass} passes, "
+            f"{len(suppressed)} allowlisted finding(s)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""Analyzer core: Finding, pass registry, tree walking, allowlist matching.
+
+A *pass* is a function ``(tree, source, rel_path) -> list[Finding]`` over one
+already-parsed module.  Passes never import the code under analysis — every
+check is AST + source-comment based, so the analyzer runs without jax (and the
+fixture tests feed it snippets that could never import).
+
+Allowlisting: entries live in :mod:`sparkucx_tpu.analysis.config` as
+``(file_suffix, pass_name, message_substring)`` triples, each with a reviewed
+justification comment (the ``lint_private_access.py`` discipline, inherited).
+A finding is allowlisted when the file matches the suffix, the pass matches
+(or the entry names ``"*"``), and the substring occurs in the message — the
+substring keeps entries narrow: they pin one construct, not a whole file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit, printed as ``path:line: [pass] message``."""
+
+    path: str  # package-relative, forward slashes (e.g. "transport/tpu.py")
+    line: int
+    pass_name: str
+    message: str
+
+    def render(self) -> str:
+        return f"sparkucx_tpu/{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+PassFn = Callable[[ast.Module, str, str], List[Finding]]
+
+_REGISTRY: Dict[str, PassFn] = {}
+
+
+def register(name: str) -> Callable[[PassFn], PassFn]:
+    """Decorator: add a pass to the registry under ``name``."""
+
+    def deco(fn: PassFn) -> PassFn:
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def registered_passes() -> Dict[str, PassFn]:
+    return dict(_REGISTRY)
+
+
+# ----------------------------------------------------------------------
+# allowlist
+
+
+def is_allowlisted(
+    finding: Finding, allowlist: Optional[Iterable[Tuple[str, str, str]]] = None
+) -> Optional[Tuple[str, str, str]]:
+    """Return the matching allowlist entry, or None."""
+    if allowlist is None:
+        from sparkucx_tpu.analysis.config import ALLOWLIST
+
+        allowlist = ALLOWLIST
+    for entry in allowlist:
+        suffix, pass_name, match = entry
+        if pass_name not in ("*", finding.pass_name):
+            continue
+        if suffix and not finding.path.endswith(suffix):
+            continue
+        if match in finding.message:
+            return entry
+    return None
+
+
+# ----------------------------------------------------------------------
+# drivers
+
+
+def run_source(
+    source: str,
+    passes: Optional[Sequence[str]] = None,
+    filename: str = "<fixture>",
+) -> List[Finding]:
+    """Run passes over one source string (the fixture-test entry point)."""
+    tree = ast.parse(source, filename=filename)
+    names = list(passes) if passes else sorted(_REGISTRY)
+    out: List[Finding] = []
+    for name in names:
+        out.extend(_REGISTRY[name](tree, source, filename))
+    out.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    return out
+
+
+def package_root() -> str:
+    """The sparkucx_tpu/ directory this analyzer ships inside."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def analyze_tree(
+    root: Optional[str] = None,
+    passes: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], List[Tuple[Finding, Tuple[str, str, str]]], int]:
+    """Run passes over every .py under ``root``.
+
+    Returns ``(violations, allowlisted, num_files)`` where ``allowlisted``
+    pairs each suppressed finding with the entry that matched it.
+    """
+    from sparkucx_tpu.analysis.config import ALLOWLIST
+
+    root = root or package_root()
+    names = list(passes) if passes else sorted(_REGISTRY)
+    violations: List[Finding] = []
+    suppressed: List[Tuple[Finding, Tuple[str, str, str]]] = []
+    num_files = 0
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            num_files += 1
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path) as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+            for name in names:
+                for finding in _REGISTRY[name](tree, source, rel):
+                    entry = is_allowlisted(finding, ALLOWLIST)
+                    if entry is not None:
+                        suppressed.append((finding, entry))
+                    else:
+                        violations.append(finding)
+    violations.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    suppressed.sort(key=lambda p: (p[0].path, p[0].line, p[0].pass_name))
+    return violations, suppressed, num_files
+
+
+# ----------------------------------------------------------------------
+# small AST helpers shared by passes
+
+
+def callee_name(call: ast.Call) -> Optional[str]:
+    """Bare name of the called function: ``f(...)`` -> f, ``a.b.f(...)`` -> f."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render ``a.b.c`` chains (Name/Attribute only) as a dotted string."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def docstring_of(fn: ast.AST) -> str:
+    try:
+        return ast.get_docstring(fn) or ""
+    except TypeError:
+        return ""

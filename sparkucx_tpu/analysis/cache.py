@@ -1,0 +1,194 @@
+"""Pass: compile-cache hygiene (recompile-bomb detector).
+
+Every compiled-exchange front-end in this codebase follows one discipline:
+shape-ish arguments are bucketed to powers of two *before* they become part
+of a compile-cache key (``bucket_send_rows`` in ``_exchange_fn``,
+``bit_length`` rounding in ``_gather_fn``/``_scatter_fn``), so a shuffle
+whose size wanders produces a handful of compiles instead of one per size —
+a recompile per round is a multi-second stall on TPU.
+
+The pass flags functions that (a) touch a compile cache — an attribute/name
+containing a configured marker (``cache``, ``_fns``) used with ``.get(key)``
+or ``[key] = ...``, or an ``@lru_cache`` decorator — AND (b) call a jit
+builder (``build_*`` / ``jax.jit``), where (c) a *parameter* with a shape-ish
+name (rows/size/count/blocks/…) appears raw in the cache key without having
+been rebound through a bucketing call first.  ``@lru_cache`` builders key on
+the raw arguments by construction, so every shape-ish parameter of one is
+flagged — bucket at the call site or switch to an explicit keyed dict.
+
+Only parameters are checked: locals derived inside the function are assumed
+to have gone through whatever derivation the author chose (the
+``bucketed = bucket_send_rows(...)`` idiom produces a fresh name, which is
+the point — raw and bucketed values never share a spelling).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from sparkucx_tpu.analysis.base import Finding, callee_name, register
+from sparkucx_tpu.analysis.config import (
+    BUCKETING_MARKERS,
+    BUILDER_NAMES,
+    BUILDER_PREFIXES,
+    CACHE_NAME_MARKERS,
+)
+
+PASS = "cache-hygiene"
+
+_SHAPEY = re.compile(
+    r"rows|size|count|blocks|capacity|depth|width|bytes|num_|_num|length", re.I
+)
+
+
+def _is_cache_name(name: Optional[str]) -> bool:
+    return bool(name) and any(m in name for m in CACHE_NAME_MARKERS)
+
+
+def _attr_or_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_builder_call(node: ast.Call) -> bool:
+    name = callee_name(node)
+    if name is None:
+        return False
+    return name in BUILDER_NAMES or any(name.startswith(p) for p in BUILDER_PREFIXES)
+
+
+def _is_bucketing_expr(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            name = callee_name(sub)
+            if name in BUCKETING_MARKERS:
+                return True
+    return False
+
+
+def _params_of(fn) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _lru_cached(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _attr_or_name(target) in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+class _CacheUse:
+    """One ``<cache>.get(key)`` / ``<cache>[key] = ...`` site."""
+
+    def __init__(self, cache_name: str, key: ast.AST, line: int) -> None:
+        self.cache_name = cache_name
+        self.key = key
+        self.line = line
+
+
+def _cache_uses(fn) -> List[_CacheUse]:
+    uses: List[_CacheUse] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("get", "setdefault")
+                and _is_cache_name(_attr_or_name(f.value))
+                and node.args
+            ):
+                uses.append(_CacheUse(_attr_or_name(f.value), node.args[0], node.lineno))
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and _is_cache_name(_attr_or_name(t.value)):
+                    uses.append(_CacheUse(_attr_or_name(t.value), t.slice, node.lineno))
+    return uses
+
+
+def _key_names(fn, key: ast.AST) -> Set[str]:
+    """Bare names participating in the key; a Name key resolves one level
+    through a local ``key = (...)`` tuple assignment."""
+    if isinstance(key, ast.Name):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == key.id for t in node.targets
+            ):
+                key = node.value
+                break
+    names: Set[str] = set()
+    for sub in ast.walk(key):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            names.add(sub.id)
+    return names
+
+
+def _bucketed_params(fn) -> Set[str]:
+    """Parameters rebound through a bucketing expression anywhere in the
+    function (``send_rows = bucket_send_rows(send_rows, n)``)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_bucketing_expr(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+@register(PASS)
+def check(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_builder = any(
+            isinstance(n, ast.Call) and _is_builder_call(n) for n in ast.walk(fn)
+        )
+        # an @lru_cache'd build_* function IS the builder — its body need not
+        # call another one for the cache key to matter
+        is_builder_def = any(fn.name.startswith(p) for p in BUILDER_PREFIXES)
+        if not has_builder and not (is_builder_def and _lru_cached(fn)):
+            continue
+        shapey_params = [p for p in _params_of(fn) if _SHAPEY.search(p)]
+        if not shapey_params:
+            continue
+        if _lru_cached(fn):
+            for p in shapey_params:
+                findings.append(
+                    Finding(
+                        path,
+                        fn.lineno,
+                        PASS,
+                        f"@lru_cache jit builder '{fn.name}' keys on raw shape "
+                        f"argument '{p}' — recompile bomb; bucket at the call "
+                        f"site (bucket_send_rows / pow2) or key an explicit dict",
+                    )
+                )
+            continue
+        uses = _cache_uses(fn)
+        if not uses:
+            continue
+        bucketed = _bucketed_params(fn)
+        seen: Set[str] = set()
+        for use in uses:
+            for p in _key_names(fn, use.key):
+                if p in shapey_params and p not in bucketed and p not in seen:
+                    seen.add(p)
+                    findings.append(
+                        Finding(
+                            path,
+                            use.line,
+                            PASS,
+                            f"shape argument '{p}' flows un-bucketed into "
+                            f"compile cache '{use.cache_name}' in '{fn.name}' "
+                            f"— recompile bomb (bucket_send_rows / pow2 first)",
+                        )
+                    )
+    return findings

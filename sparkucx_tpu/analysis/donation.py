@@ -1,0 +1,221 @@
+"""Pass: use-after-donate.
+
+A donated jit argument's buffer is invalid the moment the call returns — XLA
+reused its memory for the output.  Reading the Python name afterwards returns
+garbage (TPU) or works by accident (CPU backend ignores donation), which is
+the worst kind of bug: green tests, corrupt shuffles in production.
+
+The pass tracks, per function scope and in lexical order:
+
+* names bound to donating callables — ``fn = build_exchange(...)`` (table in
+  config.DONATING_BUILDERS), ``fn, b = self._scatter_fn(...)`` (tuple
+  builders), and direct ``jax.jit(..., donate_argnums=<literal>)``;
+* donation events — a call through such a name marks the ``ast.Name``
+  arguments at the donating positions as dead;
+* reads — a later ``Load`` of a dead name is a finding; a ``Store`` (or
+  ``del``) revives it.  ``cur, _ = fn(cur, sizes)`` is the sanctioned idiom:
+  the read happens before the donation, the rebind after.
+
+Known limits (accepted — this is a linter, not an escape analysis): aliases
+(``y = x``) are not tracked through the donation, loop back-edges are not
+modeled (a donate-at-bottom/read-at-top loop escapes), and branches are
+merged by union.  Conditional donation (build_exchange donates only when
+send_rows == recv_rows) is treated as always-donating: may-donate means
+must-not-reuse.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from sparkucx_tpu.analysis.base import Finding, callee_name, register
+from sparkucx_tpu.analysis.config import DONATING_BUILDERS, TUPLE_DONATING_BUILDERS
+
+PASS = "use-after-donate"
+
+#: literal-ish nodes we refuse to treat as donated variables
+_LITERALS = (ast.Constant, ast.List, ast.Tuple, ast.Dict, ast.Set)
+
+
+def _jit_donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """``jax.jit(..., donate_argnums=<int or tuple literal>)`` -> positions."""
+    if callee_name(call) != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, ast.Tuple) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int) for e in v.elts
+        ):
+            return tuple(e.value for e in v.elts)
+    return None
+
+
+class _Scope:
+    """Per-function donation state: builder bindings + dead names."""
+
+    def __init__(self, donating: Optional[Dict[str, Tuple[int, ...]]] = None) -> None:
+        # name -> donated positions of the callable bound to it
+        self.donating: Dict[str, Tuple[int, ...]] = dict(donating or {})
+        # name -> (line it was donated on, callable name that ate it)
+        self.donated: Dict[str, Tuple[int, str]] = {}
+
+
+class _Analyzer:
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.findings: List[Finding] = []
+
+    # -- expression handling (reads first, then donations) ---------------
+
+    def _reads(self, expr: ast.AST, scope: _Scope) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                dead = scope.donated.get(sub.id)
+                if dead is not None:
+                    line, via = dead
+                    self.findings.append(
+                        Finding(
+                            self.path,
+                            sub.lineno,
+                            PASS,
+                            f"read of '{sub.id}' after it was donated to "
+                            f"'{via}' at line {line} (buffer is dead post-call)",
+                        )
+                    )
+
+    def _donations(self, expr: ast.AST, scope: _Scope) -> None:
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call) or not isinstance(sub.func, ast.Name):
+                continue
+            positions = scope.donating.get(sub.func.id)
+            if positions is None:
+                continue
+            for p in positions:
+                if p < len(sub.args) and isinstance(sub.args[p], ast.Name):
+                    name = sub.args[p].id
+                    scope.donated[name] = (sub.lineno, sub.func.id)
+
+    def _expr(self, expr: Optional[ast.AST], scope: _Scope) -> None:
+        if expr is None:
+            return
+        self._reads(expr, scope)
+        self._donations(expr, scope)
+
+    # -- binding handling -------------------------------------------------
+
+    def _store(self, target: ast.AST, scope: _Scope) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                scope.donated.pop(sub.id, None)
+                scope.donating.pop(sub.id, None)
+
+    def _bind_builders(self, targets: List[ast.AST], value: ast.AST, scope: _Scope) -> None:
+        if not isinstance(value, ast.Call) or len(targets) != 1:
+            return
+        name = callee_name(value)
+        target = targets[0]
+        positions = DONATING_BUILDERS.get(name)
+        if positions is None:
+            positions = _jit_donated_positions(value)
+        if positions is not None and isinstance(target, ast.Name):
+            scope.donating[target.id] = positions
+            return
+        tuple_positions = TUPLE_DONATING_BUILDERS.get(name)
+        if (
+            tuple_positions is not None
+            and isinstance(target, ast.Tuple)
+            and target.elts
+            and isinstance(target.elts[0], ast.Name)
+        ):
+            scope.donating[target.elts[0].id] = tuple_positions
+
+    # -- statement walk ----------------------------------------------------
+
+    def block(self, stmts: List[ast.stmt], scope: _Scope) -> None:
+        for st in stmts:
+            self.stmt(st, scope)
+
+    def _branch(self, scope: _Scope, bodies: List[List[ast.stmt]]) -> None:
+        """Exclusive branches: run each on a copy, merge by union (a donation
+        in one arm must still poison reads after the join)."""
+        merged_donated = dict(scope.donated)
+        merged_donating = dict(scope.donating)
+        for body in bodies:
+            sub = _Scope(scope.donating)
+            sub.donated = dict(scope.donated)
+            self.block(body, sub)
+            merged_donated.update(sub.donated)
+            merged_donating.update(sub.donating)
+        scope.donated = merged_donated
+        scope.donating = merged_donating
+
+    def stmt(self, st: ast.stmt, scope: _Scope) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = _Scope(scope.donating)  # closures see outer builder bindings
+            args = st.args
+            for a in (
+                list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            ):
+                inner.donating.pop(a.arg, None)
+            self.block(st.body, inner)
+        elif isinstance(st, ast.ClassDef):
+            self.block(st.body, _Scope(scope.donating))
+        elif isinstance(st, ast.Assign):
+            self._expr(st.value, scope)
+            for t in st.targets:
+                self._store(t, scope)
+            self._bind_builders(st.targets, st.value, scope)
+        elif isinstance(st, ast.AnnAssign):
+            self._expr(st.value, scope)
+            self._store(st.target, scope)
+            if st.value is not None:
+                self._bind_builders([st.target], st.value, scope)
+        elif isinstance(st, ast.AugAssign):
+            self._expr(st.value, scope)
+            self._expr(st.target, scope)  # augmented target is read too
+            self._store(st.target, scope)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._store(t, scope)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._expr(st.test, scope)
+            self._branch(scope, [st.body, st.orelse])
+        elif isinstance(st, ast.For):
+            self._expr(st.iter, scope)
+            self._store(st.target, scope)
+            self._branch(scope, [st.body, st.orelse])
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._expr(item.context_expr, scope)
+                if item.optional_vars is not None:
+                    self._store(item.optional_vars, scope)
+            self.block(st.body, scope)
+        elif isinstance(st, ast.Try):
+            self.block(st.body, scope)
+            for h in st.handlers:
+                self.block(h.body, scope)
+            self.block(st.orelse, scope)
+            self.block(st.finalbody, scope)
+        elif isinstance(st, (ast.Return, ast.Expr, ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(st):
+                self._expr(child, scope)
+        else:
+            # import / global / pass / break / continue — nothing to track,
+            # but still scan any expressions for reads of dead names.
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child, scope)
+
+
+@register(PASS)
+def check(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    analyzer = _Analyzer(path)
+    analyzer.block(tree.body, _Scope())
+    return analyzer.findings

@@ -1,0 +1,167 @@
+"""Pass: host-sync-in-pipeline.
+
+RoundPipeline earns its overlap only if the submit lane never blocks on the
+device: one stray ``np.asarray(device_array)`` in a submit callback serializes
+the whole depth-d pipeline back to depth 1 — silently, with no failing test,
+just a flat perf curve.  This pass walks the module-local call graph from
+
+* every ``RoundPipeline(depth, submit, drain, ...)`` construction — the
+  2nd/3rd positional (or ``submit=``/``drain=`` keyword) callbacks, and
+* the configured roots (``_run_exchange``),
+
+and flags blocking host syncs anywhere inside: ``block_until_ready`` (both
+``jax.block_until_ready(x)`` and ``x.block_until_ready()``),
+``jax.device_get``, and ``np.asarray``/``np.array`` whose first argument is a
+variable (literal list/tuple arguments are host-born and skipped — the
+static approximation of "on device values").
+
+Findings carry the lane in the message (``submit stage '_submit'`` /
+``drain stage '_drain' (via '_memmap_round')``) so the allowlist can bless
+the drain lane — the pipeline's sanctioned host-sync point — while keeping
+submit-lane findings hard errors.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from sparkucx_tpu.analysis.base import Finding, callee_name, dotted_name, register
+from sparkucx_tpu.analysis.config import HOST_SYNC_ROOTS
+
+PASS = "host-sync"
+
+_LITERALS = (
+    ast.Constant, ast.List, ast.Tuple, ast.Dict, ast.Set,
+    ast.ListComp, ast.DictComp, ast.SetComp, ast.GeneratorExp,
+)
+
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    """Return a human name if this call is a blocking host sync."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "block_until_ready":
+            return "block_until_ready"
+        if func.attr == "device_get" and dotted_name(func) == "jax.device_get":
+            return "jax.device_get"
+        if func.attr in ("asarray", "array"):
+            base = dotted_name(func.value)
+            if base in ("np", "numpy"):
+                if node.args and not isinstance(node.args[0], _LITERALS):
+                    return f"np.{func.attr}"
+    return None
+
+
+def _index_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    """name -> FunctionDef for every def in the module (nested included).
+    Shadowed names keep the first definition — good enough for a per-module
+    reachability sketch."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _own_nodes(fn: ast.AST):
+    """All descendant nodes EXCLUDING nested function bodies — those are
+    separate graph nodes, labeled and scanned through their own call edges."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_callees(fn: ast.AST) -> List[str]:
+    """Names this function calls that could resolve module-locally: bare
+    ``f(...)`` and ``self.f(...)``, plus bare-name callback references."""
+    out: List[str] = []
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.append(f.id)
+            elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("self", "cls"):
+                out.append(f.attr)
+    return out
+
+
+def _callback_name(node: ast.AST) -> Optional[str]:
+    """A stage callback reference: bare ``_submit`` or bound ``self._submit``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
+def _pipeline_stages(tree: ast.Module) -> List[Tuple[str, str]]:
+    """[(role, function_name)] for every RoundPipeline(...) construction."""
+    stages: List[Tuple[str, str]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and callee_name(node) == "RoundPipeline"):
+            continue
+        # positional: RoundPipeline(depth, submit, drain, ...)
+        for idx, role in ((1, "submit"), (2, "drain")):
+            if idx < len(node.args):
+                name = _callback_name(node.args[idx])
+                if name is not None:
+                    stages.append((role, name))
+        for kw in node.keywords:
+            if kw.arg in ("submit", "drain"):
+                name = _callback_name(kw.value)
+                if name is not None:
+                    stages.append((kw.arg, name))
+    return stages
+
+
+@register(PASS)
+def check(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    functions = _index_functions(tree)
+    # label per function name: where it sits in the pipeline ("submit stage
+    # '_submit'", "reachable from '_run_exchange'", possibly "(via 'helper')")
+    labels: Dict[str, str] = {}
+    queue: List[str] = []
+
+    # Stages are seeded first so the stage label wins over plain reachability.
+    for role, name in _pipeline_stages(tree):
+        if name in functions and name not in labels:
+            labels[name] = f"pipeline {role} stage '{name}'"
+            queue.append(name)
+    for root in HOST_SYNC_ROOTS:
+        if root in functions and root not in labels:
+            labels[root] = f"'{root}'"
+            queue.append(root)
+
+    while queue:
+        name = queue.pop(0)
+        base = labels[name]
+        for callee in _local_callees(functions[name]):
+            if callee in functions and callee not in labels:
+                root_label = base.split(" (via ")[0]
+                labels[callee] = f"{root_label} (via '{callee}')"
+                queue.append(callee)
+
+    findings: List[Finding] = []
+    for name, label in labels.items():
+        fn = functions[name]
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                what = _blocking_call(node)
+                if what is not None:
+                    findings.append(
+                        Finding(
+                            path,
+                            node.lineno,
+                            PASS,
+                            f"blocking host sync '{what}' in {label} — "
+                            f"stalls the pipeline lane",
+                        )
+                    )
+    return findings

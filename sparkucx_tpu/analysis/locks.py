@@ -1,0 +1,197 @@
+"""Pass: lock-discipline.
+
+Convention (docs/ANALYSIS.md): a field whose mutation must happen under a lock
+carries the annotation
+
+    self._shuffles = {}  #: guarded by self._lock
+
+on the assignment line (or the annotation comment sits on the line directly
+above — dataclass field style).  The pass then flags, module-wide, every
+mutation of that field name — plain/aug/subscript assignment and mutator
+method calls (``.append``/``.update``/...) — that is not lexically inside a
+``with <...><lock>:`` block whose lock's final component matches the
+annotated lock name.
+
+Escapes, both deliberate conventions rather than holes:
+
+* ``__init__`` bodies are exempt (construction happens-before sharing);
+* a function whose docstring contains ``caller holds`` + the lock name is
+  exempt — the documented private-helper contract already used by
+  ``HbmBlockStore._rollover`` and friends.  The docstring is the contract;
+  the analyzer makes writing it mandatory.
+
+``ast`` drops comments, so annotations are collected with a line scan of the
+source before the AST walk — which is also why the annotation syntax is a
+comment, not a decorator: it works on dataclass fields and plain assignments
+alike and costs nothing at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from sparkucx_tpu.analysis.base import Finding, docstring_of, register
+
+PASS = "lock-discipline"
+
+_GUARD_RE = re.compile(r"#:\s*guarded by\s+([A-Za-z_][\w.]*)")
+_SELF_FIELD_RE = re.compile(r"(?:self|cls)\.(\w+)\s*(?::[^=]+)?=(?!=)")
+_DATACLASS_FIELD_RE = re.compile(r"^\s*(\w+)\s*:")
+
+#: method names that mutate their receiver in place
+MUTATORS = {
+    "append", "extend", "insert", "pop", "popitem", "popleft", "appendleft",
+    "add", "remove", "discard", "clear", "update", "setdefault",
+}
+
+
+def collect_guards(source: str) -> Dict[str, str]:
+    """Scan for ``#: guarded by <lock>`` annotations -> {field: lock_name}.
+
+    The lock is remembered by its final dotted component (``self._tag_lock``
+    -> ``_tag_lock``) so holding a *different* lock never satisfies it.
+    """
+    guards: Dict[str, str] = {}
+    lines = source.splitlines()
+    for i, line in enumerate(lines):
+        m = _GUARD_RE.search(line)
+        if m is None:
+            continue
+        lock = m.group(1).rsplit(".", 1)[-1]
+        code = line[: m.start()]
+        fm = _SELF_FIELD_RE.search(code) or _DATACLASS_FIELD_RE.match(code)
+        if fm is None:
+            # annotation-on-its-own-line style: field is on the next code line
+            for j in range(i + 1, min(i + 4, len(lines))):
+                nxt = lines[j]
+                if not nxt.strip() or nxt.lstrip().startswith("#"):
+                    continue
+                fm = _SELF_FIELD_RE.search(nxt) or _DATACLASS_FIELD_RE.match(nxt)
+                break
+        if fm is not None:
+            guards[fm.group(1)] = lock
+    return guards
+
+
+def _lock_names_in(expr: ast.AST) -> Set[str]:
+    """Lock-ish identifiers in a ``with`` item (final components containing
+    'lock'): ``with self._tag_lock:`` -> {'_tag_lock'}."""
+    out: Set[str] = set()
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name and "lock" in name.lower():
+            out.add(name)
+    return out
+
+
+class _LockVisitor(ast.NodeVisitor):
+    def __init__(self, guards: Dict[str, str], path: str) -> None:
+        self.guards = guards
+        self.path = path
+        self.findings: List[Finding] = []
+        self.held: List[str] = []  # stack of held lock names
+        self.exempt = 0  # __init__ / documented caller-holds depth
+
+    # -- context tracking --------------------------------------------------
+
+    def _visit_with(self, node) -> None:
+        names: Set[str] = set()
+        for item in node.items:
+            names |= _lock_names_in(item.context_expr)
+        self.held.extend(names)
+        self.generic_visit(node)
+        del self.held[len(self.held) - len(names):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def _visit_func(self, node) -> None:
+        doc = docstring_of(node).lower()
+        exempt = node.name == "__init__" or ("caller holds" in doc and "lock" in doc)
+        self.exempt += exempt
+        self.generic_visit(node)
+        self.exempt -= exempt
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- mutation sites ----------------------------------------------------
+
+    def _flag(self, field: str, line: int, how: str) -> None:
+        if self.exempt:
+            return
+        lock = self.guards[field]
+        if lock in self.held:
+            return
+        self.findings.append(
+            Finding(
+                self.path,
+                line,
+                PASS,
+                f"unguarded {how} of '{field}' (annotated '#: guarded by "
+                f"{lock}'; held locks: {sorted(set(self.held)) or 'none'})",
+            )
+        )
+
+    def _check_target(self, target: ast.AST, line: int) -> None:
+        if isinstance(target, ast.Attribute) and target.attr in self.guards:
+            self._flag(target.attr, line, "write")
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Attribute) and base.attr in self.guards:
+                self._flag(base.attr, line, "item write")
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._check_target(el, line)
+        elif isinstance(target, ast.Starred):
+            self._check_target(target.value, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+            base = func.value
+            field: Optional[str] = None
+            if isinstance(base, ast.Attribute) and base.attr in self.guards:
+                field = base.attr
+            elif isinstance(base, ast.Subscript):
+                inner = base.value
+                if isinstance(inner, ast.Attribute) and inner.attr in self.guards:
+                    field = inner.attr
+            if field is not None:
+                self._flag(field, node.lineno, f"mutator call '.{func.attr}()'")
+        self.generic_visit(node)
+
+
+@register(PASS)
+def check(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    guards = collect_guards(source)
+    if not guards:
+        return []
+    visitor = _LockVisitor(guards, path)
+    visitor.visit(tree)
+    return visitor.findings

@@ -1,0 +1,77 @@
+"""Passes: private-access + required-surface (ex scripts/lint_private_access.py).
+
+Folded into the analyzer so CI runs ONE gate; the old script remains as a
+thin shim.  Semantics are unchanged:
+
+* private-access — flags ``expr._name`` attribute access where ``expr`` is
+  not ``self``/``cls`` (reaching into another object's internals rots) and
+  ``from module import _name`` of private names across modules.  Allowed:
+  ``self._x``, ``cls._x``, dunders, ``_``-prefixed locals/params themselves.
+* required-surface — asserts the load-bearing public methods in
+  config.REQUIRED_SURFACE still exist (AST only, no import), so a rename
+  fails here before it fails at runtime in another layer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from sparkucx_tpu.analysis.base import Finding, register
+from sparkucx_tpu.analysis.config import REQUIRED_SURFACE
+
+PRIVATE_PASS = "private-access"
+SURFACE_PASS = "required-surface"
+
+
+@register(PRIVATE_PASS)
+def check_private(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+            if not name.startswith("_") or name.startswith("__"):
+                continue
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                continue
+            # self.x._y is still private access on x's internals — flag unless
+            # the full chain starts at self AND the private attr is on self
+            out.append(Finding(path, node.lineno, PRIVATE_PASS,
+                               f"private attribute access: .{name}"))
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name.startswith("_") and not alias.name.startswith("__"):
+                    out.append(Finding(path, node.lineno, PRIVATE_PASS,
+                                       f"private import: {alias.name} from {node.module}"))
+    return out
+
+
+@register(SURFACE_PASS)
+def check_surface(tree: ast.Module, source: str, path: str) -> List[Finding]:
+    want = None
+    for sfx, classes in REQUIRED_SURFACE.items():
+        if path.endswith(sfx):
+            want = classes
+    if want is None:
+        return []
+    methods = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods[node.name] = {
+                n.name
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+    out: List[Finding] = []
+    for cls, names in want.items():
+        have = methods.get(cls)
+        if have is None:
+            out.append(Finding(path, 1, SURFACE_PASS,
+                               f"required public surface: class {cls} missing"))
+            continue
+        for name in names:
+            if name not in have:
+                out.append(Finding(path, 1, SURFACE_PASS,
+                                   f"required public surface: {cls}.{name} missing"))
+    return out

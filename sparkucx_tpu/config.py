@@ -176,6 +176,12 @@ class TpuShuffleConf:
 
     # instrumentation
     collect_stats: bool = True
+    #: Runtime buffer sanitizer (memory/sanitizer.py): track pooled-handle
+    #: lifecycles, poison freed host buffers with 0xDD, and RAISE on
+    #: double-release / use-after-release / re-pooling a buffer with live
+    #: exported views.  Debug tool — default off; in normal mode release
+    #: stays idempotent (see MemoryBlock.close / BlockFetchResult.release).
+    sanitize: bool = False
 
     # ------------------------------------------------------------------
     @classmethod
@@ -239,6 +245,7 @@ class TpuShuffleConf:
             ("reduceMemoryBudget", "reduce_memory_budget", parse_size),
             ("pipelineDepth", "pipeline_depth", int),
             ("deviceStaging", "device_staging", lambda v: str(v).lower() == "true"),
+            ("sanitize", "sanitize", lambda v: str(v).lower() == "true"),
         ]:
             v = get(name)
             if v is not None:

@@ -98,6 +98,10 @@ class MemoryBlock:
     allocator_token: Optional[object] = field(default=None, repr=False)
     _on_close: Optional[callable] = field(default=None, repr=False)
     _closed: bool = field(default=False, repr=False)
+    #: sanitize-mode hook (memory/sanitizer.py): called on a close() of an
+    #: already-closed block.  Normal mode leaves it None and close() stays
+    #: idempotent — the documented contract free-list parking depends on.
+    _on_double_close: Optional[callable] = field(default=None, repr=False)
 
     def host_view(self) -> np.ndarray:
         """1-D uint8 view of the first ``size`` bytes (host memory only)."""
@@ -112,10 +116,18 @@ class MemoryBlock:
 
     def close(self) -> None:
         if self._closed:
+            if self._on_double_close is not None:
+                self._on_double_close(self)  # raises under sanitize mode
             return
         self._closed = True
         if self._on_close is not None:
-            self._on_close(self)
+            try:
+                self._on_close(self)
+            except BaseException:
+                # A failed recycle (e.g. sanitize-mode live-view raise) must
+                # leave the block checked out and closeable, not half-dead.
+                self._closed = False
+                raise
 
     def rearm(self) -> None:
         """Allocator checkout hook: make ``close()`` live again after a pooled

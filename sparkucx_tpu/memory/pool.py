@@ -28,6 +28,7 @@ import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import MemoryBlock
+from sparkucx_tpu.memory import sanitizer as _sanitizer
 
 
 def round_up_to_next_power_of_two(size: int) -> int:
@@ -85,15 +86,22 @@ class _Slab:
 class AllocatorStack:
     """Free-stack of equal-sized buffers for one bucket (MemoryPool.scala:55-110)."""
 
-    def __init__(self, size: int, min_allocation_size: int, alignment: int = _DEFAULT_ALIGNMENT) -> None:
+    def __init__(
+        self,
+        size: int,
+        min_allocation_size: int,
+        alignment: int = _DEFAULT_ALIGNMENT,
+        sanitizer: Optional[_sanitizer.BufferSanitizer] = None,
+    ) -> None:
         self.size = size
         self.min_allocation_size = min_allocation_size
         self.alignment = alignment
-        self._free: List[MemoryBlock] = []
-        self._slabs: List[_Slab] = []
+        self.sanitizer = sanitizer or _sanitizer.DISABLED
+        self._free: List[MemoryBlock] = []  #: guarded by self._lock
+        self._slabs: List[_Slab] = []  #: guarded by self._lock
         self._lock = threading.Lock()
-        self.total_allocated = 0  # bytes of backing allocations
-        self.total_requested = 0  # get() count for stats
+        self.total_allocated = 0  #: guarded by self._lock (bytes of backing allocations)
+        self.total_requested = 0  #: guarded by self._lock (get() count for stats)
 
     def _carve(self, slab: _Slab) -> List[MemoryBlock]:
         """Split a slab into ``size``-byte refcounted views."""
@@ -110,17 +118,27 @@ class AllocatorStack:
         def recycle(mb: MemoryBlock, _slab=slab) -> None:
             # _closed stays True while the block sits in the free stack (re-armed
             # at checkout in get()) so a stale holder's second close() is a no-op
-            # instead of a double-free.
+            # instead of a double-free.  Sanitize mode runs first: it raises on
+            # live exported views (block stays checked out) and poisons the
+            # bucket bytes before the handle becomes claimable again.
+            self.sanitizer.on_release(mb)
             with _slab.lock:
                 _slab.refcount -= 1
             with self._lock:
                 self._free.append(mb)
 
-        mb = MemoryBlock(data=view, size=self.size, is_host_memory=True, _on_close=recycle)
+        mb = MemoryBlock(
+            data=view,
+            size=self.size,
+            is_host_memory=True,
+            _on_close=recycle,
+            _on_double_close=self.sanitizer.on_double_release,
+        )
         mb.allocator_token = slab
         return mb
 
     def _allocate_more(self) -> None:
+        """Grow the free list by one slab; caller holds ``self._lock``."""
         # Small buckets allocate min_allocation_size slabs and carve them up;
         # buckets >= the slab size allocate exactly one buffer (MemoryPool.scala:64-70).
         alloc_size = max(self.size, self.min_allocation_size)
@@ -143,6 +161,7 @@ class AllocatorStack:
             with slab.lock:
                 slab.refcount += 1
             mb.rearm()
+        self.sanitizer.on_checkout(mb)
         return mb
 
     def preallocate(self, count: int) -> None:
@@ -181,9 +200,13 @@ class MemoryPool:
 
     def __init__(self, conf: Optional[TpuShuffleConf] = None) -> None:
         self.conf = conf or TpuShuffleConf()
-        self._stacks: Dict[int, AllocatorStack] = {}
+        #: lifecycle tracker (conf.sanitize; no-op when disabled) — public so
+        #: the reader attaches view bookkeeping without reaching into pool
+        #: internals (analysis: private-access pass)
+        self.sanitizer = _sanitizer.from_conf(self.conf)
+        self._stacks: Dict[int, AllocatorStack] = {}  #: guarded by self._lock
         self._lock = threading.Lock()
-        self._closed = False
+        self._closed = False  #: guarded by self._lock
 
     def _bucket(self, size: int) -> int:
         return max(round_up_to_next_power_of_two(size), self.conf.min_buffer_size)
@@ -194,7 +217,9 @@ class MemoryPool:
                 raise RuntimeError("MemoryPool is closed")
             stack = self._stacks.get(bucket)
             if stack is None:
-                stack = AllocatorStack(bucket, self.conf.min_allocation_size)
+                stack = AllocatorStack(
+                    bucket, self.conf.min_allocation_size, sanitizer=self.sanitizer
+                )
                 self._stacks[bucket] = stack
             return stack
 

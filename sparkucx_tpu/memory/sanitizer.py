@@ -1,0 +1,171 @@
+"""Runtime buffer sanitizer (``spark.shuffle.tpu.sanitize``, default off).
+
+The pool's zero-copy design trades safety for speed on purpose: pooled
+``MemoryBlock`` handles park in a free list with ``close()`` idempotent (a
+stale holder's second close is a no-op, not a double-free), and the reader
+hands out read-only memoryviews straight over fetch buffers.  Both idioms
+fail *silently* when misused — a consumer that keeps reading a released view
+sees whatever the next checkout wrote there (the exact stale-registered-
+buffer hazard SparkUCX documents around its RDMA pool).
+
+Sanitize mode makes every such misuse loud, the ASan playbook applied to the
+pool:
+
+* **double-release** — a second ``close()`` on a released pooled handle
+  raises :class:`SanitizerError` instead of no-op'ing.  The normal-mode
+  contract stays *idempotent* (free-list parking depends on it); sanitize
+  mode tightens it to *raise* so tests can pin the offender.
+* **use-after-release** — ``BlockFetchResult.data`` raises after
+  ``release()``/``detach()`` dropped the buffer.
+* **poisoning** — freed host buffers are filled with ``POISON`` (0xDD)
+  before re-pooling, so any surviving view reads garbage *deterministically*
+  rather than plausible stale bytes.
+* **re-pool with live views** — recycling a buffer while exported views are
+  outstanding (the reader registered a view and nobody released it) raises.
+
+The sanitizer is attached to the pool as the PUBLIC ``MemoryPool.sanitizer``
+attribute; the reader picks it up from there.  When disabled (default) every
+hook is a cheap no-op and no state is kept.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+#: fill byte for freed buffers (0xDD, the classic "dead memory" marker)
+POISON = 0xDD
+
+
+class SanitizerError(RuntimeError):
+    """A buffer-lifecycle invariant was violated under sanitize mode."""
+
+
+class _HandleState:
+    """Lifecycle record of one checked-out pooled handle."""
+
+    __slots__ = ("live", "exports")
+
+    def __init__(self) -> None:
+        self.live = True
+        self.exports = 0
+
+
+class BufferSanitizer:
+    """Tracks pooled-handle lifecycles; all methods are thread-safe.
+
+    Handles are keyed by ``id(block)`` — pooled MemoryBlock objects are
+    themselves pooled (the free list parks the wrapper, not just the bytes),
+    so object identity is stable across a checkout/release cycle and the
+    entry is refreshed at every checkout.
+    """
+
+    def __init__(self, enabled: bool = False) -> None:
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._handles: Dict[int, _HandleState] = {}  #: guarded by self._lock
+        # counters (observability; read them without the lock at your peril)
+        self.checkouts = 0  #: guarded by self._lock
+        self.releases = 0  #: guarded by self._lock
+        self.poisoned_bytes = 0  #: guarded by self._lock
+
+    # -- pool hooks --------------------------------------------------------
+
+    def on_checkout(self, block) -> None:
+        """A pooled handle left the free list (AllocatorStack.get)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.checkouts += 1
+            self._handles[id(block)] = _HandleState()
+
+    def on_release(self, block) -> None:
+        """A handle is about to re-pool (recycle hook).  Raises on live
+        exported views; poisons the backing bytes."""
+        if not self.enabled:
+            return
+        with self._lock:
+            state = self._handles.get(id(block))
+            if state is not None and state.exports > 0:
+                raise SanitizerError(
+                    f"re-pooling buffer with {state.exports} live exported "
+                    f"view(s) — release every BlockFetchResult before closing "
+                    f"its MemoryBlock"
+                )
+            if state is not None:
+                state.live = False
+            self.releases += 1
+            self.poisoned_bytes += int(getattr(block.data, "nbytes", 0))
+        # poison OUTSIDE the lock: a big memset under it would serialize the
+        # pool.  The block is already off every consumer's hands (exports==0).
+        data = block.data
+        if isinstance(data, np.ndarray):
+            data.reshape(-1).view(np.uint8)[:] = POISON
+
+    def on_double_release(self, block) -> None:
+        """Second close() of a parked handle — a latent double-free."""
+        if not self.enabled:
+            return
+        raise SanitizerError(
+            "double release: MemoryBlock.close() called on a handle already "
+            "parked in the free list (idempotent in normal mode; sanitize "
+            "mode raises to pin the offender)"
+        )
+
+    # -- view hooks (reader zero-copy results) -----------------------------
+
+    def export_view(self, block) -> None:
+        """A zero-copy view over ``block`` was handed to a consumer."""
+        if not self.enabled or block is None:
+            return
+        with self._lock:
+            state = self._handles.setdefault(id(block), _HandleState())
+            state.exports += 1
+
+    def release_view(self, block) -> None:
+        """The consumer's view was released/detached before the buffer."""
+        if not self.enabled or block is None:
+            return
+        with self._lock:
+            state = self._handles.get(id(block))
+            if state is not None and state.exports > 0:
+                state.exports -= 1
+
+    def check_view_released(self, what: str) -> None:
+        """Access to an already-released view: raise with context."""
+        if not self.enabled:
+            return
+        raise SanitizerError(
+            f"use-after-release: {what} accessed after release()/detach() "
+            f"returned its buffer to the pool"
+        )
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "checkouts": self.checkouts,
+                "releases": self.releases,
+                "poisoned_bytes": self.poisoned_bytes,
+                "tracked_handles": len(self._handles),
+            }
+
+
+#: shared no-op instance for call sites without a pool/conf
+DISABLED = BufferSanitizer(enabled=False)
+
+
+def from_conf(conf) -> BufferSanitizer:
+    """Build from ``TpuShuffleConf`` (``spark.shuffle.tpu.sanitize``).
+
+    The ``SPARKUCX_TPU_SANITIZE`` environment variable force-enables the
+    sanitizer regardless of conf — CI's sanitize-mode test subset flips the
+    whole suite on without threading a conf through every fixture."""
+    enabled = bool(getattr(conf, "sanitize", False)) or (
+        os.environ.get("SPARKUCX_TPU_SANITIZE", "").lower() in ("1", "true")
+    )
+    return BufferSanitizer(enabled=enabled)

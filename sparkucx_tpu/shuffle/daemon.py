@@ -100,9 +100,12 @@ class ShuffleDaemon:
         self._srv.listen(16)
         self.address: Tuple[str, int] = self._srv.getsockname()
         self._running = True
-        self._writers: Dict[int, object] = {}
-        self._streams: Dict[Tuple[int, int], object] = {}
-        self._next_writer = 0
+        # _serve runs per-connection threads; every handle-table touch goes
+        # through _lock — a second connection's OPEN/COMMIT must never race a
+        # stream rebinding mid-dispatch (analysis: lock-discipline pass).
+        self._writers: Dict[int, object] = {}  #: guarded by self._lock
+        self._streams: Dict[Tuple[int, int], object] = {}  #: guarded by self._lock
+        self._next_writer = 0  #: guarded by self._lock
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
@@ -159,22 +162,34 @@ class ShuffleDaemon:
             self._ack(conn, True, writer=handle)
         elif op == DaemonOp.WRITE_PARTITION:
             handle, reduce_id = int(meta["writer"]), int(meta["reduce_id"])
-            writer = self._writers[handle]
             key = (handle, reduce_id)
-            stream = self._streams.get(key)
+            stale = []
+            with self._lock:
+                writer = self._writers[handle]
+                stream = self._streams.get(key)
+                if stream is None:
+                    # close any open stream of this writer (sequential protocol);
+                    # pop under the lock, close outside it (close flushes)
+                    for k in [k for k in self._streams if k[0] == handle]:
+                        stale.append(self._streams.pop(k))
+            for s in stale:
+                s.close()
             if stream is None:
-                # close any open stream of this writer (sequential protocol)
-                for k in [k for k in self._streams if k[0] == handle]:
-                    self._streams.pop(k).close()
                 stream = writer.get_partition_writer(reduce_id).open_stream()
-                self._streams[key] = stream
+                with self._lock:
+                    self._streams[key] = stream
             stream.write(body)
             self._ack(conn, True, written=len(body))
         elif op == DaemonOp.COMMIT_MAP:
             handle = int(meta["writer"])
-            for k in [k for k in self._streams if k[0] == handle]:
-                self._streams.pop(k).close()
-            writer = self._writers.pop(handle)
+            with self._lock:
+                stale = [
+                    self._streams.pop(k)
+                    for k in [k for k in self._streams if k[0] == handle]
+                ]
+                writer = self._writers.pop(handle)
+            for s in stale:
+                s.close()
             lengths = writer.commit_all_partitions()
             self._ack(conn, True, body=np.asarray(lengths, dtype="<i8").tobytes())
         elif op == DaemonOp.RUN_EXCHANGE:

@@ -60,7 +60,7 @@ class BlockFetchResult:
     captured memoryview object itself goes stale at that point.  Constructing
     with a plain ``bytes`` payload keeps the old copying contract."""
 
-    __slots__ = ("block_id", "_data", "_buf", "_pooled")
+    __slots__ = ("block_id", "_data", "_buf", "_pooled", "_san", "_released")
 
     def __init__(
         self,
@@ -68,32 +68,51 @@ class BlockFetchResult:
         data,
         buf: Optional[MemoryBlock] = None,
         pooled: bool = False,
+        sanitizer=None,
     ) -> None:
         self.block_id = block_id
         self._data = data
         self._buf = buf
         self._pooled = pooled
+        self._san = sanitizer
+        self._released = False
+        if sanitizer is not None:
+            sanitizer.export_view(buf)
 
     @property
     def data(self):
+        if self._released and self._san is not None:
+            self._san.check_view_released(
+                f"BlockFetchResult({self.block_id.name}).data"
+            )
         return self._data
 
     def release(self) -> None:
         """Consumer is done with ``data``: hand the fetch buffer back without
-        any copy.  ``data`` must not be touched afterwards."""
+        any copy.  ``data`` must not be touched afterwards — under sanitize
+        mode a later ``data`` access raises; in normal mode a pooled result
+        degrades to ``b""``.  Idempotent in BOTH modes (the fetch iterator's
+        ``finally: detach()`` safety net depends on it)."""
         buf, self._buf = self._buf, None
         if buf is not None:
+            if self._san is not None:
+                self._san.release_view(buf)
             if self._pooled:
                 self._data = b""
+                self._released = True
             buf.close()
 
     def detach(self) -> None:
         """Make ``data`` outlive the buffer: copy it out if (and only if) the
-        buffer is pooled, then hand the buffer back.  Idempotent."""
+        buffer is pooled, then hand the buffer back.  Idempotent; ``data``
+        stays valid afterwards (it is a private copy), so this never trips
+        the use-after-release check."""
         buf, self._buf = self._buf, None
         if buf is not None:
             if self._pooled:
                 self._data = bytes(self._data)
+            if self._san is not None:
+                self._san.release_view(buf)
             buf.close()
 
 
@@ -258,7 +277,11 @@ class TpuShuffleReader:
                     self.metrics.remote_bytes_read += int(result.stats.recv_size)
                     self.metrics.remote_blocks_fetched += 1
                     prev = BlockFetchResult(
-                        bid, memoryview(view), buf, pooled=self.pool is not None
+                        bid,
+                        memoryview(view),
+                        buf,
+                        pooled=self.pool is not None,
+                        sanitizer=self.pool.sanitizer if self.pool is not None else None,
                     )
                     yield prev
                     prev.detach()

@@ -61,7 +61,7 @@ class TpuShuffleBlockResolver:
         self.conf = conf
         self.transport = transport
         self.store = store
-        self._shuffles: Set[int] = set()
+        self._shuffles: Set[int] = set()  #: guarded by self._lock
         self._lock = threading.Lock()
 
     def on_map_committed(self, shuffle_id: int, map_id: int, num_reducers: int) -> None:

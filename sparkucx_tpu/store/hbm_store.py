@@ -353,18 +353,18 @@ class HbmBlockStore:
         self.conf = conf or TpuShuffleConf()
         self.device = device
         self.executor_id = executor_id
-        self._shuffles: Dict[int, _ShuffleState] = {}
+        self._shuffles: Dict[int, _ShuffleState] = {}  #: guarded by self._lock
         # Commits that raced ahead of create_shuffle (a peer's MapperInfo can
         # arrive before this process registers the shuffle); applied at creation.
-        self._pending_infos: Dict[int, List[MapperInfo]] = {}
+        self._pending_infos: Dict[int, List[MapperInfo]] = {}  #: guarded by self._lock
         self._lock = threading.RLock()
         # disk round tier accounting (conf.spill_to_disk)
-        self._spill_dir: Optional[str] = None
-        self._spill_bytes = 0
+        self._spill_dir: Optional[str] = None  #: guarded by self._lock
+        self._spill_bytes = 0  #: guarded by self._lock
         #: build_block_scatter compile cache keyed by pow2-bucketed geometry —
         #: the _gather_fn discipline (transport/tpu.py) applied to the write
         #: path, so varying-shape device rounds share a handful of compiles.
-        self._scatter_cache: Dict[Tuple[int, int, int], object] = {}
+        self._scatter_cache: Dict[Tuple[int, int, int], object] = {}  #: guarded by self._lock
 
     def _shm_staging(self, shuffle_id: int, nbytes: int):
         """Shared-memory staging for single-host zero-copy serving
@@ -546,7 +546,8 @@ class HbmBlockStore:
         batch size and largest-block window so varying device rounds reuse a
         handful of compiles (the exchange's ``_gather_fn`` discipline).
         Returns ``(fn, bucketed_num_blocks)``; callers pad the plan arrays to
-        the bucket with zero-count entries."""
+        the bucket with zero-count entries.  Caller holds ``self._lock``
+        (its one call site is ``_materialize_device_round``)."""
         b = max(1 << max(num_blocks - 1, 0).bit_length(), 1)
         w = max(1 << max(max_rows - 1, 0).bit_length(), 1)
         key = (b, w, out_rows)

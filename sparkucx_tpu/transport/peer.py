@@ -490,24 +490,24 @@ class PeerTransport(ShuffleTransport):
         self.conf = conf or TpuShuffleConf()
         self.executor_id = executor_id
         self.store = store if store is not None else HbmBlockStore(self.conf, executor_id=executor_id)
-        self._registry: Dict[BlockId, Block] = {}
+        self._registry: Dict[BlockId, Block] = {}  #: guarded by self._registry_lock
         self._registry_lock = threading.Lock()
         self.server: Optional[BlockServer] = None
         # Connection cache keyed by (executor, slot): callers map onto
         # num_client_workers parallel connections per peer by thread identity —
         # the reference's thread->worker routing ``threadId % numWorkers``
         # (UcxShuffleTransport.scala:277-279, UcxShuffleConf.scala:80-86).
-        self._conns: Dict[Tuple[ExecutorId, int], _PeerConnection] = {}
-        self._conn_addrs: Dict[ExecutorId, Tuple[str, int]] = {}
+        self._conns: Dict[Tuple[ExecutorId, int], _PeerConnection] = {}  #: guarded by self._conn_lock
+        self._conn_addrs: Dict[ExecutorId, Tuple[str, int]] = {}  #: guarded by self._conn_lock
         self._conn_lock = threading.Lock()
         self._slot_local = threading.local()
-        self._slot_rr = 0
-        self._connecting: Dict[Tuple[ExecutorId, int], threading.Event] = {}
-        self._next_tag = 0
+        self._slot_rr = 0  #: guarded by self._tag_lock
+        self._connecting: Dict[Tuple[ExecutorId, int], threading.Event] = {}  #: guarded by self._conn_lock
+        self._next_tag = 0  #: guarded by self._tag_lock
         self._tag_lock = threading.Lock()
-        self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]], Optional[_PeerConnection]]] = {}
-        self._scattering: set = set()
-        self._zombies: List[_PeerConnection] = []  # evicted, not yet drained
+        self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]], Optional[_PeerConnection]]] = {}  #: guarded by self._tag_lock
+        self._scattering: set = set()  #: guarded by self._tag_lock
+        self._zombies: List[_PeerConnection] = []  #: guarded by self._conn_lock (evicted, not yet drained)
         self.stats_agg = StatsAggregator() if self.conf.collect_stats else None
         #: Wakeup doorbell (conf.use_wakeup): recv threads set it when an ack
         #: parks, so fetch loops can sleep in wait_for_activity() instead of
@@ -556,11 +556,15 @@ class PeerTransport(ShuffleTransport):
             self._zombies = []
         for c in conns:
             c.close()
-        for reqs, _, _, _ in list(self._inflight.values()):
+        # snapshot + clear under the tag lock: a recv thread can still be
+        # resolving an ack while we tear down (found by the lock-discipline pass)
+        with self._tag_lock:
+            inflight = list(self._inflight.values())
+            self._inflight.clear()
+        for reqs, _, _, _ in inflight:
             for r in reqs:
                 if not r.completed():
                     r.cancel()
-        self._inflight.clear()
         if self.server is not None:
             self.server.close()
         self.store.close()

@@ -23,6 +23,7 @@ section 7 "multi-controller discipline").
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -105,9 +106,12 @@ class SpmdShuffleExecutor:
         self._meta: Dict[int, Tuple[int, int, List[Tuple[int, int]]]] = {}
         self._exchange_fns: Dict[int, object] = {}
         #: memmap spill files per shuffle as (path, charged nbytes) —
-        #: host_recv_mode='memmap'; the refund uses the tracked charge
-        self._recv_spill: Dict[int, List[Tuple[str, int]]] = {}
-        self._recv_spill_bytes = 0  # charged against conf.spill_disk_cap_bytes
+        #: host_recv_mode='memmap'; the refund uses the tracked charge.
+        #: _host_shard runs on the pipeline DRAIN worker while remove_shuffle
+        #: runs on the caller thread — both sides take _spill_lock.
+        self._recv_spill: Dict[int, List[Tuple[str, int]]] = {}  #: guarded by self._spill_lock
+        self._recv_spill_bytes = 0  #: guarded by self._spill_lock (vs conf.spill_disk_cap_bytes)
+        self._spill_lock = threading.Lock()
         #: per-stage pipeline timings (same occupancy view as the cluster's)
         self.stats = StatsAggregator()
         if self.conf.host_recv_mode not in ("array", "memmap"):
@@ -327,13 +331,16 @@ class SpmdShuffleExecutor:
 
         cap = self.conf.spill_disk_cap_bytes
         nbytes = int(host.nbytes)
-        if cap and self._recv_spill_bytes + nbytes > cap:
-            raise TransportError(
-                f"received-shard spill would exceed spill_disk_cap_bytes "
-                f"({self._recv_spill_bytes + nbytes} > {cap}) on executor "
-                f"{self.executor_id}"
-            )
-        self._recv_spill_bytes += nbytes
+        # reserve-then-write: check+charge atomic under the spill lock (the
+        # drain worker charges here while remove_shuffle refunds concurrently)
+        with self._spill_lock:
+            if cap and self._recv_spill_bytes + nbytes > cap:
+                raise TransportError(
+                    f"received-shard spill would exceed spill_disk_cap_bytes "
+                    f"({self._recv_spill_bytes + nbytes} > {cap}) on executor "
+                    f"{self.executor_id}"
+                )
+            self._recv_spill_bytes += nbytes
         spill_dir = self.conf.spill_dir
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
@@ -348,7 +355,8 @@ class SpmdShuffleExecutor:
             mm[:] = host
             mm.flush()
         except BaseException:
-            self._recv_spill_bytes -= nbytes
+            with self._spill_lock:
+                self._recv_spill_bytes -= nbytes
             try:
                 os.unlink(path)
             except OSError:
@@ -358,7 +366,8 @@ class SpmdShuffleExecutor:
         # track the CHARGED bytes with the path: the refund must mirror the
         # charge, not os.path.getsize (block-size rounding / sparse files /
         # truncation by an operator would drift _recv_spill_bytes permanently)
-        self._recv_spill.setdefault(shuffle_id, []).append((path, nbytes))
+        with self._spill_lock:
+            self._recv_spill.setdefault(shuffle_id, []).append((path, nbytes))
         return np.memmap(path, dtype=np.uint8, mode="r", shape=shape)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
@@ -368,11 +377,16 @@ class SpmdShuffleExecutor:
         self._mapper_infos.pop(shuffle_id, None)
         import os
 
-        for path, nbytes in self._recv_spill.pop(shuffle_id, []):
+        with self._spill_lock:
+            doomed = self._recv_spill.pop(shuffle_id, [])
+        for path, nbytes in doomed:
             try:
                 os.unlink(path)
-                self._recv_spill_bytes -= nbytes
+                freed = True
             except FileNotFoundError:
-                self._recv_spill_bytes -= nbytes  # already gone: still refund
+                freed = True  # already gone: still refund
             except OSError:
-                pass  # still on disk: keep it charged
+                freed = False  # still on disk: keep it charged
+            if freed:
+                with self._spill_lock:
+                    self._recv_spill_bytes -= nbytes

@@ -84,8 +84,10 @@ class _ShuffleMeta:
     recv_shards: Optional[List[List[np.ndarray]]] = None  # [round][executor] uint8
     recv_sizes: Optional[List[np.ndarray]] = None         # [round] (n, n) rows j<-i
     #: memmap backing (path, bytes) to unlink on remove_shuffle ('memmap'
-    #: mode); sizes are tracked so the disk budget is refunded exactly
-    recv_spill_paths: List[Tuple[str, int]] = field(default_factory=list)
+    #: mode); sizes are tracked so the disk budget is refunded exactly.
+    #: Appended from the pipeline DRAIN worker while the main thread may be
+    #: tearing the shuffle down — mutate only under the cluster's lock.
+    recv_spill_paths: List[Tuple[str, int]] = field(default_factory=list)  #: guarded by self._lock
     # HBM-resident copies of the received shards (conf.keep_device_recv) —
     # the source the device-side block gather serves from:
     recv_device: Optional[List[List[object]]] = None      # [round][executor] jax.Array
@@ -115,15 +117,15 @@ class TpuShuffleCluster:
         self.transports: List[TpuShuffleTransport] = [
             TpuShuffleTransport(self, eid, device=devices[eid]) for eid in range(self.num_executors)
         ]
-        self._meta: Dict[int, _ShuffleMeta] = {}
-        self._exchange_cache: Dict[Tuple[int, int, str], Callable] = {}
+        self._meta: Dict[int, _ShuffleMeta] = {}  #: guarded by self._lock
+        self._exchange_cache: Dict[Tuple[int, int, str], Callable] = {}  #: guarded by self._lock
         self._lock = threading.RLock()
         #: aggregate per-stage pipeline/exchange timings (occupancy view)
         self.stats = StatsAggregator()
         #: bytes of received-shard spill currently on disk (host_recv_mode=
         #: 'memmap'), charged against conf.spill_disk_cap_bytes like the
-        #: store's staging spill
-        self._recv_spill_bytes = 0
+        #: store's staging spill; the drain worker charges, teardown refunds
+        self._recv_spill_bytes = 0  #: guarded by self._lock
 
     # -- membership / lookup ----------------------------------------------
 
@@ -452,7 +454,10 @@ class TpuShuffleCluster:
             # unmapped (host RSS actually falls back to ~one transient shard),
             # and fetches fault in only the pages they touch.
             del mm, host
-            meta.recv_spill_paths.append((path, nbytes))
+            # the drain worker appends while remove_shuffle may iterate on the
+            # main thread — same lock as the budget it charges against
+            with self._lock:
+                meta.recv_spill_paths.append((path, nbytes))
             views.append(np.memmap(path, dtype=np.uint8, mode="r", shape=shape))
         return views
 

@@ -43,10 +43,12 @@ class StatsAggregator:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._ops: Dict[str, int] = {}
-        self._bytes: Dict[str, int] = {}
-        self._total_ns: Dict[str, int] = {}
-        self._samples: Dict[str, List[int]] = {}
+        # record() is called from the pipeline's submit AND drain lanes
+        # concurrently — every counter mutates under _lock (lock-discipline)
+        self._ops: Dict[str, int] = {}  #: guarded by self._lock
+        self._bytes: Dict[str, int] = {}  #: guarded by self._lock
+        self._total_ns: Dict[str, int] = {}  #: guarded by self._lock
+        self._samples: Dict[str, List[int]] = {}  #: guarded by self._lock
 
     def record(self, kind: str, stats: OperationStats) -> None:
         elapsed = stats.elapsed_ns()

@@ -1,0 +1,491 @@
+"""Analyzer + sanitizer tests (fast, no device work).
+
+Each analysis pass gets three fixture snippets run through ``run_source``:
+one that must flag, one that must stay clean, and one exercising the escape
+hatch (allowlist / lock held / bucketing rebind / docstring contract).  The
+fixtures are source STRINGS — they are parsed, never imported, so they can
+reference modules that don't exist.
+
+The sanitizer tests pin the documented lifecycle contracts: release is
+idempotent in normal mode; sanitize mode raises on double-release,
+use-after-release, and re-pooling with live exported views, and poisons
+freed host buffers with 0xDD.
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.analysis import is_allowlisted, run_source
+from sparkucx_tpu.analysis.__main__ import main as analysis_main
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import ShuffleBlockId
+from sparkucx_tpu.memory.pool import MemoryPool
+from sparkucx_tpu.memory.sanitizer import POISON, BufferSanitizer, SanitizerError
+from sparkucx_tpu.shuffle.reader import BlockFetchResult
+
+
+def src(text: str) -> str:
+    return textwrap.dedent(text)
+
+
+def messages(findings):
+    return [f.message for f in findings]
+
+
+# ----------------------------------------------------------------------
+# use-after-donate
+
+
+class TestUseAfterDonate:
+    def test_flags_read_after_donating_call(self):
+        findings = run_source(
+            src(
+                """
+                def run(spec, buf):
+                    fn = build_exchange(spec)
+                    out = fn(buf)
+                    return buf.sum() + out
+                """
+            ),
+            passes=["use-after-donate"],
+        )
+        assert len(findings) == 1
+        assert "buf" in findings[0].message
+        assert "donated" in findings[0].message
+
+    def test_flags_jit_donate_argnums(self):
+        findings = run_source(
+            src(
+                """
+                import jax
+
+                def run(x):
+                    g = jax.jit(step, donate_argnums=(0,))
+                    y = g(x)
+                    return x + y
+                """
+            ),
+            passes=["use-after-donate"],
+        )
+        assert len(findings) == 1
+        assert "x" in findings[0].message
+
+    def test_rebind_revives_and_branches_merge(self):
+        # rebinding the name after donation makes later reads legal; a read
+        # that only happens on the non-donating branch is also legal
+        findings = run_source(
+            src(
+                """
+                def run(spec, buf, cond):
+                    fn = build_exchange(spec)
+                    buf = fn(buf)
+                    return buf.sum()
+
+                def branchy(spec, buf, cond):
+                    fn = build_exchange(spec)
+                    if cond:
+                        fn(buf)
+                    else:
+                        pass
+                    return buf
+                """
+            ),
+            passes=["use-after-donate"],
+        )
+        # `return buf` after the If IS flagged (donated on one branch ->
+        # may-donate is must-not-reuse), but `buf = fn(buf)` is not
+        assert len(findings) == 1
+        assert findings[0].line > 7
+
+    def test_block_scatter_positional_donation(self):
+        findings = run_source(
+            src(
+                """
+                def run(b, out):
+                    fn = build_block_scatter(1, 2, 3, 4)
+                    fn(a, b, c, d, out)
+                    return out
+                """
+            ),
+            passes=["use-after-donate"],
+        )
+        assert len(findings) == 1
+        assert "out" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# lock-discipline
+
+
+LOCK_FIXTURE = """
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other_lock = threading.Lock()
+        self._items = []  #: guarded by self._lock
+
+    def bad(self, x):
+        self._items.append(x)
+
+    def wrong_lock(self, x):
+        with self._other_lock:
+            self._items = [x]
+
+    def good(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def helper(self, x):
+        \"\"\"Append one item; caller holds ``self._lock``.\"\"\"
+        self._items.append(x)
+"""
+
+
+class TestLockDiscipline:
+    def test_flags_unguarded_and_wrong_lock(self):
+        findings = run_source(src(LOCK_FIXTURE), passes=["lock-discipline"])
+        assert len(findings) == 2
+        assert any("mutator call '.append()'" in m for m in messages(findings))
+        assert any("_other_lock" in m for m in messages(findings))
+
+    def test_init_and_caller_holds_exempt(self):
+        findings = run_source(src(LOCK_FIXTURE), passes=["lock-discipline"])
+        lines = {f.line for f in findings}
+        # __init__ assignment (the annotation line) and the documented helper
+        # must not be among the findings
+        assert all(l < 20 for l in lines)
+
+    def test_clean_without_annotations(self):
+        findings = run_source(
+            src(
+                """
+                class Free:
+                    def mutate(self, x):
+                        self.items.append(x)
+                """
+            ),
+            passes=["lock-discipline"],
+        )
+        assert findings == []
+
+
+# ----------------------------------------------------------------------
+# host-sync
+
+
+HOSTSYNC_FIXTURE = """
+import numpy as np
+from sparkucx_tpu.transport.pipeline import RoundPipeline
+
+class Exchanger:
+    def _submit(self, r):
+        x = self._arrs[r]
+        x.block_until_ready()
+        return x
+
+    def _drain(self, r, ticket):
+        return np.asarray(ticket)
+
+    def _helper(self, t):
+        return jax.device_get(t)
+
+    def _run_exchange(self, rounds):
+        pipe = RoundPipeline(2, self._submit, self._drain, name="x")
+        for r in range(rounds):
+            self._helper(r)
+
+    def unrelated(self, x):
+        x.block_until_ready()
+"""
+
+
+class TestHostSync:
+    def test_flags_stages_and_reachable_callees(self):
+        findings = run_source(src(HOSTSYNC_FIXTURE), passes=["host-sync"])
+        msgs = messages(findings)
+        assert any("block_until_ready" in m and "submit stage" in m for m in msgs)
+        assert any("np.asarray" in m and "drain stage" in m for m in msgs)
+        assert any("device_get" in m and "via '_helper'" in m for m in msgs)
+        # `unrelated` is not a stage and not reachable from _run_exchange
+        assert not any("unrelated" in m for m in msgs)
+        assert len(findings) == 3
+
+    def test_literal_asarray_not_flagged(self):
+        findings = run_source(
+            src(
+                """
+                import numpy as np
+                from sparkucx_tpu.transport.pipeline import RoundPipeline
+
+                class E:
+                    def _submit(self, r):
+                        return np.asarray([0, 1, 2])
+
+                    def _drain(self, r, t):
+                        return t
+
+                    def go(self):
+                        RoundPipeline(2, self._submit, self._drain)
+                """
+            ),
+            passes=["host-sync"],
+        )
+        assert findings == []
+
+    def test_drain_findings_are_allowlistable_by_lane(self):
+        findings = run_source(
+            src(HOSTSYNC_FIXTURE), passes=["host-sync"], filename="transport/fix.py"
+        )
+        allow = {("transport/fix.py", "host-sync", "drain stage")}
+        left = [f for f in findings if not is_allowlisted(f, allow)]
+        # the drain-lane finding is suppressed; submit + root survive
+        assert len(left) == 2
+        assert all("drain stage" not in f.message for f in left)
+
+
+# ----------------------------------------------------------------------
+# cache-hygiene
+
+
+class TestCacheHygiene:
+    def test_flags_raw_shape_params_in_cache_key(self):
+        findings = run_source(
+            src(
+                """
+                class S:
+                    def get(self, rows, width):
+                        key = (rows, width)
+                        if key not in self._scatter_cache:
+                            self._scatter_cache[key] = build_thing(rows, width)
+                        return self._scatter_cache[key]
+                """
+            ),
+            passes=["cache-hygiene"],
+        )
+        msgs = messages(findings)
+        assert any("'rows'" in m for m in msgs)
+        assert any("'width'" in m for m in msgs)
+
+    def test_bucketed_param_clean(self):
+        findings = run_source(
+            src(
+                """
+                class S:
+                    def get(self, rows, width):
+                        rows = round_up_to_next_power_of_two(rows)
+                        width = bucket_send_rows(width)
+                        key = (rows, width)
+                        if key not in self._scatter_cache:
+                            self._scatter_cache[key] = build_thing(rows, width)
+                        return self._scatter_cache[key]
+                """
+            ),
+            passes=["cache-hygiene"],
+        )
+        assert findings == []
+
+    def test_lru_cache_builder_flagged(self):
+        findings = run_source(
+            src(
+                """
+                import functools
+
+                @functools.lru_cache(maxsize=None)
+                def build_gather(num_blocks, dtype):
+                    return num_blocks
+                """
+            ),
+            passes=["cache-hygiene"],
+        )
+        assert len(findings) == 1
+        assert "num_blocks" in findings[0].message
+        assert "bucket" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# private-access / required-surface / allowlist mechanics
+
+
+class TestPrivateAndSurface:
+    def test_private_access_flagged_self_ok(self):
+        findings = run_source(
+            src(
+                """
+                def f(other):
+                    return other._guts
+
+                class C:
+                    def g(self):
+                        return self._mine
+                """
+            ),
+            passes=["private-access"],
+        )
+        assert len(findings) == 1
+        assert "._guts" in findings[0].message
+
+    def test_required_surface_missing_method(self):
+        findings = run_source(
+            src(
+                """
+                class HbmBlockStore:
+                    def register_shuffle(self):
+                        pass
+                """
+            ),
+            passes=["required-surface"],
+            filename="store/hbm_store.py",
+        )
+        assert any("missing" in m for m in messages(findings))
+
+    def test_allowlist_matching_is_narrow(self):
+        findings = run_source(
+            "def f(o):\n    return o._guts\n",
+            passes=["private-access"],
+            filename="transport/thing.py",
+        )
+        (f,) = findings
+        assert is_allowlisted(f, {("transport/thing.py", "private-access", "._guts")})
+        assert is_allowlisted(f, {("thing.py", "*", "._guts")})
+        assert not is_allowlisted(f, {("other.py", "private-access", "._guts")})
+        assert not is_allowlisted(f, {("thing.py", "lock-discipline", "._guts")})
+        assert not is_allowlisted(f, {("thing.py", "private-access", "._other")})
+
+
+# ----------------------------------------------------------------------
+# CLI
+
+
+class TestCli:
+    def test_ci_clean_at_head(self, capsys):
+        assert analysis_main(["--ci"]) == 0
+        assert capsys.readouterr().out == ""
+
+    def test_injected_violation_fails_with_file_line(self, tmp_path, capsys):
+        bad = tmp_path / "leaky.py"
+        bad.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = []  #: guarded by self._lock\n"
+            "    def leak(self, x):\n"
+            "        self._q.append(x)\n"
+        )
+        assert analysis_main(["--ci", "--root", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "leaky.py:7" in out
+        assert "[lock-discipline]" in out
+
+    def test_unknown_pass_rejected(self, capsys):
+        assert analysis_main(["--passes", "nope"]) == 2
+
+    def test_list_passes(self, capsys):
+        assert analysis_main(["--list-passes"]) == 0
+        out = capsys.readouterr().out.split()
+        for name in (
+            "use-after-donate",
+            "lock-discipline",
+            "host-sync",
+            "cache-hygiene",
+            "private-access",
+            "required-surface",
+        ):
+            assert name in out
+
+
+# ----------------------------------------------------------------------
+# runtime buffer sanitizer
+
+
+@pytest.fixture
+def sane_pool():
+    pool = MemoryPool(TpuShuffleConf(sanitize=True))
+    yield pool
+    try:
+        pool.close()
+    except ResourceWarning:
+        pass
+
+
+class TestSanitizer:
+    def test_conf_knob(self):
+        assert MemoryPool(TpuShuffleConf()).sanitizer.enabled is False
+        assert MemoryPool(TpuShuffleConf(sanitize=True)).sanitizer.enabled is True
+        conf = TpuShuffleConf.from_spark_conf({"spark.shuffle.tpu.sanitize": "true"})
+        assert conf.sanitize is True
+
+    def test_double_release_raises(self, sane_pool):
+        mb = sane_pool.get(100)
+        mb.close()
+        with pytest.raises(SanitizerError, match="double release"):
+            mb.close()
+
+    def test_normal_mode_release_idempotent(self):
+        pool = MemoryPool(TpuShuffleConf())
+        mb = pool.get(100)
+        mb.close()
+        mb.close()  # documented no-op
+        pool.close()
+
+    def test_freed_buffer_poisoned(self, sane_pool):
+        mb = sane_pool.get(64)
+        mb.host_view()[:] = 7
+        backing = mb.data
+        mb.close()
+        assert (np.asarray(backing).reshape(-1).view(np.uint8) == POISON).all()
+        assert sane_pool.sanitizer.stats()["poisoned_bytes"] > 0
+
+    def test_use_after_release_raises(self, sane_pool):
+        mb = sane_pool.get(32)
+        r = BlockFetchResult(
+            ShuffleBlockId(1, 2, 3),
+            memoryview(mb.host_view()),
+            mb,
+            pooled=True,
+            sanitizer=sane_pool.sanitizer,
+        )
+        r.release()
+        with pytest.raises(SanitizerError, match="use-after-release"):
+            r.data
+        # detach/release stay idempotent even in sanitize mode: the fetch
+        # iterator's `finally: prev.detach()` safety net relies on it
+        r.detach()
+        r.release()
+
+    def test_repool_with_live_view_raises_then_recovers(self, sane_pool):
+        mb = sane_pool.get(32)
+        r = BlockFetchResult(
+            ShuffleBlockId(1, 2, 3),
+            memoryview(mb.host_view()),
+            mb,
+            pooled=True,
+            sanitizer=sane_pool.sanitizer,
+        )
+        with pytest.raises(SanitizerError, match="live exported view"):
+            mb.close()
+        # the failed close leaves the handle checked out; the legitimate
+        # release path (view first, then buffer) still works
+        r.release()
+
+    def test_detach_keeps_data_valid(self, sane_pool):
+        mb = sane_pool.get(8)
+        mb.host_view()[:] = 42
+        view = memoryview(mb.host_view()[: mb.size])
+        r = BlockFetchResult(
+            ShuffleBlockId(0, 0, 0), view, mb, pooled=True,
+            sanitizer=sane_pool.sanitizer,
+        )
+        r.detach()
+        assert bytes(r.data)[:4] == b"\x2a\x2a\x2a\x2a"
+
+    def test_disabled_sanitizer_is_noop(self):
+        san = BufferSanitizer(enabled=False)
+        san.on_checkout(object())
+        san.on_double_release(object())
+        san.check_view_released("anything")
+        assert san.stats()["checkouts"] == 0
