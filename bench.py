@@ -473,6 +473,30 @@ def main():
                 RESULT["write_device_speedup"] = round(wr["device"] / wr["host"], 3)
         except Exception as e:
             RESULT["write_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            # Skew-aware exchange planning (ops/skew.py): quota-capped chunked
+            # plan vs the max-sized single-shot bucket on a Zipf-skewed size
+            # matrix.  40000 rows sits just past the 32768 pow2 boundary, the
+            # case where single-shot doubles its staging bucket but chunking
+            # pays only extra sub-rounds; quota 8192 forces 5 chunks.  Bit
+            # equality of the two plans is asserted inside measure_skew.
+            if budget_left() < 90:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            from sparkucx_tpu.perf.benchmark import measure_skew
+
+            sk = measure_skew(1, 40000, REPEATS, quota_rows=8192)
+            RESULT["skew"] = {
+                "quota_gbps": round(sk["quota"]["gbps"], 3),
+                "max_gbps": round(sk["max"]["gbps"], 3),
+                "subrounds": sk["subrounds"],
+                "quota_padding": round(sk["quota"]["padding_fraction"], 4),
+                "max_padding": round(sk["max"]["padding_fraction"], 4),
+                "staged_rows_cut": round(
+                    sk["max"]["staged_rows"] / max(sk["quota"]["staged_rows"], 1), 3
+                ),
+            }
+        except Exception as e:
+            RESULT["skew_error"] = f"{type(e).__name__}: {e}"[:200]
 
     emit_once()
 

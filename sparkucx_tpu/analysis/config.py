@@ -36,6 +36,10 @@ from __future__ import annotations
 #:   device payloads into the host assembly buffer.  That D2H is the
 #:   documented cost of mixed-mode rounds (an executor sealed fewer device
 #:   rounds than its peers), accepted until a device-side repack exists.
+#: - tpu.py ``_submit_quota``: the quota engine's twin of ``_assemble`` — the
+#:   np.asarray sits on the mixed host/device branch (the all-device arm above
+#:   it slices on-device via jnp), guarded by ``isinstance(p, jax.Array)``;
+#:   same documented mixed-mode D2H cost, same scope.
 #:
 #: cache-hygiene:
 #: - hbm_store.py ``out_rows``: the scatter output shape IS the staging
@@ -52,6 +56,7 @@ ALLOWLIST = {
     ("perf/benchmark.py", "host-sync", "drain stage"),
     ("transport/spmd.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit'"),
     ("transport/tpu.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit' (via '_assemble')"),
+    ("transport/tpu.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit_quota'"),
     ("store/hbm_store.py", "cache-hygiene", "'out_rows'"),
 }
 
@@ -115,4 +120,13 @@ BUILDER_PREFIXES = ("build_",)
 BUILDER_NAMES = ("jit",)
 
 #: Callee / method names that sanctify a shape value as bucketed.
-BUCKETING_MARKERS = ("bucket_send_rows", "round_up_to_next_power_of_two", "bit_length")
+#: quota_slot_rows / plan_exchange (ops/skew.py) pow2-round the quota-capped
+#: slot — a plan's slot_rows is a bucket_send_rows fixed point, so shape
+#: params flowing through the skew planner are bucketed by construction.
+BUCKETING_MARKERS = (
+    "bucket_send_rows",
+    "round_up_to_next_power_of_two",
+    "bit_length",
+    "quota_slot_rows",
+    "plan_exchange",
+)

@@ -174,6 +174,16 @@ class TpuShuffleConf:
     #: only reorders WHEN stages run, never what they compute).
     pipeline_depth: int = 2
 
+    #: Skew-aware exchange planning (ops/skew.py): cap each destination's
+    #: exchange slot at this many rows and chunk hotter lanes across extra
+    #: pipelined sub-rounds instead of inflating every slot to the global max
+    #: — the extra rounds ride the pipeline_depth overlap, so hot-lane bytes
+    #: stream while cold lanes finish.  Shrinks staged HBM and (under the
+    #: portable dense lowering) wire bytes on Zipf-skewed shuffles; results
+    #: are bit-identical to the single-shot exchange.  0 (default) disables
+    #: the planner entirely — the unchunked path runs byte-for-byte as before.
+    slot_quota_rows: int = 0
+
     # instrumentation
     collect_stats: bool = True
     #: Runtime buffer sanitizer (memory/sanitizer.py): track pooled-handle
@@ -244,6 +254,7 @@ class TpuShuffleConf:
             ("spillDiskCap", "spill_disk_cap_bytes", parse_size),
             ("reduceMemoryBudget", "reduce_memory_budget", parse_size),
             ("pipelineDepth", "pipeline_depth", int),
+            ("slotQuotaRows", "slot_quota_rows", int),
             ("deviceStaging", "device_staging", lambda v: str(v).lower() == "true"),
             ("sanitize", "sanitize", lambda v: str(v).lower() == "true"),
         ]:
@@ -275,6 +286,8 @@ class TpuShuffleConf:
             raise ValueError("num_executors must be divisible by num_slices")
         if self.pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1 (1 = serial engine)")
+        if self.slot_quota_rows < 0:
+            raise ValueError("slot_quota_rows must be >= 0 (0 = no quota)")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

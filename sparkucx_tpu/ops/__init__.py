@@ -27,6 +27,15 @@ from sparkucx_tpu.ops.hierarchy import (
     make_hierarchical_mesh,
 )
 from sparkucx_tpu.ops.pallas_kernels import build_block_gather, pack_plan
+from sparkucx_tpu.ops.skew import (
+    ExchangePlan,
+    chunk_size_rows,
+    plan_exchange,
+    quota_slot_rows,
+    reassemble_round,
+    slice_subround,
+    staging_occupancy,
+)
 from sparkucx_tpu.ops.relational import (
     AggregateSpec,
     JoinSpec,
@@ -72,6 +81,13 @@ __all__ = [
     "make_hierarchical_mesh",
     "build_block_gather",
     "pack_plan",
+    "ExchangePlan",
+    "chunk_size_rows",
+    "plan_exchange",
+    "quota_slot_rows",
+    "reassemble_round",
+    "slice_subround",
+    "staging_occupancy",
     "AggregateSpec",
     "JoinSpec",
     "build_grouped_aggregate",

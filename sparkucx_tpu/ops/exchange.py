@@ -391,16 +391,24 @@ def pack_chunks_slots(
     alignment analogue, NvkvHandler.scala:244-256).
 
     Returns ((n*slot_rows, row_bytes/4) int32 buffer, per-peer sizes in rows).
+
+    The buffer is allocated with ``np.empty``: only each chunk's final-row
+    tail (part of a USED row, so it does reach receivers) is zeroed.  Rows
+    between the sized prefix and the slot end stay uninitialized — the size
+    matrix counts only used rows, so no lowering lets them into valid receive
+    output (the same contract staging garbage already rides on).
     """
     n = len(chunks)
-    buf = np.zeros(n * slot_rows * row_bytes, dtype=np.uint8)
-    sizes = np.zeros(n, dtype=np.int32)
+    buf = np.empty(n * slot_rows * row_bytes, dtype=np.uint8)
+    sizes = np.empty(n, dtype=np.int32)
     for j, chunk in enumerate(chunks):
-        rows = -(-len(chunk) // row_bytes)
+        nbytes = len(chunk)
+        rows = -(-nbytes // row_bytes)
         if rows > slot_rows:
             raise ValueError(f"chunk for peer {j} ({rows} rows) exceeds slot {slot_rows} rows")
         start = j * slot_rows * row_bytes
-        buf[start : start + len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        buf[start : start + nbytes] = np.frombuffer(chunk, dtype=np.uint8)
+        buf[start + nbytes : start + rows * row_bytes] = 0  # final-row tail only
         sizes[j] = rows
     return buf.view(np.int32).reshape(n * slot_rows, row_bytes // 4), sizes
 

@@ -79,7 +79,7 @@ def _parse_args(argv):
         "mode",
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
-            "columnar", "groupby", "join", "write",
+            "columnar", "groupby", "join", "write", "skew",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -133,6 +133,15 @@ def _parse_args(argv):
     p.add_argument(
         "--depths", default="1,2,3",
         help="comma-separated pipeline depths to compare (pipeline mode)",
+    )
+    p.add_argument(
+        "--zipf-alpha", type=float, default=1.2,
+        help="Zipf exponent for the per-peer size distribution (skew mode)",
+    )
+    p.add_argument(
+        "--quota", type=int, default=0,
+        help="slot quota in rows (skew mode); 0 picks the pow2 ceiling of the "
+        "mean lane size automatically",
     )
     return p.parse_args(argv)
 
@@ -508,6 +517,226 @@ def measure_write(
                 report(impl, it - 1, dt, total)
         results[impl] = best
     return results
+
+
+def zipf_size_matrix(executors: int, max_peer_rows: int, alpha: float) -> np.ndarray:
+    """A deterministic Zipf-skewed exchange size matrix: ``sizes[i, j]`` rows
+    from sender i to destination j follow ``(rank + 1) ** -alpha`` scaled so
+    each sender's hottest lane is ``max_peer_rows`` (min 1 row), with the rank
+    order permuted per sender (seeded) so the hot destination varies — the
+    shape real shuffle workloads take (ISSUE: TPC-DS/TPC-H are Zipf-skewed)."""
+    n = executors
+    rng = np.random.default_rng(0)
+    weights = (np.arange(1, n + 1, dtype=np.float64)) ** (-alpha)
+    base = np.maximum(1, np.round(max_peer_rows * weights / weights[0])).astype(np.int64)
+    sizes = np.empty((n, n), dtype=np.int32)
+    for i in range(n):
+        sizes[i] = base[rng.permutation(n)]
+    return sizes
+
+
+def measure_skew(
+    executors: int, max_peer_rows: int, iterations: int,
+    zipf_alpha: float = 1.2, quota_rows: int = 0, report=None,
+) -> dict:
+    """Measurement core of the ``skew`` mode — the quota-capped plan
+    (ops/skew.py) vs the max-sized single-shot plan on a Zipf-skewed shuffle.
+
+    The max plan stages every peer slot at the hottest lane's pow2 bucket (the
+    ``bucket_send_rows`` behavior the quota exists to cap): one exchange, most
+    of it padding.  The quota plan caps the slot at ``quota_rows`` (0 = the
+    pow2 ceiling of the mean lane size) and chunks hot lanes across sub-round
+    exchanges.  Both produce bit-identical receive bytes (asserted); the
+    returned dict carries effective GB/s (useful bytes / wall time), staged
+    rows, dense-lowering wire bytes, and padding fraction per plan — the
+    measured table in docs/PERF.md.  ``report(plan, it, seconds, bytes)`` per
+    iteration.  Shared by the CLI and bench.py."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.exchange import (
+        ExchangeSpec, bucket_send_rows, build_exchange, make_mesh,
+    )
+    from sparkucx_tpu.ops.skew import (
+        chunk_size_rows, plan_exchange, quota_slot_rows, reassemble_round,
+        slice_subround,
+    )
+
+    n = executors
+    row_bytes = 512
+    lane = row_bytes // 4
+    sizes = zipf_size_matrix(n, max_peer_rows, zipf_alpha)
+    slot = bucket_send_rows(int(sizes.max()) * n, n) // n  # the max plan's slot
+    if quota_rows <= 0:
+        quota_rows = int(quota_slot_rows(slot, int(np.ceil(sizes.mean()))))
+    plan = plan_exchange([int(sizes.max())], slot, quota_rows)
+    q = plan.slot_rows
+
+    mesh = make_mesh(n)
+    sharding = NamedSharding(mesh, P("ex", None))
+    rng = np.random.default_rng(1)
+    # slot-layout staging payload per sender, hot lanes filled to their size
+    payloads = []
+    for i in range(n):
+        p = np.zeros((n * slot, lane), dtype=np.int32)
+        for j in range(n):
+            p[j * slot : j * slot + sizes[i, j]] = rng.integers(
+                -100, 100, size=(int(sizes[i, j]), lane), dtype=np.int32
+            )
+        payloads.append(p)
+    used_rows = int(sizes.sum())
+    useful_bytes = used_rows * row_bytes
+
+    def run_max():
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=n * slot, recv_rows=n * slot, lane=lane
+        )
+        fn = build_exchange(mesh, spec)
+        size_mat = jax.device_put(sizes, sharding)
+        data_host = np.concatenate(payloads)
+
+        def shot():
+            data = jax.device_put(data_host, sharding)
+            recv, rs = fn(data, size_mat)
+            jax.block_until_ready(recv)
+            return recv, rs
+
+        recv, rs = shot()  # warmup/compile + the oracle output
+        rs_host = np.asarray(rs)
+        devices = list(mesh.devices.reshape(-1))
+        by_device = {s.device: s.data for s in recv.addressable_shards}
+        shards = [
+            np.asarray(by_device[devices[j]]).reshape(-1).view(np.uint8)[
+                : int(rs_host[j].sum()) * row_bytes
+            ]
+            for j in range(n)
+        ]
+        best = 0.0
+        for it in range(iterations):
+            t0 = time.perf_counter()
+            shot()
+            dt = time.perf_counter() - t0
+            best = max(best, useful_bytes / dt / 1e9)
+            if report is not None:
+                report("max", it, dt, useful_bytes)
+        staged = n * n * slot
+        return shards, best, staged
+
+    def run_quota():
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=n * q, recv_rows=n * q, lane=lane
+        )
+        fn = build_exchange(mesh, spec)
+        nchunks = plan.chunks_per_round[0]
+        sub_size_mats = [
+            np.stack([chunk_size_rows(sizes[i], c, q) for i in range(n)])
+            for c in range(nchunks)
+        ]
+        size_mats = [jax.device_put(m, sharding) for m in sub_size_mats]
+
+        def shot():
+            outs = []
+            for c in range(nchunks):
+                data = jax.device_put(
+                    np.concatenate(
+                        [slice_subround(p, n, c, q) for p in payloads]
+                    ),
+                    sharding,
+                )
+                recv, _ = fn(data, size_mats[c])
+                outs.append(recv)
+            jax.block_until_ready(outs[-1])
+            return outs
+
+        outs = shot()  # warmup/compile + the compared output
+        devices = list(mesh.devices.reshape(-1))
+        shards = []
+        for j in range(n):
+            # consumer j reassembles from column j (rows j received per sender)
+            sub_sizes = [m[:, j] for m in sub_size_mats]
+            sub_shards = [
+                np.asarray(
+                    next(s.data for s in o.addressable_shards if s.device == devices[j])
+                ).reshape(-1).view(np.uint8)
+                for o in outs
+            ]
+            shards.append(reassemble_round(sub_shards, sub_sizes, row_bytes))
+        best = 0.0
+        for it in range(iterations):
+            t0 = time.perf_counter()
+            shot()
+            dt = time.perf_counter() - t0
+            best = max(best, useful_bytes / dt / 1e9)
+            if report is not None:
+                report("quota", it, dt, useful_bytes)
+        return shards, best, plan.staged_rows(n)
+
+    max_shards, max_gbps, max_staged = run_max()
+    quota_shards, quota_gbps, quota_staged = run_quota()
+    for j in range(n):
+        assert bytes(quota_shards[j]) == bytes(max_shards[j]), (
+            f"quota plan diverged from single-shot on consumer {j}"
+        )
+    return {
+        "executors": n,
+        "zipf_alpha": zipf_alpha,
+        "max_peer_rows": int(sizes.max()),
+        "quota_slot": q,
+        "subrounds": plan.num_subrounds,
+        "used_rows": used_rows,
+        "bit_identical": True,
+        "max": {
+            "gbps": max_gbps,
+            "staged_rows": max_staged,
+            "wire_bytes": max_staged * row_bytes,
+            "padding_fraction": 1.0 - used_rows / max_staged,
+        },
+        "quota": {
+            "gbps": quota_gbps,
+            "staged_rows": quota_staged,
+            "wire_bytes": quota_staged * row_bytes,
+            "padding_fraction": 1.0 - used_rows / quota_staged,
+        },
+    }
+
+
+def run_skew(args) -> None:
+    size = parse_size(args.block_size)
+    max_peer_rows = max(1, size // 512)
+
+    def report(plan, it, dt, tot):
+        print(
+            f"{plan} iter {it}: {tot} useful bytes in {dt*1e3:.1f} ms = "
+            f"{tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    r = measure_skew(
+        args.executors, max_peer_rows, args.iterations,
+        zipf_alpha=args.zipf_alpha, quota_rows=args.quota, report=report,
+    )
+    print(
+        f"zipf(alpha={r['zipf_alpha']}) over {r['executors']} executors: "
+        f"hottest lane {r['max_peer_rows']} rows, quota slot {r['quota_slot']} "
+        f"rows, {r['subrounds']} sub-rounds",
+        flush=True,
+    )
+    for plan in ("max", "quota"):
+        p = r[plan]
+        print(
+            f"{plan:5} plan: {p['gbps']:.2f} GB/s effective, "
+            f"{p['staged_rows']} staged rows, {p['wire_bytes']} wire bytes "
+            f"(dense), padding {p['padding_fraction']:.1%}",
+            flush=True,
+        )
+    staged_cut = r["max"]["staged_rows"] / max(r["quota"]["staged_rows"], 1)
+    print(
+        f"quota plan stages {staged_cut:.2f}x fewer rows; outputs bit-identical",
+        flush=True,
+    )
 
 
 def run_write(args) -> None:
@@ -966,6 +1195,8 @@ def main(argv=None) -> None:
         run_gather(args)
     elif args.mode == "write":
         run_write(args)
+    elif args.mode == "skew":
+        run_skew(args)
     elif args.mode == "sort":
         run_sort(args)
     elif args.mode == "columnar":

@@ -636,6 +636,11 @@ class HbmBlockStore:
         ``jax.Array`` on ``self.device`` when set, else host ndarray);
         ``send_sizes[p]`` is the used row count of peer p's region (the round's
         exchange size-matrix row).
+
+        The sealed payloads must stay valid until ``remove_shuffle``: the
+        quota-capped exchange (ops/skew.py, conf.slot_quota_rows) slices chunk
+        windows out of them across multiple pipelined sub-rounds, and the pull
+        fallback reads blocks from them after the exchange.
         """
         st = self._state(shuffle_id)
         with self._lock:
@@ -682,6 +687,17 @@ class HbmBlockStore:
         """Per-peer region size in bytes — public form of the staging geometry
         the transports need for offset math (was reached via ``_state``)."""
         return self._state(shuffle_id).region_size
+
+    def round_max_rows(self, shuffle_id: int) -> List[int]:
+        """Per staging round, this executor's hottest destination region in
+        rows (completed rollover rounds first, the live round last) — the
+        local input to the skew planner (ops/skew.plan_exchange; the SPMD
+        executor all-gathers these so every process derives one schedule)."""
+        st = self._state(shuffle_id)
+        with self._lock:
+            maxes = [int(used.max()) // st.alignment for _, used in st.prev_rounds]
+            maxes.append(int(st.region_used.max()) // st.alignment)
+        return maxes
 
     def host_staging_allocated(self, shuffle_id: int) -> bool:
         """True when the host staging buffer exists for this shuffle.  The
@@ -809,12 +825,25 @@ class HbmBlockStore:
 
     def stats(self, shuffle_id: int) -> Dict[str, object]:
         st = self._state(shuffle_id)
+        # per staging round (rollovers then the live round), (used, padded)
+        # rows of the slot layout — the store-side view of the imbalance the
+        # skew planner (conf.slot_quota_rows) caps.  Computed inline: _lock is
+        # a plain (non-reentrant) Lock, so this must not call the locked
+        # round_max_rows helper.
+        slot_rows = st.region_size // st.alignment
+        occupancy = []
+        for _, used in st.prev_rounds:
+            u = int(used.sum()) // st.alignment
+            occupancy.append((u, int(used.size) * slot_rows - u))
+        u = int(st.region_used.sum()) // st.alignment
+        occupancy.append((u, int(st.region_used.size) * slot_rows - u))
         return {
             "num_blocks": len(st.blocks),
             "bytes_staged": int(sum(e.length for e in st.blocks.values())),
             "bytes_padded": int(sum(e.padded for e in st.blocks.values())),
             "region_used": st.region_used.tolist(),
             "region_size": st.region_size,
+            "round_occupancy": occupancy,
             "committed_maps": sorted(st.committed_maps),
             "sealed": st.sealed,
             "device_mode": st.device_mode,

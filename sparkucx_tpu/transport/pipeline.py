@@ -52,7 +52,7 @@ from __future__ import annotations
 
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 from sparkucx_tpu.core.operation import OperationStats
 from sparkucx_tpu.utils.stats import StatsAggregator
@@ -71,6 +71,7 @@ class RoundPipeline:
         name: str = "pipeline",
         stats: Optional[StatsAggregator] = None,
         result_bytes: Optional[Callable[[Any], int]] = None,
+        result_rows: Optional[Callable[[Any], Tuple[int, int]]] = None,
     ) -> None:
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
@@ -80,6 +81,9 @@ class RoundPipeline:
         self.name = name
         self.stats = stats
         self._result_bytes = result_bytes
+        # result_rows(result) -> (used_rows, padded_rows): staging occupancy
+        # of the round, surfaced as the drain span's padding telemetry
+        self._result_rows = result_rows
 
     # -- instrumented stage wrappers --------------------------------------
 
@@ -100,7 +104,12 @@ class RoundPipeline:
             recv_size=self._result_bytes(result) if self._result_bytes else 0
         )
         if self.stats is not None:
-            self.stats.record(f"{self.name}.drain", op)
+            used, padded = (
+                self._result_rows(result) if self._result_rows else (0, 0)
+            )
+            self.stats.record(
+                f"{self.name}.drain", op, used_rows=used, padded_rows=padded
+            )
         return result
 
     # -- the engine --------------------------------------------------------

@@ -39,6 +39,13 @@ from sparkucx_tpu.ops.exchange import (
     build_exchange,
     rebucket_slots,
 )
+from sparkucx_tpu.ops.skew import (
+    chunk_size_rows,
+    plan_exchange,
+    quota_slot_rows,
+    reassemble_round,
+    slice_subround,
+)
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
 from sparkucx_tpu.transport.peer import PeerTransport
 from sparkucx_tpu.transport.pipeline import RoundPipeline
@@ -180,6 +187,12 @@ class SpmdShuffleExecutor:
 
         self._await_commits(shuffle_id)
         rounds = self.store.seal(shuffle_id)
+        if self.conf.slot_quota_rows > 0:
+            # Skew-aware path (ops/skew.py): quota-capped slots, hot lanes
+            # chunked across extra pipelined sub-rounds.  Separate engine so
+            # quota-off keeps this single-shot path byte-for-byte.
+            self._run_exchange_quota(shuffle_id, rounds)
+            return
         n = self.num_executors
         ax = self.conf.mesh_axis_name
         send_rows, lane = int(rounds[0][0].shape[0]), int(rounds[0][0].shape[1])
@@ -187,31 +200,7 @@ class SpmdShuffleExecutor:
         # varying-size shuffles share one compiled exchange per power-of-two
         # slot bucket; payloads relocate into the bucketed slot layout below.
         bucketed = bucket_send_rows(send_rows, n)
-
-        key = (bucketed, lane, self.conf.num_slices)
-        fn = self._exchange_fns.get(key)
-        if fn is None:
-            spec = ExchangeSpec(
-                num_executors=n, send_rows=bucketed, recv_rows=bucketed,
-                lane=lane, axis_name=ax,
-            )
-            if self.conf.num_slices > 1:
-                # multi-slice multi-host: the two-phase ICI+DCN route over the
-                # same global devices, slice-major (ops/hierarchy.py)
-                from sparkucx_tpu.ops.hierarchy import (
-                    build_hierarchical_exchange,
-                    make_hierarchical_mesh,
-                )
-
-                hmesh = make_hierarchical_mesh(
-                    self.conf.num_slices,
-                    n // self.conf.num_slices,
-                    devices=list(self.mesh.devices.reshape(-1)),
-                )
-                fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
-            else:
-                fn = build_exchange(self.mesh, spec)
-            self._exchange_fns[key] = fn
+        fn = self._exchange_fn_for(bucketed, lane)
 
         data_sharding = NamedSharding(self.mesh, P(ax, None))
         sizes_sharding = NamedSharding(self.mesh, P(ax, None))
@@ -274,14 +263,188 @@ class SpmdShuffleExecutor:
         pipe = RoundPipeline(
             depth, _submit, _drain, name="exchange.pipeline", stats=self.stats,
             result_bytes=lambda r: int(r[1].sum()) * self.conf.block_alignment,
+            # per-round staging occupancy of this process's shard (the slot
+            # padding conf.slot_quota_rows exists to shrink)
+            result_rows=lambda r: (int(r[1].sum()), bucketed - int(r[1].sum())),
         )
         results = pipe.run(num_rounds)
         recv_shards = [shard for shard, _ in results]
         recv_sizes_rows = [sizes for _, sizes in results]
+        for sizes in recv_sizes_rows:
+            active = int(np.count_nonzero(sizes))
+            self.stats.record_rows("exchange.lanes", active, sizes.size - active)
         self._recv[shuffle_id] = (recv_shards, recv_sizes_rows)
         logger.info(
             "exchange done: shuffle=%d rounds=%d depth=%d",
             shuffle_id, num_rounds, depth,
+        )
+
+    def _exchange_fn_for(self, bucketed_rows: int, lane: int):
+        """Compiled-exchange cache lookup, keyed on the bucketed slot layout.
+
+        ``bucketed_rows`` is re-bucketed here (``bucket_send_rows`` is a fixed
+        point on pow2-slot multiples, so callers that already bucketed — the
+        default path's ``bucket_send_rows``, the quota path's
+        ``quota_slot_rows * n`` — pass through unchanged) so a raw staging
+        size can never become a compile-cache key."""
+        n = self.num_executors
+        bucketed_rows = bucket_send_rows(bucketed_rows, n)
+        key = (bucketed_rows, lane, self.conf.num_slices)
+        fn = self._exchange_fns.get(key)
+        if fn is None:
+            spec = ExchangeSpec(
+                num_executors=n, send_rows=bucketed_rows, recv_rows=bucketed_rows,
+                lane=lane, axis_name=self.conf.mesh_axis_name,
+            )
+            if self.conf.num_slices > 1:
+                # multi-slice multi-host: the two-phase ICI+DCN route over the
+                # same global devices, slice-major (ops/hierarchy.py)
+                from sparkucx_tpu.ops.hierarchy import (
+                    build_hierarchical_exchange,
+                    make_hierarchical_mesh,
+                )
+
+                hmesh = make_hierarchical_mesh(
+                    self.conf.num_slices,
+                    n // self.conf.num_slices,
+                    devices=list(self.mesh.devices.reshape(-1)),
+                )
+                fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
+            else:
+                fn = build_exchange(self.mesh, spec)
+            self._exchange_fns[key] = fn
+        return fn
+
+    def _run_exchange_quota(self, shuffle_id: int, rounds) -> None:
+        """Quota-capped exchange (conf.slot_quota_rows > 0), SPMD flavor.
+
+        Every process derives the SAME sub-round plan — the per-round hottest
+        lane is all-gathered over the mesh (a tiny int collective, like the
+        round-count agreement) before planning, so the collective schedule
+        stays in lockstep.  The drain worker splices each staging round's
+        chunks back into the exact tight sender-major shard the single-shot
+        path produces (bit-equality pinned in tests/test_skew.py)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = self.num_executors
+        ax = self.conf.mesh_axis_name
+        send_rows, lane = int(rounds[0][0].shape[0]), int(rounds[0][0].shape[1])
+        staging_slot = send_rows // n
+        q = quota_slot_rows(staging_slot, self.conf.slot_quota_rows)
+        bucketed = q * n
+        fn = self._exchange_fn_for(bucketed, lane)
+
+        data_sharding = NamedSharding(self.mesh, P(ax, None))
+        sizes_sharding = NamedSharding(self.mesh, P(ax, None))
+
+        # Agree on the global round count, then on each round's hottest lane
+        # (max used rows over all senders/destinations): two tiny int
+        # all-gathers so every process plans the identical sub-round schedule.
+        my_rounds = np.array([[len(rounds)]], dtype=np.int32)
+        rc = jax.make_array_from_single_device_arrays(
+            (n, 1), sizes_sharding, [jax.device_put(my_rounds, self.device)]
+        )
+        num_rounds = int(np.max(jax.jit(lambda x: jnp.max(x), out_shardings=None)(rc)))
+        local_maxes = np.zeros((1, num_rounds), dtype=np.int32)
+        for rnd in range(min(len(rounds), num_rounds)):
+            local_maxes[0, rnd] = int(np.max(rounds[rnd][1], initial=0))
+        mx = jax.make_array_from_single_device_arrays(
+            (n, num_rounds), sizes_sharding, [jax.device_put(local_maxes, self.device)]
+        )
+        gm = jax.jit(lambda x: jnp.max(x, axis=0), out_shardings=None)(mx)
+        plan = plan_exchange(
+            [int(gm[rnd]) for rnd in range(num_rounds)],
+            staging_slot,
+            self.conf.slot_quota_rows,
+        )
+        subs = plan.subrounds()
+
+        def _submit_quota(sub_idx):
+            """One sub-round's assemble + H2D + collective dispatch: slice the
+            chunk window out of every peer slot (all processes submit the same
+            sub-round order, whatever the depth)."""
+            rnd, chunk, _ = subs[sub_idx]
+            if rnd < len(rounds):
+                payload, sizes = rounds[rnd]
+                sub_sizes = chunk_size_rows(sizes, chunk, q)
+                xp = jnp if isinstance(payload, jax.Array) else np
+                piece = slice_subround(payload, n, chunk, q, xp=xp)
+            else:
+                piece = np.zeros((bucketed, lane), dtype=np.int32)
+                sub_sizes = np.zeros(n, dtype=np.int32)
+            local_payload = jax.device_put(piece, self.device)
+            local_sizes = jax.device_put(
+                np.reshape(sub_sizes, (1, n)).astype(np.int32), self.device
+            )
+            data = jax.make_array_from_single_device_arrays(
+                (n * bucketed, lane), data_sharding, [local_payload]
+            )
+            size_mat = jax.make_array_from_single_device_arrays(
+                (n, n), sizes_sharding, [local_sizes]
+            )
+            recv, rs = fn(data, size_mat)
+            my_recv = next(
+                s.data for s in recv.addressable_shards if s.device == self.device
+            )
+            my_rs = next(
+                s.data for s in rs.addressable_shards if s.device == self.device
+            )
+            my_recv.copy_to_host_async()
+            my_rs.copy_to_host_async()
+            return my_recv, my_rs
+
+        # this staging round's drained sub-rounds, oldest first: appended and
+        # consumed ONLY by the pipeline's single in-order drain worker, so no
+        # lock is needed (closure-local, single-thread access by construction)
+        pending = []
+
+        def _drain_quota(sub_idx, ticket):
+            """Materialize one sub-round's shard; on a staging round's FINAL
+            chunk, splice the chunks back into the single-shot layout, apply
+            host_recv_mode, and emit the round's result (None otherwise)."""
+            rnd, chunk, nchunks = subs[sub_idx]
+            my_recv, my_rs = ticket
+            pending.append(
+                (
+                    np.asarray(my_recv).reshape(-1).view(np.uint8),
+                    np.asarray(my_rs).reshape(-1),
+                )
+            )
+            if chunk < nchunks - 1:
+                return None
+            parts = list(pending)  # exactly this round's sub-rounds, in order
+            pending.clear()
+            sub_sizes = [s for _, s in parts]
+            logical = np.sum(sub_sizes, axis=0).astype(np.int32)
+            assembled = reassemble_round(
+                [b for b, _ in parts], sub_sizes, self.conf.block_alignment
+            )
+            shard = self._host_shard(shuffle_id, rnd, assembled)
+            used = int(logical.sum())
+            return shard, logical, (used, nchunks * bucketed - used)
+
+        depth = max(1, int(self.conf.pipeline_depth))
+        pipe = RoundPipeline(
+            depth, _submit_quota, _drain_quota, name="exchange.pipeline",
+            stats=self.stats,
+            result_bytes=lambda r: (
+                0 if r is None else int(r[1].sum()) * self.conf.block_alignment
+            ),
+            result_rows=lambda r: (0, 0) if r is None else r[2],
+        )
+        results = [r for r in pipe.run(len(subs)) if r is not None]
+        recv_shards = [shard for shard, _, _ in results]
+        recv_sizes_rows = [sizes for _, sizes, _ in results]
+        for sizes in recv_sizes_rows:
+            active = int(np.count_nonzero(sizes))
+            self.stats.record_rows("exchange.lanes", active, sizes.size - active)
+        self._recv[shuffle_id] = (recv_shards, recv_sizes_rows)
+        logger.info(
+            "exchange done (quota): shuffle=%d rounds=%d subrounds=%d "
+            "quota_slot=%d depth=%d",
+            shuffle_id, num_rounds, len(subs), q, depth,
         )
 
     # -- post-exchange reads ----------------------------------------------
@@ -331,6 +494,10 @@ class SpmdShuffleExecutor:
 
         cap = self.conf.spill_disk_cap_bytes
         nbytes = int(host.nbytes)
+        if nbytes == 0:
+            # nothing received this round (quota-path tight shards can be
+            # empty); np.memmap cannot map a zero-byte file — keep the array
+            return host
         # reserve-then-write: check+charge atomic under the spill lock (the
         # drain worker charges here while remove_shuffle refunds concurrently)
         with self._spill_lock:

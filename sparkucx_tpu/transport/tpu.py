@@ -59,6 +59,14 @@ from sparkucx_tpu.ops.exchange import (
     make_mesh,
     rebucket_slots,
 )
+from sparkucx_tpu.ops.skew import (
+    chunk_size_rows,
+    pad_rows_pow2,
+    piece_slices,
+    plan_exchange,
+    reassemble_round,
+    slice_subround,
+)
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
 from sparkucx_tpu.transport.pipeline import RoundPipeline
 from sparkucx_tpu.utils.stats import StatsAggregator
@@ -291,6 +299,13 @@ class TpuShuffleCluster:
                         f"expected {(send_rows, lane)} — mismatched staging "
                         "geometry (stagingCapacity/blockAlignment) across executors"
                     )
+        if self.conf.slot_quota_rows > 0:
+            # Skew-aware path (ops/skew.py): cap every peer slot at the quota
+            # and chunk hotter lanes across extra pipelined sub-rounds.  Kept
+            # as a separate engine so quota-off preserves this single-shot
+            # path — including its donation of sealed payloads — byte-for-byte.
+            self._run_exchange_quota(meta, sealed, mode)
+            return
         fn = self._exchange_fn(send_rows)
         bucketed = bucket_send_rows(send_rows, self.num_executors)
 
@@ -370,7 +385,14 @@ class TpuShuffleCluster:
                 # host RSS stays bounded by ~one in-flight window however many
                 # rounds the shuffle spills.
                 with span("exchange.d2h_memmap", shuffle_id=shuffle_id, round=rnd):
-                    shards = self._memmap_round(meta, rnd, shard_by_device, devices, n)
+                    shards = self._memmap_round(
+                        meta,
+                        rnd,
+                        (
+                            np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
+                            for j in range(n)
+                        ),
+                    )
             else:
                 # One D2H per executor shard; fetches then slice host memory.
                 with span("exchange.d2h", shuffle_id=shuffle_id, round=rnd):
@@ -391,6 +413,12 @@ class TpuShuffleCluster:
             name="exchange.pipeline",
             stats=self.stats,
             result_bytes=lambda r: int(r[1].sum()) * self.row_bytes,
+            # staging occupancy per round: used rows vs. the slot padding the
+            # skew planner (conf.slot_quota_rows) exists to shrink
+            result_rows=lambda r: (
+                int(r[1].sum()),
+                n * bucketed - int(r[1].sum()),
+            ),
         )
         results = pipe.run(num_rounds)
 
@@ -399,6 +427,8 @@ class TpuShuffleCluster:
             if shards is not None:
                 meta.recv_shards.append(shards)
             meta.recv_sizes.append(sizes_host)
+            active = int(np.count_nonzero(sizes_host))
+            self.stats.record_rows("exchange.lanes", active, sizes_host.size - active)
             if dev_shards is not None:
                 if meta.recv_device is None:
                     meta.recv_device = []
@@ -407,9 +437,192 @@ class TpuShuffleCluster:
             meta.recv_shards = None  # explicit no-host-copy marker
         meta.exchanged = True
 
-    def _memmap_round(self, meta, rnd: int, shard_by_device, devices, n: int):
+    def _run_exchange_quota(self, meta, sealed, mode: str) -> None:
+        """Quota-capped exchange engine (conf.slot_quota_rows > 0).
+
+        Plans sub-rounds from the sealed size matrices (ops/skew.plan_exchange):
+        every sub-round stages the quota-capped pow2 slot, hot lanes chunk
+        across consecutive sub-rounds riding the same RoundPipeline overlap,
+        and the drain worker splices each staging round's chunks back into the
+        exact tight sender-major buffer the single-shot exchange produces
+        (bit-equality pinned in tests/test_skew.py).  The compiled-exchange
+        cache is keyed on the quota bucket, so skewed and uniform shuffles
+        whose caps land in one bucket share executables."""
+        import jax.numpy as jnp
+
+        shuffle_id = meta.shuffle_id
+        n = self.num_executors
+        num_rounds = max(len(s) for s in sealed)
+        first_payload = sealed[0][0][0]
+        send_rows, lane = int(first_payload.shape[0]), int(first_payload.shape[1])
+        staging_slot = send_rows // n
+        # cluster-wide hottest (sender, destination) lane per staging round
+        round_maxes = [
+            max(
+                (int(np.max(s[rnd][1], initial=0)) for s in sealed if rnd < len(s)),
+                default=0,
+            )
+            for rnd in range(num_rounds)
+        ]
+        plan = plan_exchange(round_maxes, staging_slot, self.conf.slot_quota_rows)
+        q = plan.slot_rows
+        bucketed = q * n
+        fn = self._exchange_fn(bucketed)  # pow2 slot: bucketing fixed point
+        subs = plan.subrounds()
+
+        ax = self.conf.mesh_axis_name
+        data_sharding = NamedSharding(self.mesh, P(ax, None))
+        devices = list(self.mesh.devices.reshape(-1))
+        keep_device = self.conf.keep_device_recv
+
+        def _submit_quota(sub_idx):
+            """One sub-round's H2D + collective dispatch + async D2H kick-off
+            — the quota twin of _submit, slicing chunk windows out of every
+            peer slot instead of relocating whole slots."""
+            rnd, chunk, _ = subs[sub_idx]
+            payloads, size_rows = [], []
+            for s in sealed:
+                if rnd < len(s):
+                    payloads.append(s[rnd][0])
+                    size_rows.append(s[rnd][1])
+                else:  # executor had fewer spill rounds: empty contribution
+                    payloads.append(None)
+                    size_rows.append(np.zeros(n, dtype=np.int32))
+            sub_sizes = np.stack([chunk_size_rows(sr, chunk, q) for sr in size_rows])
+            if all(isinstance(p, jax.Array) for p in payloads):
+                # device-sealed rounds: slice each chunk window on its device
+                pieces = [slice_subround(p, n, chunk, q, xp=jnp) for p in payloads]
+                data = jax.make_array_from_single_device_arrays(
+                    (n * bucketed, lane), data_sharding, pieces
+                )
+            else:
+                host = np.zeros((n * bucketed, lane), dtype=np.int32)
+                for i, p in enumerate(payloads):
+                    if p is not None:
+                        # mixed host/device rounds pay one D2H here, same as
+                        # the default assemble (allowlisted host-sync cost)
+                        arr = np.asarray(p) if isinstance(p, jax.Array) else p
+                        host[i * bucketed : (i + 1) * bucketed] = slice_subround(
+                            arr, n, chunk, q
+                        )
+                data = jax.device_put(host, data_sharding)
+            size_mat = jax.device_put(
+                sub_sizes.astype(np.int32), NamedSharding(self.mesh, P(ax, None))
+            )
+            with span(
+                "exchange.collective",
+                shuffle_id=shuffle_id,
+                round=rnd,
+                chunk=chunk,
+                rows=bucketed,
+            ):
+                recv, recv_sizes = fn(data, size_mat)
+            shard_by_device = {s.device: s.data for s in recv.addressable_shards}
+            if mode != "device":
+                for a in shard_by_device.values():
+                    a.copy_to_host_async()
+            recv_sizes.copy_to_host_async()
+            return recv, recv_sizes, shard_by_device
+
+        # this staging round's drained sub-rounds, oldest first: appended and
+        # consumed ONLY by the pipeline's single in-order drain worker, so no
+        # lock is needed (closure-local, single-thread access by construction)
+        pending = []
+
+        def _drain_quota(sub_idx, ticket):
+            """Complete one sub-round host-side; on a staging round's FINAL
+            chunk, splice the accumulated chunks back into the single-shot
+            receive layout and emit the round's result (None otherwise)."""
+            rnd, chunk, nchunks = subs[sub_idx]
+            recv, recv_sizes, shard_by_device = ticket
+            sizes_host = np.asarray(recv_sizes)
+            if mode == "device":
+                jax.block_until_ready(recv)
+                host_parts = None
+            else:
+                with span("exchange.d2h", shuffle_id=shuffle_id, round=rnd, chunk=chunk):
+                    host_parts = [
+                        np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
+                        for j in range(n)
+                    ]
+            dev_parts = (
+                [shard_by_device[devices[j]] for j in range(n)] if keep_device else None
+            )
+            pending.append((sizes_host, host_parts, dev_parts))
+            if chunk < nchunks - 1:
+                return None
+            # final chunk: pending holds exactly this round's sub-rounds
+            parts = list(pending)
+            pending.clear()
+            sub_size_mats = [p[0] for p in parts]
+            logical = np.sum(sub_size_mats, axis=0).astype(np.int32)
+            shards = dev_shards = None
+            if mode != "device":
+                assembled = [
+                    reassemble_round(
+                        [p[1][j] for p in parts],
+                        [m[j] for m in sub_size_mats],
+                        self.row_bytes,
+                    )
+                    for j in range(n)
+                ]
+                if mode == "memmap":
+                    with span("exchange.d2h_memmap", shuffle_id=shuffle_id, round=rnd):
+                        shards = self._memmap_round(meta, rnd, assembled)
+                else:
+                    shards = assembled
+            if keep_device:
+                dev_shards = []
+                for j in range(n):
+                    splice = piece_slices([m[j] for m in sub_size_mats])
+                    pieces = [
+                        parts[c][2][j][start : start + rows] for c, start, rows in splice
+                    ]
+                    if pieces:
+                        # pow2-pad so the block gather's jit cache stays
+                        # bounded despite data-dependent reassembled rows
+                        dshard = pad_rows_pow2(jnp.concatenate(pieces), xp=jnp)
+                    else:
+                        dshard = jnp.zeros((1, lane), dtype=parts[0][2][j].dtype)
+                    dev_shards.append(dshard)
+            used = int(logical.sum())
+            staged = nchunks * n * bucketed
+            return shards, logical, dev_shards, (used, staged - used)
+
+        depth = max(1, int(self.conf.pipeline_depth))
+        pipe = RoundPipeline(
+            depth,
+            _submit_quota,
+            _drain_quota,
+            name="exchange.pipeline",
+            stats=self.stats,
+            result_bytes=lambda r: 0 if r is None else int(r[1].sum()) * self.row_bytes,
+            result_rows=lambda r: (0, 0) if r is None else r[3],
+        )
+        results = [r for r in pipe.run(len(subs)) if r is not None]
+
+        meta.recv_shards, meta.recv_sizes = [], []
+        for shards, logical, dev_shards, _occ in results:
+            if shards is not None:
+                meta.recv_shards.append(shards)
+            meta.recv_sizes.append(logical)
+            active = int(np.count_nonzero(logical))
+            self.stats.record_rows("exchange.lanes", active, logical.size - active)
+            if dev_shards is not None:
+                if meta.recv_device is None:
+                    meta.recv_device = []
+                meta.recv_device.append(dev_shards)
+        if mode == "device":
+            meta.recv_shards = None  # explicit no-host-copy marker
+        meta.exchanged = True
+
+    def _memmap_round(self, meta, rnd: int, host_views):
         """Spill one round's received shards to a disk-backed mapping and
-        return uint8 ``np.memmap`` views (host_recv_mode='memmap')."""
+        return uint8 ``np.memmap`` views (host_recv_mode='memmap').
+
+        ``host_views`` yields one flat uint8 array per executor; passing a
+        generator keeps host RSS at ~one transient shard — each view is
+        materialized, written, and dropped before the next is produced."""
         import os
         import tempfile
 
@@ -417,10 +630,15 @@ class TpuShuffleCluster:
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
         views = []
-        for j in range(n):
-            host = np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
+        for j, host in enumerate(host_views):
             cap = self.conf.spill_disk_cap_bytes
             nbytes = int(host.nbytes)
+            if nbytes == 0:
+                # nothing received (a quota-path tight shard can be empty);
+                # np.memmap cannot map a zero-byte file, and there is nothing
+                # to spill — keep the empty array itself
+                views.append(host)
+                continue
             # reserve-then-write keeps check+charge atomic under the lock;
             # any write failure refunds the reservation and removes the
             # half-written file so the budget cannot leak

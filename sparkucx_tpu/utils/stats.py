@@ -26,6 +26,10 @@ class StatsSummary:
     max_ns: Optional[int] = None
     p50_ns: Optional[int] = None
     p99_ns: Optional[int] = None
+    #: exchange staging occupancy (ops/skew.py telemetry): rows that carried
+    #: payload vs rows staged only as slot padding, summed over this kind's ops
+    used_rows: int = 0
+    padded_rows: int = 0
 
     @property
     def mean_ns(self) -> float:
@@ -34,6 +38,13 @@ class StatsSummary:
     @property
     def throughput_gbps(self) -> float:
         return self.bytes / self.total_ns if self.total_ns else 0.0  # bytes/ns == GB/s
+
+    @property
+    def padding_fraction(self) -> float:
+        """Fraction of staged rows that were slot padding — the imbalance the
+        skew planner (conf.slot_quota_rows) exists to shrink."""
+        staged = self.used_rows + self.padded_rows
+        return self.padded_rows / staged if staged else 0.0
 
 
 class StatsAggregator:
@@ -49,24 +60,47 @@ class StatsAggregator:
         self._bytes: Dict[str, int] = {}  #: guarded by self._lock
         self._total_ns: Dict[str, int] = {}  #: guarded by self._lock
         self._samples: Dict[str, List[int]] = {}  #: guarded by self._lock
+        # padding telemetry (ops/skew.py): written from the pipeline drain
+        # worker alongside the timing counters — same lock, same discipline
+        self._used_rows: Dict[str, int] = {}  #: guarded by self._lock
+        self._padded_rows: Dict[str, int] = {}  #: guarded by self._lock
 
-    def record(self, kind: str, stats: OperationStats) -> None:
+    def record(
+        self,
+        kind: str,
+        stats: OperationStats,
+        *,
+        used_rows: int = 0,
+        padded_rows: int = 0,
+    ) -> None:
         elapsed = stats.elapsed_ns()
         with self._lock:
             self._ops[kind] = self._ops.get(kind, 0) + 1
             self._bytes[kind] = self._bytes.get(kind, 0) + stats.recv_size
             self._total_ns[kind] = self._total_ns.get(kind, 0) + elapsed
+            self._used_rows[kind] = self._used_rows.get(kind, 0) + used_rows
+            self._padded_rows[kind] = self._padded_rows.get(kind, 0) + padded_rows
             samples = self._samples.setdefault(kind, [])
             if len(samples) < self._RESERVOIR:
                 samples.append(elapsed)
             else:  # cheap deterministic reservoir: overwrite round-robin
                 samples[self._ops[kind] % self._RESERVOIR] = elapsed
 
+    def record_rows(self, kind: str, used_rows: int, padded_rows: int) -> None:
+        """Occupancy-only record (no timed operation behind it): per-round
+        lane-occupancy counters the transports emit once per exchange."""
+        with self._lock:
+            self._used_rows[kind] = self._used_rows.get(kind, 0) + used_rows
+            self._padded_rows[kind] = self._padded_rows.get(kind, 0) + padded_rows
+
     def summary(self, kind: str) -> StatsSummary:
         with self._lock:
             ops = self._ops.get(kind, 0)
+            used = self._used_rows.get(kind, 0)
+            padded = self._padded_rows.get(kind, 0)
             if not ops:
-                return StatsSummary()
+                # row-only kinds (record_rows) still surface their occupancy
+                return StatsSummary(used_rows=used, padded_rows=padded)
             samples = sorted(self._samples.get(kind, []))
             return StatsSummary(
                 ops=ops,
@@ -76,19 +110,27 @@ class StatsAggregator:
                 max_ns=samples[-1] if samples else None,
                 p50_ns=samples[len(samples) // 2] if samples else None,
                 p99_ns=samples[min(len(samples) - 1, int(len(samples) * 0.99))] if samples else None,
+                used_rows=used,
+                padded_rows=padded,
             )
 
     def kinds(self) -> List[str]:
         with self._lock:
-            return sorted(self._ops)
+            return sorted(set(self._ops) | set(self._used_rows))
 
     def report(self) -> str:
         lines = []
         for kind in self.kinds():
             s = self.summary(kind)
-            lines.append(
+            line = (
                 f"{kind}: ops={s.ops} bytes={s.bytes} mean={s.mean_ns/1e3:.1f}us "
                 f"p50={0 if s.p50_ns is None else s.p50_ns/1e3:.1f}us "
                 f"p99={0 if s.p99_ns is None else s.p99_ns/1e3:.1f}us"
             )
+            if s.used_rows or s.padded_rows:
+                line += (
+                    f" used_rows={s.used_rows} padded_rows={s.padded_rows} "
+                    f"padding={s.padding_fraction:.1%}"
+                )
+            lines.append(line)
         return "\n".join(lines)

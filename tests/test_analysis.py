@@ -288,6 +288,27 @@ class TestCacheHygiene:
         )
         assert findings == []
 
+    def test_skew_planner_rebind_counts_as_bucketed(self):
+        """Shape params flowing through the skew planner (quota_slot_rows /
+        plan_exchange, ops/skew.py) are pow2-bucketed by construction and
+        must sanctify a cache key like bucket_send_rows does."""
+        findings = run_source(
+            src(
+                """
+                class S:
+                    def get(self, rows, depth):
+                        rows = quota_slot_rows(rows, self.conf.slot_quota_rows)
+                        depth = plan_exchange([depth], depth, 0).slot_rows
+                        key = (rows, depth)
+                        if key not in self._exchange_cache:
+                            self._exchange_cache[key] = build_thing(rows, depth)
+                        return self._exchange_cache[key]
+                """
+            ),
+            passes=["cache-hygiene"],
+        )
+        assert findings == []
+
     def test_lru_cache_builder_flagged(self):
         findings = run_source(
             src(

@@ -34,6 +34,7 @@ CHILD = textwrap.dedent(
         num_slices=int(os.environ.get("TEST_NUM_SLICES", "1")),
         host_recv_mode=os.environ.get("TEST_HOST_RECV_MODE", "array"),
         spill_dir=os.environ.get("TEST_SPILL_DIR") or None,
+        slot_quota_rows=int(os.environ.get("TEST_SLOT_QUOTA_ROWS", "0")),
     )
     ex = SpmdShuffleExecutor(conf, coordinator_address=coord, num_processes=2, process_id=pid)
     assert ex.num_executors == 2, ex.num_executors
@@ -133,6 +134,38 @@ def test_two_process_spmd_exchange_two_slices():
     driver_addr = f"{driver.address[0]}:{driver.address[1]}"
     env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     env["TEST_NUM_SLICES"] = "2"
+    script = CHILD.format(root=ROOT)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(pid), coord, driver_addr],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=ROOT, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"child {pid} failed:\n{out[-3000:]}"
+            assert f"CHILD_PASS pid={pid}" in out, out[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        driver.close()
+
+
+def test_two_process_spmd_exchange_quota():
+    """Multi-controller + slotQuotaRows: both processes must all-gather the
+    same sub-round plan (lockstep collectives) and splice chunked receive
+    bytes back to the oracle.  Quota of 1 row with ≤1500-byte payloads (3
+    rows at 512 alignment) forces 3 sub-rounds per staging round."""
+    from sparkucx_tpu.parallel.bootstrap import DriverEndpoint
+
+    driver = DriverEndpoint()
+    coord = f"127.0.0.1:{_free_port()}"
+    driver_addr = f"{driver.address[0]}:{driver.address[1]}"
+    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["TEST_SLOT_QUOTA_ROWS"] = "1"
     script = CHILD.format(root=ROOT)
     procs = [
         subprocess.Popen(
