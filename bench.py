@@ -21,7 +21,8 @@ analogue).  ``vs_baseline`` = tpu_gbps / tcp_gbps.
 
 Sub-metrics (same JSON line): ``gather_gbps`` — the device-side ragged block
 gather (ops/pallas_kernels.py), ``sort_mrows_s`` — the device-resident TeraSort
-step (ops/sort.py).
+step (ops/sort.py), ``wire`` — the striped loopback peer wire (streams=1 vs 4,
+perf/benchmark.py measure_wire; TPU-free, measured after the TCP baseline).
 
 A small end-to-end shuffle (stage -> commit -> exchange -> fetch vs oracle) runs
 untimed first as an integrity gate.
@@ -290,6 +291,25 @@ def main():
     except Exception as e:
         tcp = None
         RESULT["tcp_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # 1b. Striped-wire sub-metric — also TPU-free (loopback peer wire), so it
+    # runs before the chip probe and survives null rounds.  Measured AFTER the
+    # TCP baseline so it cannot perturb tcp_gbps.  NOTE: this harness has one
+    # CPU core, so loopback is core-bound (~2.4 GB/s aggregate); the striping
+    # gain here comes from deeper kernel socket buffering, not parallel recv.
+    try:
+        from sparkucx_tpu.perf.benchmark import measure_wire
+
+        w = measure_wire(streams_list=(1, 4), num_blocks=8, block_bytes=32 << 20,
+                         iterations=4)
+        RESULT["wire"] = {
+            f"streams{s}_gbps": round(r["gbps"], 3) for s, r in w.items()
+        }
+        if w.get(1, {}).get("gbps") and w.get(4, {}).get("gbps"):
+            RESULT["wire"]["stripe_speedup"] = round(w[4]["gbps"] / w[1]["gbps"], 3)
+            RESULT["wire"]["syscalls_per_mb"] = round(w[4]["syscalls_per_mb"], 3)
+    except Exception as e:
+        RESULT["wire_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # 2. Bounded chip probe — never touch the backend in-process before this.
     platform, probe_err = probe_tpu(budget_left)

@@ -20,6 +20,14 @@ from __future__ import annotations
 #:   ``mm._mmap.close()`` is the canonical numpy idiom for releasing the fd
 #:   eagerly (numpy/numpy#13510); guarded by try/except for numpy internals
 #:   moving.
+#: - daemon.py / peer.py ``._sendmsg_all``: the partial-send/IOV_MAX-safe
+#:   vectored send loop lives as a ``BlockServer`` staticmethod; the store
+#:   daemon's serve path and peer.py's own ``_ServerGroup`` lane senders
+#:   (same file, but the pass keys on the attribute) reuse it so every wire
+#:   writer handles short ``sendmsg`` returns identically.  It is a pure
+#:   function of (socket, parts) — no BlockServer state — kept underscored
+#:   because the iovec windowing is an implementation detail of the wire,
+#:   not transport API.  Reviewed with the striped-wire PR.
 #:
 #: host-sync:
 #: - "drain stage": the drain lane IS the pipeline's sanctioned host-sync
@@ -51,6 +59,8 @@ ALLOWLIST = {
     ("store/hbm_store.py", "private-access", "._lock"),
     ("store/hbm_store.py", "private-access", "._rollover"),  # also ._rollover_device
     ("core/block.py", "private-access", "._mmap"),
+    ("shuffle/daemon.py", "private-access", "._sendmsg_all"),
+    ("transport/peer.py", "private-access", "._sendmsg_all"),
     ("transport/tpu.py", "host-sync", "drain stage"),
     ("transport/spmd.py", "host-sync", "drain stage"),
     ("perf/benchmark.py", "host-sync", "drain stage"),

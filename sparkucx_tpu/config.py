@@ -82,6 +82,30 @@ class TpuShuffleConf:
     #: never retries — SURVEY.md section 5.3); 0 disables the fallback.
     fetch_retries: int = 1
 
+    # striped zero-copy wire path (transport/peer.py)
+    #: Parallel TCP connections (lanes) per peer pair.  1 (default) is the
+    #: single-lane path, byte-identical to the pre-striping wire protocol.
+    #: With K > 1, large fetch replies stream as fixed chunk frames striped
+    #: round-robin across the K lanes (AM ids 5-6, core/definitions.py) and
+    #: each lane's recv thread scatters its chunks into the result buffers
+    #: concurrently — the FAST/SparkUCX parallel-stream prescription for
+    #: saturating a host link from Python.
+    wire_streams: int = 1
+    #: Chunk frame payload size for striped replies.  Smaller chunks spread
+    #: a single hot reply across lanes sooner; larger chunks cut per-frame
+    #: syscall + header overhead.  4 MiB is the measured knee on loopback
+    #: (1 MiB loses ~15% to per-frame overhead; see docs/PERF.md).
+    wire_chunk_bytes: int = 4 << 20
+    #: Reduce-side fetch credit budget in bytes: the reader keeps issuing
+    #: fetch windows while their expected reply bytes fit the budget, so many
+    #: windows pipeline instead of strictly alternating request/drain.  A
+    #: request larger than the whole budget is admitted alone (never starved).
+    #: 0 disables pipelining — one window in flight, the historical loop.
+    wire_credit_bytes: int = 64 << 20
+    #: SO_SNDBUF/SO_RCVBUF for every peer/daemon socket, both ends; 0 keeps
+    #: the platform default plus the transport's builtin 4 MiB reply windows.
+    wire_sock_buf_bytes: int = 0
+
     # staged store (HBM; NVKV analogue).  512 = one exchange row (128 int32
     # lanes, the native XLA:TPU tile width) and exactly NVKV's sector alignment
     # (NvkvHandler.scala:244-256).
@@ -236,6 +260,10 @@ class TpuShuffleConf:
             ("numClientWorkers", "num_client_workers", int),
             ("maxBlocksPerRequest", "max_blocks_per_request", int),
             ("fetchRetries", "fetch_retries", int),
+            ("wire.streams", "wire_streams", int),
+            ("wire.chunkBytes", "wire_chunk_bytes", parse_size),
+            ("wire.creditBytes", "wire_credit_bytes", parse_size),
+            ("wire.sockBufBytes", "wire_sock_buf_bytes", parse_size),
             ("blockAlignment", "block_alignment", parse_size),
             ("stagingCapacity", "staging_capacity_per_executor", parse_size),
             ("storePort", "store_port", int),
@@ -288,6 +316,14 @@ class TpuShuffleConf:
             raise ValueError("pipeline_depth must be >= 1 (1 = serial engine)")
         if self.slot_quota_rows < 0:
             raise ValueError("slot_quota_rows must be >= 0 (0 = no quota)")
+        if self.wire_streams < 1:
+            raise ValueError("wire_streams must be >= 1 (1 = single-lane wire)")
+        if self.wire_chunk_bytes < 4096:
+            raise ValueError("wire_chunk_bytes must be >= 4096")
+        if self.wire_credit_bytes < 0:
+            raise ValueError("wire_credit_bytes must be >= 0 (0 = no pipelining)")
+        if self.wire_sock_buf_bytes < 0:
+            raise ValueError("wire_sock_buf_bytes must be >= 0 (0 = platform default)")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

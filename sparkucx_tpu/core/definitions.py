@@ -10,7 +10,21 @@ InitExecutorAck        1  handshake ack: remote store connected
 MapperInfo             2  map-side commit: {numPartitions, mapId, (offset,len)*R}
 FetchBlockReq          3  fetch one (shuffleId, mapId, reduceId) block
 FetchBlockReqAck       4  fetch reply: block bytes (eager) or rndv handle
+FetchBlockChunk        5  striped-wire continuation: one chunk of a streaming
+                          fetch reply (tag, block, seq, offset) + payload
+WireHello              6  striped-wire lane handshake: (group, lane, nlanes,
+                          chunk_bytes) — joins this connection to a stripe group
 ====================  ==  =======================================================
+
+Ids 5-6 extend the reference schema for the striped zero-copy wire path: a
+fetch reply in striped mode is a size *manifest* (a FetchBlockReqAck frame with
+``body_len == 0``) plus ``FetchBlockChunk`` frames carrying fixed-size slices
+of the reply body round-robin across the group's lanes.  Chunks address their
+destination directly — ``(tag, block index, offset within block)`` — so lanes
+need no cross-lane ordering and the manifest may arrive before, between, or
+after the chunks; the fetch completes when the manifest has arrived AND every
+payload byte has been scattered.  ``wire.streams = 1`` never emits ids 5-6:
+the single-lane wire stays byte-identical to the pre-striping protocol.
 
 Frame format (all little-endian):  ``<u32 am_id> <u64 header_len> <u64 body_len>
 <header bytes> <body bytes>`` — the (header, body) split mirrors jucx's
@@ -33,6 +47,8 @@ class AmId(enum.IntEnum):
     MAPPER_INFO = 2
     FETCH_BLOCK_REQ = 3
     FETCH_BLOCK_REQ_ACK = 4
+    FETCH_BLOCK_CHUNK = 5
+    WIRE_HELLO = 6
 
 
 _FRAME = struct.Struct("<IQQ")
@@ -68,6 +84,35 @@ def pack_fetch_req(shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
 
 def unpack_fetch_req(data: bytes) -> Tuple[int, int, int]:
     return _FETCH_REQ.unpack_from(data)
+
+
+#: FetchBlockChunk header: which batch (tag), which block of the batch, the
+#: global chunk sequence number (stripe lane = seq % nlanes; telemetry and
+#: interleave testing), and the chunk's offset *within its block* — the chunk
+#: is self-addressing, so lanes never need cross-lane ordering.
+_CHUNK_HDR = struct.Struct("<QIIQ")
+CHUNK_HEADER_SIZE = _CHUNK_HDR.size
+
+#: WireHello header: stripe-group id (client-random u64), this connection's
+#: lane index, the group's lane count, and the chunk frame size the client
+#: expects replies striped into.
+_HELLO = struct.Struct("<QIIQ")
+
+
+def pack_chunk_hdr(tag: int, block: int, seq: int, offset: int) -> bytes:
+    return _CHUNK_HDR.pack(tag, block, seq, offset)
+
+
+def unpack_chunk_hdr(data) -> Tuple[int, int, int, int]:
+    return _CHUNK_HDR.unpack_from(data)
+
+
+def pack_wire_hello(group: int, lane: int, nlanes: int, chunk_bytes: int) -> bytes:
+    return _HELLO.pack(group, lane, nlanes, chunk_bytes)
+
+
+def unpack_wire_hello(data) -> Tuple[int, int, int, int]:
+    return _HELLO.unpack_from(data)
 
 
 @dataclass(frozen=True)

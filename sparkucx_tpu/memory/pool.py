@@ -164,6 +164,26 @@ class AllocatorStack:
         self.sanitizer.on_checkout(mb)
         return mb
 
+    def get_n(self, count: int) -> List[MemoryBlock]:
+        """Batch checkout: ``count`` blocks for ONE lock round-trip — the
+        fetch reader allocates whole request windows at a time, and taking
+        the stack lock per block showed up once windows grew credit-deep."""
+        out: List[MemoryBlock] = []
+        with self._lock:
+            self.total_requested += count
+            while len(self._free) < count:
+                self._allocate_more()
+            for _ in range(count):
+                mb = self._free.pop()
+                slab = mb.allocator_token
+                with slab.lock:
+                    slab.refcount += 1
+                mb.rearm()
+                out.append(mb)
+        for mb in out:
+            self.sanitizer.on_checkout(mb)
+        return out
+
     def preallocate(self, count: int) -> None:
         """MemoryPool.scala:141-147 warm-up."""
         with self._lock:
@@ -229,6 +249,24 @@ class MemoryPool:
         mb = self._stack_for(self._bucket(size)).get()
         mb.size = size  # sized view over the bucket buffer
         return mb
+
+    def get_many(self, sizes) -> List[MemoryBlock]:
+        """Order-preserving batch checkout: requests are grouped by bucket so
+        a fetch window of K same-bucket blocks pays one stack-lock round-trip
+        instead of K (the credit-pipelined reader's allocation path)."""
+        sizes = list(sizes)
+        for s in sizes:
+            if s <= 0:
+                raise ValueError(f"invalid allocation size {s}")
+        by_bucket: Dict[int, List[int]] = {}
+        for i, s in enumerate(sizes):
+            by_bucket.setdefault(self._bucket(s), []).append(i)
+        out: List[Optional[MemoryBlock]] = [None] * len(sizes)
+        for bucket, idxs in by_bucket.items():
+            for i, mb in zip(idxs, self._stack_for(bucket).get_n(len(idxs))):
+                mb.size = sizes[i]  # sized view over the bucket buffer
+                out[i] = mb
+        return out
 
     def put(self, mb: MemoryBlock) -> None:
         mb.close()

@@ -25,6 +25,12 @@ Modes:
 * ``client`` — connect, issue -o-deep batches of ``fetch_blocks_by_block_ids``
   across -t threads, spin ``progress()``, print per-batch bandwidth
   (UcxPerfBenchmark.scala:100-154, bandwidth print :140-143).
+* ``wire`` — loopback peer-fetch throughput at several ``wire.streams`` lane
+  counts (the striped zero-copy wire path): one in-process BlockServer, one
+  client per streams value fetching -n blocks of -s bytes per iteration.
+  Prints GB/s, receive syscalls/MB, and p99 frame stall per streams value;
+  ``--streams 1`` is the byte-identical pre-striping wire, so it doubles as
+  the before/after baseline.
 * ``superstep`` — the TPU-only mode with no reference counterpart: time the
   collective exchange on the local mesh (what bench.py wraps).
 * ``pipeline`` — multi-round (spilled) shuffle throughput with host staging in
@@ -79,7 +85,7 @@ def _parse_args(argv):
         "mode",
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
-            "columnar", "groupby", "join", "write", "skew",
+            "columnar", "groupby", "join", "write", "skew", "wire",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -133,6 +139,14 @@ def _parse_args(argv):
     p.add_argument(
         "--depths", default="1,2,3",
         help="comma-separated pipeline depths to compare (pipeline mode)",
+    )
+    p.add_argument(
+        "--streams", default="1,2,4",
+        help="comma-separated wire.streams values to compare (wire mode)",
+    )
+    p.add_argument(
+        "--chunk-bytes", default="4m",
+        help="chunk frame size for striped lanes (wire mode; wire.chunkBytes)",
     )
     p.add_argument(
         "--zipf-alpha", type=float, default=1.2,
@@ -273,6 +287,87 @@ def run_superstep(args) -> None:
         )
 
 
+def measure_wire(
+    streams_list=(1, 2, 4),
+    num_blocks: int = 8,
+    block_bytes: int = 32 << 20,
+    iterations: int = 5,
+    chunk_bytes: int = 4 << 20,
+    report=None,
+) -> dict:
+    """Measurement core of the ``wire`` mode — loopback peer-fetch throughput
+    at several ``wire.streams`` lane counts (the striped zero-copy wire path).
+
+    One BlockServer-backed PeerTransport registers ``num_blocks`` blocks of
+    ``block_bytes``; for each streams value a fresh client fetches the whole
+    set per iteration (the whole batch in flight, the -o = -n shape).  Per
+    streams value the result carries best GB/s, receive syscalls per MB
+    (``recv_into`` calls / MB landed, from ``wire_lane_stats``), and the worst
+    lane's p99 frame stall.  ``streams = 1`` is the byte-identical single-lane
+    wire, so its row IS the pre-striping baseline.  ``report(streams, it,
+    seconds, bytes)`` per iteration.  Shared by the CLI and bench.py."""
+    server = PeerTransport(TpuShuffleConf(), executor_id=0)
+    addr = server.init()
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, size=block_bytes, dtype=np.uint8)
+    bids = [ShuffleBlockId(0, 0, i) for i in range(num_blocks)]
+    for bid in bids:
+        server.register(bid, BytesBlock(payload.tobytes()))
+    total = num_blocks * block_bytes
+    results = {}
+    try:
+        for streams in streams_list:
+            conf = TpuShuffleConf(
+                wire_streams=streams,
+                wire_chunk_bytes=chunk_bytes,
+                max_blocks_per_request=num_blocks,
+            )
+            client = PeerTransport(conf, executor_id=100 + streams)
+            client.add_executor(0, addr)
+            bufs = [
+                MemoryBlock(np.zeros(block_bytes, dtype=np.uint8), size=block_bytes)
+                for _ in range(num_blocks)
+            ]
+
+            def fetch_once():
+                reqs = client.fetch_blocks_by_block_ids(
+                    0, bids, bufs, [None] * num_blocks
+                )
+                while not all(r.completed() for r in reqs):
+                    client.progress()
+                    client.wait_for_activity(0.002)
+                for r in reqs:
+                    res = r.wait(1)
+                    assert res.status == OperationStatus.SUCCESS, str(res.error)
+
+            fetch_once()  # warmup: connect (+ stripe handshake), page in
+            assert bytes(bufs[0].host_view()[:64].tobytes()) == payload[:64].tobytes()
+            best = 0.0
+            t_all0 = time.perf_counter()
+            for it in range(iterations):
+                t0 = time.perf_counter()
+                fetch_once()
+                dt = time.perf_counter() - t0
+                best = max(best, total / dt / 1e9)
+                if report is not None:
+                    report(streams, it, dt, total)
+            wall = time.perf_counter() - t_all0
+            lanes = client.wire_lane_stats()
+            rx_bytes = sum(s["rx_bytes"] for s in lanes)
+            rx_syscalls = sum(s["rx_syscalls"] for s in lanes)
+            results[streams] = {
+                "gbps": best,
+                "mean_gbps": total * iterations / wall / 1e9,
+                "syscalls_per_mb": rx_syscalls / max(rx_bytes / 1e6, 1e-9),
+                "p99_frame_stall_ms": max(s["rx_stall_p99_ns"] for s in lanes) / 1e6,
+                "lanes": len(lanes),
+            }
+            client.close()
+    finally:
+        server.close()
+    return results
+
+
 def measure_pipeline(
     executors: int, round_bytes: int, rounds: int, iterations: int,
     depths=(1, 2, 3), report=None,
@@ -394,6 +489,36 @@ def measure_gather(
         if report is not None:
             report(it, dt, tot, fn.impl)
     return best
+
+
+def run_wire(args) -> None:
+    size = parse_size(args.block_size)
+    streams_list = tuple(int(s) for s in args.streams.split(","))
+
+    def report(streams, it, dt, tot):
+        print(
+            f"streams {streams} iter {it}: {args.num_blocks} x {size} B in "
+            f"{dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    results = measure_wire(
+        streams_list, args.num_blocks, size, args.iterations,
+        chunk_bytes=parse_size(args.chunk_bytes), report=report,
+    )
+    base = results.get(1, {}).get("gbps")
+    for streams, r in sorted(results.items()):
+        speedup = (
+            f" ({r['gbps'] / base:.2f}x vs streams=1)"
+            if base and streams != 1
+            else ""
+        )
+        print(
+            f"wire streams {streams}: {r['gbps']:.2f} GB/s, "
+            f"{r['syscalls_per_mb']:.1f} syscalls/MB, "
+            f"p99 frame stall {r['p99_frame_stall_ms']:.2f} ms{speedup}",
+            flush=True,
+        )
 
 
 def run_pipeline(args) -> None:
@@ -1189,6 +1314,8 @@ def main(argv=None) -> None:
         run_server(args)
     elif args.mode == "client":
         run_client(args)
+    elif args.mode == "wire":
+        run_wire(args)
     elif args.mode == "pipeline":
         run_pipeline(args)
     elif args.mode == "gather":

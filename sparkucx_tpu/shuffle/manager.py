@@ -133,6 +133,7 @@ class TpuShuffleManager:
             aggregator=aggregator,
             key_ordering=key_ordering,
             fetch_retries=self.conf.fetch_retries,
+            credit_bytes=self.conf.wire_credit_bytes,
             memory_budget=self.conf.reduce_memory_budget,
             spill_dir=self.conf.spill_dir,
             merge_combiners=merge_combiners,

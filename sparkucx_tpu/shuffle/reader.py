@@ -189,6 +189,7 @@ class TpuShuffleReader:
         memory_budget: int = 64 << 20,
         spill_dir: Optional[str] = None,
         merge_combiners: Optional[Callable[[Any, Any], Any]] = None,
+        credit_bytes: int = 0,
     ) -> None:
         self.transport = transport
         self.executor_id = executor_id
@@ -207,6 +208,11 @@ class TpuShuffleReader:
         self.memory_budget = memory_budget
         self.spill_dir = spill_dir
         self.merge_combiners = merge_combiners
+        #: byte budget for credit-based fetch pipelining: issue request
+        #: windows ahead of consumption while their result-buffer bytes fit
+        #: the budget (``spark.shuffle.tpu.wire.creditBytes``); 0 = the
+        #: historical strictly-serial window loop
+        self.credit_bytes = max(0, credit_bytes)
         self.metrics = ShuffleReadMetrics()
 
     # -- raw block iterator ------------------------------------------------
@@ -222,72 +228,121 @@ class TpuShuffleReader:
     def fetch_blocks(self) -> Iterator[BlockFetchResult]:
         """Windowed fetch of all non-empty blocks; yields as windows complete.
 
-        Window size caps in-flight buffers like ``maxBlocksPerRequest``
+        Window size caps one request like ``maxBlocksPerRequest``
         (UcxShuffleConf.scala:88-93); the spin between windows is charged to
-        fetch_wait (UcxShuffleReader.scala:118-123)."""
+        fetch_wait (UcxShuffleReader.scala:118-123).  With ``credit_bytes``
+        set, later windows are issued AHEAD of consumption while their bytes
+        fit the budget (credit-based pipelining: the wire fills the next
+        windows' buffers while this thread deserializes the current one);
+        yield order is window order either way, and ``credit_bytes == 0`` is
+        the historical strictly-serial loop."""
         bids = self._block_ids()
-        for w in range(0, len(bids), self.max_blocks_per_request):
-            window = bids[w : w + self.max_blocks_per_request]
-            buffers: List[MemoryBlock] = []
-            for bid in window:
-                size = self.block_sizes(bid.map_id, bid.reduce_id)
-                if self.pool is not None:
-                    buffers.append(self.pool.get(size))
-                else:
-                    buffers.append(MemoryBlock(np.zeros(size, dtype=np.uint8), size=size))
-            groups: dict = {}
-            for bid, buf in zip(window, buffers):
-                groups.setdefault(self.sender_of(bid.map_id), []).append((bid, buf))
-            requests: List[Tuple[ShuffleBlockId, MemoryBlock, Request]] = []
-            for sender, items in groups.items():
-                reqs = self.transport.fetch_blocks_by_block_ids(
-                    sender,
-                    [bid for bid, _ in items],
-                    [buf for _, buf in items],
-                    [None] * len(items),
-                )
-                requests.extend((bid, buf, req) for (bid, buf), req in zip(items, reqs))
+        windows = [
+            bids[w : w + self.max_blocks_per_request]
+            for w in range(0, len(bids), self.max_blocks_per_request)
+        ]
+        if self.credit_bytes > 0 and len(windows) > 1:
+            yield from self._fetch_windows_pipelined(windows)
+            return
+        for window in windows:
+            requests = self._issue_window(window)
+            self._await_window(requests, len(window))
+            yield from self._yield_window(requests)
 
-            t0 = time.monotonic_ns()
-            # wakeup park between polls when the transport supports it
-            # (use_wakeup; GlobalWorkerRpcThread.scala:46-58) — a local fetch
-            # completes on the first poll so the wait never fires there
-            park = getattr(self.transport, "wait_for_activity", None)
-            with span("read.window", shuffle_id=self.shuffle_id, blocks=len(window)):
-                while not all(req.completed() for _, _, req in requests):
-                    self.transport.progress()
-                    if park is not None and not all(
-                        req.completed() for _, _, req in requests
-                    ):
-                        park(0.002)
-            self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
+    def _fetch_windows_pipelined(self, windows) -> Iterator[BlockFetchResult]:
+        from collections import deque
 
-            prev: Optional[BlockFetchResult] = None
+        from sparkucx_tpu.transport.pipeline import CreditGate
+
+        gate = CreditGate(self.credit_bytes)
+        costs = [
+            sum(self.block_sizes(b.map_id, b.reduce_id) for b in w) for w in windows
+        ]
+        issued: deque = deque()  # (window, requests, cost) awaiting completion
+        nxt = 0
+        while nxt < len(windows) or issued:
+            while nxt < len(windows):
+                cost = costs[nxt]
+                if not issued:
+                    gate.acquire(cost)  # head window always admits (oversized-alone)
+                elif not gate.try_acquire(cost):
+                    break  # budget full: stop issuing ahead
+                issued.append((windows[nxt], self._issue_window(windows[nxt]), cost))
+                nxt += 1
+            window, requests, cost = issued.popleft()
             try:
-                for bid, buf, req in requests:
-                    result = req.wait(0)
-                    if result.status != OperationStatus.SUCCESS:
-                        result = self._retry_fetch(bid, buf, result)
-                    # Zero-copy hand-off: a read-only view of the recv bytes.
-                    # The old `bytes(...)` here copied every fetched block a
-                    # second time; now the copy happens only in detach(), and
-                    # only for pooled buffers nobody released in time.
-                    view = buf.host_view()[: result.stats.recv_size]
-                    view.flags.writeable = False
-                    self.metrics.remote_bytes_read += int(result.stats.recv_size)
-                    self.metrics.remote_blocks_fetched += 1
-                    prev = BlockFetchResult(
-                        bid,
-                        memoryview(view),
-                        buf,
-                        pooled=self.pool is not None,
-                        sanitizer=self.pool.sanitizer if self.pool is not None else None,
-                    )
-                    yield prev
-                    prev.detach()
+                self._await_window(requests, len(window))
+                yield from self._yield_window(requests)
             finally:
-                if prev is not None:
-                    prev.detach()
+                # credits return when the window is consumed (or the caller
+                # abandons the iterator / a fetch raises) — the gate drains
+                # to zero either way
+                gate.release(cost)
+
+    def _issue_window(
+        self, window: List[ShuffleBlockId]
+    ) -> List[Tuple[ShuffleBlockId, MemoryBlock, Request]]:
+        sizes = [self.block_sizes(bid.map_id, bid.reduce_id) for bid in window]
+        if self.pool is not None:
+            buffers = self.pool.get_many(sizes)
+        else:
+            buffers = [MemoryBlock(np.zeros(s, dtype=np.uint8), size=s) for s in sizes]
+        groups: dict = {}
+        for bid, buf in zip(window, buffers):
+            groups.setdefault(self.sender_of(bid.map_id), []).append((bid, buf))
+        requests: List[Tuple[ShuffleBlockId, MemoryBlock, Request]] = []
+        for sender, items in groups.items():
+            reqs = self.transport.fetch_blocks_by_block_ids(
+                sender,
+                [bid for bid, _ in items],
+                [buf for _, buf in items],
+                [None] * len(items),
+            )
+            requests.extend((bid, buf, req) for (bid, buf), req in zip(items, reqs))
+        return requests
+
+    def _await_window(self, requests, num_blocks: int) -> None:
+        t0 = time.monotonic_ns()
+        # wakeup park between polls when the transport supports it
+        # (use_wakeup; GlobalWorkerRpcThread.scala:46-58) — a local fetch
+        # completes on the first poll so the wait never fires there
+        park = getattr(self.transport, "wait_for_activity", None)
+        with span("read.window", shuffle_id=self.shuffle_id, blocks=num_blocks):
+            while not all(req.completed() for _, _, req in requests):
+                self.transport.progress()
+                if park is not None and not all(
+                    req.completed() for _, _, req in requests
+                ):
+                    park(0.002)
+        self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
+
+    def _yield_window(self, requests) -> Iterator[BlockFetchResult]:
+        prev: Optional[BlockFetchResult] = None
+        try:
+            for bid, buf, req in requests:
+                result = req.wait(0)
+                if result.status != OperationStatus.SUCCESS:
+                    result = self._retry_fetch(bid, buf, result)
+                # Zero-copy hand-off: a read-only view of the recv bytes.
+                # The old `bytes(...)` here copied every fetched block a
+                # second time; now the copy happens only in detach(), and
+                # only for pooled buffers nobody released in time.
+                view = buf.host_view()[: result.stats.recv_size]
+                view.flags.writeable = False
+                self.metrics.remote_bytes_read += int(result.stats.recv_size)
+                self.metrics.remote_blocks_fetched += 1
+                prev = BlockFetchResult(
+                    bid,
+                    memoryview(view),
+                    buf,
+                    pooled=self.pool is not None,
+                    sanitizer=self.pool.sanitizer if self.pool is not None else None,
+                )
+                yield prev
+                prev.detach()
+        finally:
+            if prev is not None:
+                prev.detach()
 
     def _retry_fetch(self, bid: ShuffleBlockId, buf: MemoryBlock, failed):
         """Per-block pull-path retry — the straggler/failure escape hatch next

@@ -22,12 +22,15 @@ contract (ShuffleTransport.scala:158-165).
 
 from __future__ import annotations
 
+import os
+import queue
 import socket
 import struct
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -38,9 +41,13 @@ from sparkucx_tpu.core.definitions import (
     MAX_FRAME_BYTES,
     AmId,
     MapperInfo,
+    pack_chunk_hdr,
     pack_frame,
     pack_frame_prefix,
+    pack_wire_hello,
+    unpack_chunk_hdr,
     unpack_frame_header,
+    unpack_wire_hello,
 )
 from sparkucx_tpu.core.operation import (
     OperationCallback,
@@ -64,16 +71,53 @@ _SIZE = struct.Struct("<q")
 _MAX_FRAME = MAX_FRAME_BYTES  # shared frame ceiling (core/definitions.py)
 
 
-def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    chunks = []
+def apply_wire_sockopts(
+    sock: socket.socket,
+    conf: Optional[TpuShuffleConf] = None,
+    *,
+    sndbuf: int = 0,
+    rcvbuf: int = 0,
+) -> None:
+    """TCP_NODELAY + kernel buffer sizing for every wire socket (both ends).
+
+    Small control frames (acks, ``MapperInfo``) must not eat Nagle delays, so
+    NODELAY is unconditional.  ``conf.wire_sock_buf_bytes``
+    (``spark.shuffle.tpu.wire.sockBufBytes``), when set, overrides BOTH
+    directions' kernel buffers; otherwise the caller's per-direction defaults
+    apply (0 = leave the platform default alone)."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
+    override = conf.wire_sock_buf_bytes if conf is not None else 0
+    for opt, val in (
+        (socket.SO_SNDBUF, override or sndbuf),
+        (socket.SO_RCVBUF, override or rcvbuf),
+    ):
+        if val:
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, opt, val)
+            except OSError:
+                pass
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
+    """Receive exactly ``n`` bytes into ONE preallocated buffer.
+
+    ``recv_into`` a sliding memoryview of a single bytearray: the historical
+    implementation collected per-``recv`` bytes chunks and paid a second full
+    copy joining them.  Returns ``None`` on EOF.  A bytearray is accepted
+    everywhere the old bytes was (struct unpacking, json, ``np.frombuffer``,
+    ``bytes + bytearray`` concatenation)."""
+    out = bytearray(n)
+    mv = memoryview(out)
     got = 0
     while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
-        if not chunk:
+        r = sock.recv_into(mv[got:], n - got)
+        if r == 0:
             return None
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
+        got += r
+    return out
 
 
 def recv_frame(sock: socket.socket) -> Optional[Tuple[AmId, bytes, bytes]]:
@@ -111,6 +155,111 @@ def unpack_batch_fetch_req(header: bytes) -> Tuple[int, List[ShuffleBlockId]]:
     return tag, ids
 
 
+class _ServerGroup:
+    """Server-side stripe group: the K accepted lane sockets of one client
+    ``_StripeGroup``, plus one sender thread per lane so chunk frames bound
+    for different lanes hit the kernel concurrently (the GIL is released
+    inside ``sendmsg``/``sendall``, so K senders really do overlap socket
+    copies — a single serving thread would serialize them).
+
+    Each lane's sender shares a per-connection send lock with the lane's
+    ``_serve_conn`` thread, so control acks (InitExecutorAck) interleave with
+    chunk frames only at frame granularity, never mid-frame.  Queues are
+    bounded: a slow wire backpressures the resolve loop instead of buffering
+    the whole reply in queued iovecs."""
+
+    def __init__(self, group_id: int, nlanes: int, chunk_bytes: int) -> None:
+        self.group_id = group_id
+        self.nlanes = max(1, nlanes)
+        self.chunk_bytes = max(4096, chunk_bytes)
+        self._lock = threading.Lock()
+        self._lanes: Dict[int, socket.socket] = {}  #: guarded by self._lock
+        self._queues: Dict[int, "queue.Queue"] = {}  #: guarded by self._lock
+        self._ready = threading.Event()  # set once all nlanes registered
+        self.broken = False  # one dead lane poisons the group (benign flag,
+        # single transition False->True, read without the lock by design)
+        #: per-lane tx telemetry, each entry written only by its sender thread
+        self.tx_bytes: Dict[int, int] = {}
+        self.tx_frames: Dict[int, int] = {}
+
+    def register(self, lane: int, conn: socket.socket, send_lock: threading.Lock) -> None:
+        with self._lock:
+            self._lanes[lane] = conn
+            q: "queue.Queue" = queue.Queue(maxsize=64)
+            self._queues[lane] = q
+            self.tx_bytes[lane] = 0
+            self.tx_frames[lane] = 0
+            ready = len(self._lanes) == self.nlanes
+        threading.Thread(
+            target=self._send_loop, args=(lane, conn, q, send_lock), daemon=True
+        ).start()
+        if ready:
+            self._ready.set()
+
+    def ready(self, timeout: float = 5.0) -> bool:
+        """True once every lane has said hello — striping before that would
+        address lanes that do not exist yet.  A timed-out or broken group
+        makes the caller fall back to the single-frame reply."""
+        return self._ready.wait(timeout) and not self.broken
+
+    def enqueue(self, lane: int, parts: list) -> None:
+        with self._lock:
+            q = self._queues.get(lane)
+        while True:
+            if q is None or self.broken:
+                raise OSError("stripe group lane gone")
+            try:  # bounded wait so a group broken mid-put cannot hang the server
+                q.put(parts, timeout=0.25)
+                return
+            except queue.Full:
+                continue
+
+    def _send_loop(self, lane: int, conn: socket.socket, q: "queue.Queue", send_lock: threading.Lock) -> None:
+        while not self.broken:
+            try:
+                parts = q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if parts is None:
+                return
+            try:
+                with send_lock:
+                    if hasattr(conn, "sendmsg"):
+                        BlockServer._sendmsg_all(conn, parts)
+                    else:
+                        conn.sendall(b"".join(bytes(p) for p in parts))
+            except OSError:
+                self.close()
+                return
+            self.tx_bytes[lane] += sum(len(p) for p in parts)
+            self.tx_frames[lane] += 1
+
+    def drop_lane(self, lane: int) -> None:
+        """A lane's serve thread saw EOF/error: the group can no longer
+        stripe (chunks for that lane would be lost), so poison it."""
+        self.close(keep_lane=lane)
+
+    def close(self, keep_lane: Optional[int] = None) -> None:
+        self.broken = True
+        with self._lock:
+            queues = list(self._queues.values())
+            lanes = [c for ln, c in self._lanes.items() if ln != keep_lane]
+            self._queues.clear()
+            self._lanes.clear()
+        for q in queues:
+            try:
+                q.put_nowait(None)  # early wakeup; senders also poll `broken`
+            except queue.Full:
+                pass
+        for conn in lanes:
+            # shutdown (not close) so each lane's _serve_conn thread observes
+            # the death and runs its own cleanup exactly once
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
 class BlockServer:
     """Serves registered blocks + staged-store blocks to peers.
 
@@ -145,6 +294,10 @@ class BlockServer:
         )
         self._accepted: list = []
         self._accepted_lock = threading.Lock()
+        # Stripe groups announced by WIRE_HELLO frames (striped wire path);
+        # a group forms as its K lane connections each say hello.
+        self._groups: Dict[int, _ServerGroup] = {}  #: guarded by self._groups_lock
+        self._groups_lock = threading.Lock()
         # numListenerThreads accept loops on one listen socket
         # (UcxShuffleConf.scala:73-78; the kernel load-balances accepts).
         self._threads = [
@@ -162,11 +315,8 @@ class BlockServer:
         while self._running:
             try:
                 conn, _ = self._srv.accept()
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                try:  # deep send window: one reply batch is tens of MiB
-                    conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
-                except OSError:
-                    pass
+                # deep send window default: one reply batch is tens of MiB
+                apply_wire_sockopts(conn, self.conf, sndbuf=4 << 20)
             except OSError:
                 return
             with self._accepted_lock:
@@ -273,8 +423,50 @@ class BlockServer:
                     bufs[i] = bufs[i][sent:]
                     sent = 0
 
+    def _serve_fetch_striped(self, group: _ServerGroup, tag: int, bids, entries) -> None:
+        """Stream a fetch reply as striped chunk frames, size manifest last.
+
+        Chunks are enqueued to the group's lane senders as each block
+        resolves — store read overlaps wire send instead of assembling the
+        whole reply first — and every chunk frame addresses its destination
+        ``(tag, block, offset within block)``, so the lanes need no mutual
+        ordering.  The manifest (a FetchBlockReqAck with ``body_len == 0``
+        carrying the sizes) goes last on lane 0; the client completes the
+        batch once the manifest AND every payload byte have arrived."""
+        sizes: List[int] = []
+        seq = 0
+        chunk = group.chunk_bytes
+        for i, e in enumerate(entries):
+            if e is None:
+                sizes.append(-1)
+                continue
+            staging, off, ln = e
+            sizes.append(ln)
+            if not ln:
+                continue
+            view = memoryview(staging.reshape(-1).view(np.uint8))[off : off + ln]
+            pos = 0
+            while pos < ln:
+                n = min(chunk, ln - pos)
+                prefix = pack_frame_prefix(
+                    AmId.FETCH_BLOCK_CHUNK, pack_chunk_hdr(tag, i, seq, pos), n
+                )
+                group.enqueue(seq % group.nlanes, [prefix, view[pos : pos + n]])
+                seq += 1
+                pos += n
+        blob = b"".join(_SIZE.pack(s) for s in sizes)
+        manifest = pack_frame(
+            AmId.FETCH_BLOCK_REQ_ACK, _TAG.pack(tag) + _COUNT.pack(len(sizes)) + blob, b""
+        )
+        group.enqueue(0, [manifest])
+
     def _serve_conn(self, conn: socket.socket) -> None:
         use_sendmsg = hasattr(conn, "sendmsg")
+        # shared with this lane's stripe sender thread so control acks and
+        # chunk frames interleave only at frame granularity
+        send_lock = threading.Lock()
+        group: Optional[_ServerGroup] = None
+        lane = -1
         try:
             while self._running:
                 frame = recv_frame(conn)
@@ -284,22 +476,38 @@ class BlockServer:
                 if am_id == AmId.FETCH_BLOCK_REQ:
                     tag, bids = unpack_batch_fetch_req(header)
                     if self._io is not None:
-                        entries = list(self._io.map(self._resolve_one, bids))
+                        # executor.map is lazy-in-order: all resolves run
+                        # concurrently, iteration yields each block as soon
+                        # as it (and its predecessors) complete
+                        entries = self._io.map(self._resolve_one, bids)
                     else:
-                        entries = [self._resolve_one(b) for b in bids]
+                        entries = map(self._resolve_one, bids)
+                    if group is not None and group.ready():
+                        self._serve_fetch_striped(group, tag, bids, entries)
+                        continue
+                    entries = list(entries)
                     if use_sendmsg:
                         sizes, parts, total = self._reply_parts(entries)
                         reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
                         prefix = pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, total)
-                        self._sendmsg_all(conn, [prefix] + parts)
+                        with send_lock:
+                            self._sendmsg_all(conn, [prefix] + parts)
                         continue
                     sizes, body = self._assemble_reply(entries)
                     reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
-                    conn.sendall(
-                        pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, body.size)
-                    )
-                    if body.size:
-                        conn.sendall(memoryview(body))
+                    with send_lock:
+                        conn.sendall(
+                            pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, body.size)
+                        )
+                        if body.size:
+                            conn.sendall(memoryview(body))
+                elif am_id == AmId.WIRE_HELLO:
+                    gid, lane, nlanes, chunk_bytes = unpack_wire_hello(header)
+                    with self._groups_lock:
+                        group = self._groups.get(gid)
+                        if group is None:
+                            group = self._groups[gid] = _ServerGroup(gid, nlanes, chunk_bytes)
+                    group.register(lane, conn, send_lock)
                 elif am_id == AmId.MAPPER_INFO:
                     info = MapperInfo.unpack(body)
                     if self.store is not None:
@@ -310,13 +518,19 @@ class BlockServer:
                 elif am_id == AmId.INIT_EXECUTOR_REQ:
                     (eid,) = _TAG.unpack_from(header)
                     self.handshaken[eid] = body
-                    conn.sendall(pack_frame(AmId.INIT_EXECUTOR_ACK, header, b""))
+                    with send_lock:
+                        conn.sendall(pack_frame(AmId.INIT_EXECUTOR_ACK, header, b""))
         except (OSError, ValueError, struct.error):
             # malformed frame or dead socket: drop THIS connection, keep serving
             # (the reference's endpoint error handler evicts one endpoint,
             # UcxWorkerWrapper.scala:248-253)
             pass
         finally:
+            if group is not None:
+                group.drop_lane(lane)
+                with self._groups_lock:
+                    if self._groups.get(group.group_id) is group:
+                        del self._groups[group.group_id]
             conn.close()
             with self._accepted_lock:
                 try:
@@ -330,6 +544,10 @@ class BlockServer:
             self._srv.close()
         except OSError:
             pass
+        with self._groups_lock:
+            groups, self._groups = list(self._groups.values()), {}
+        for g in groups:
+            g.close()
         with self._accepted_lock:
             accepted, self._accepted = list(self._accepted), []
         for conn in accepted:
@@ -362,13 +580,15 @@ class _PeerConnection:
         ack_buffers: Optional[Callable[[int], Optional[list]]] = None,
         ack_done: Optional[Callable[[int], None]] = None,
         activity: Optional[threading.Event] = None,
+        conf: Optional[TpuShuffleConf] = None,
+        lane: int = 0,
+        chunk_sink: Optional[Callable[[int, int, int, int], Optional[memoryview]]] = None,
+        chunk_done: Optional[Callable[[int, int, bool], Optional[bytes]]] = None,
+        manifest_sink: Optional[Callable[[bytes], Optional[bytes]]] = None,
     ) -> None:
         self.sock = socket.create_connection(address, timeout=30)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        try:  # deep recv window to keep the scatter recv fed between polls
-            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
-        except OSError:
-            pass
+        # deep recv window default keeps the scatter recv fed between polls
+        apply_wire_sockopts(self.sock, conf, rcvbuf=4 << 20)
         self.pending: Dict[int, Callable[[bytes, bytes], None]] = {}
         self.lock = threading.Lock()
         #: parked (am_id, header, body, scattered) frames; ``scattered`` marks
@@ -378,9 +598,48 @@ class _PeerConnection:
         self.ack_buffers = ack_buffers
         self.ack_done = ack_done
         self.activity = activity
+        #: striped-wire role (lane of a _StripeGroup): chunk_sink maps a chunk
+        #: to its destination view, chunk_done/manifest_sink account receive
+        #: progress and hand back the manifest header once the batch completes
+        self.lane = lane
+        self.chunk_sink = chunk_sink
+        self.chunk_done = chunk_done
+        self.manifest_sink = manifest_sink
+        # per-lane telemetry — written only by this connection's recv thread,
+        # read racily by wire_lane_stats() (monotonic counters, no lock needed)
+        self.rx_bytes = 0
+        self.rx_syscalls = 0
+        self.rx_stall_ns = 0
+        self.stall_samples: Deque[int] = deque(maxlen=4096)
         self.alive = True
         self.recv_thread = threading.Thread(target=self._recv_loop, daemon=True)
         self.recv_thread.start()
+
+    # -- counted zero-copy receive primitives (recv thread only) -----------
+
+    def _recv_exact(self, n: int) -> Optional[bytearray]:
+        out = bytearray(n)
+        mv = memoryview(out)
+        got = 0
+        while got < n:
+            r = self.sock.recv_into(mv[got:], n - got)
+            if r == 0:
+                return None
+            got += r
+            self.rx_bytes += r
+            self.rx_syscalls += 1
+        return out
+
+    def _recv_into(self, mv: memoryview) -> None:
+        """recv_into a caller-owned destination until full — the zero-copy
+        scatter receive (no staging allocation, no join copy)."""
+        while mv.nbytes:
+            n = self.sock.recv_into(mv, mv.nbytes)
+            if n == 0:
+                raise OSError("peer closed mid-body")
+            self.rx_bytes += n
+            self.rx_syscalls += 1
+            mv = mv[n:]
 
     def _recv_ack_into_buffers(self, header: bytes, blen: int) -> bool:
         """Scatter a fetch-ack body straight into the batch's result buffers.
@@ -409,29 +668,74 @@ class _PeerConnection:
                 continue
             view = bufs[i].host_view() if bufs[i] is not None else None
             if view is not None and size <= view.size:
-                mv = memoryview(view)[:size]
-                while mv.nbytes:
-                    n = self.sock.recv_into(mv, mv.nbytes)
-                    if n == 0:
-                        raise OSError("peer closed mid-body")
-                    mv = mv[n:]
+                self._recv_into(memoryview(view)[:size])
             else:  # oversized/unknown: drain and let progress() report failure
-                if recv_exact(self.sock, size) is None:
+                if self._recv_exact(size) is None:
                     raise OSError("peer closed mid-body")
         return True
+
+    def _park(self, am_id: AmId, header: bytes, body: bytes, scattered: bool) -> None:
+        # park — completion happens under progress() (explicit-poll contract)
+        with self.inbox_lock:
+            self.inbox.append((am_id, header, body, scattered))
+        if self.activity is not None:
+            self.activity.set()
+
+    def _recv_chunk(self, header: bytes, blen: int) -> None:
+        """Receive one striped chunk straight into its destination buffer.
+
+        The chunk is self-addressing — (tag, block, offset within block) —
+        so this lane needs no coordination with its siblings.  If this chunk
+        is the batch's last missing piece, park the manifest header here so
+        progress() completes the batch on whichever lane finished last."""
+        tag, block, seq, offset = unpack_chunk_hdr(header)
+        mv = self.chunk_sink(tag, block, offset, blen) if blen else None
+        ok = False
+        try:
+            if mv is not None:
+                self._recv_into(mv)
+            elif blen:  # unknown tag / oversized target: drain off the wire
+                if self._recv_exact(blen) is None:
+                    raise OSError("peer closed mid-chunk")
+            ok = True
+        finally:
+            # the done callback must run even when the socket dies mid-chunk:
+            # it clears the tag's scattering mark so a later sweep can fail it
+            done_hdr = self.chunk_done(tag, blen if ok else 0, mv is not None)
+        if done_hdr is not None:
+            self._park(AmId.FETCH_BLOCK_REQ_ACK, done_hdr, b"", True)
 
     def _recv_loop(self) -> None:
         try:
             while self.alive:
-                hdr = recv_exact(self.sock, FRAME_HEADER_SIZE)
+                t0 = time.monotonic_ns()
+                hdr = self._recv_exact(FRAME_HEADER_SIZE)
+                stall = time.monotonic_ns() - t0
+                self.rx_stall_ns += stall
+                self.stall_samples.append(stall)
                 if hdr is None:
                     break
                 am_id, hlen, blen = unpack_frame_header(hdr)
                 if hlen + blen > _MAX_FRAME:
                     raise ValueError("frame too large")
-                header = recv_exact(self.sock, hlen) if hlen else b""
+                header = self._recv_exact(hlen) if hlen else b""
                 if hlen and header is None:
                     break
+                if am_id == AmId.FETCH_BLOCK_CHUNK and self.chunk_done is not None:
+                    self._recv_chunk(header, blen)
+                    continue
+                if (
+                    am_id == AmId.FETCH_BLOCK_REQ_ACK
+                    and blen == 0
+                    and self.manifest_sink is not None
+                ):
+                    # striped reply manifest: sizes only, payload rides (or
+                    # rode) chunk frames — completion may be here or on a
+                    # sibling lane still scattering
+                    done_hdr = self.manifest_sink(bytes(header))
+                    if done_hdr is not None:
+                        self._park(am_id, done_hdr, b"", True)
+                    continue
                 scattered = False
                 if am_id == AmId.FETCH_BLOCK_REQ_ACK and self.ack_buffers is not None:
                     (tag,) = _TAG.unpack_from(header, 0)
@@ -441,16 +745,12 @@ class _PeerConnection:
                         if self.ack_done is not None:
                             self.ack_done(tag)
                 if not scattered:
-                    body = recv_exact(self.sock, blen) if blen else b""
+                    body = self._recv_exact(blen) if blen else b""
                     if blen and body is None:
                         break
                 else:
                     body = b""  # payload already scattered into result buffers
-                # park — completion happens under progress() (explicit-poll contract)
-                with self.inbox_lock:
-                    self.inbox.append((am_id, header, body, scattered))
-                if self.activity is not None:
-                    self.activity.set()
+                self._park(am_id, header, body, scattered)
         except (OSError, ValueError, struct.error):
             pass
         self.alive = False
@@ -477,6 +777,81 @@ class _PeerConnection:
             pass
 
 
+class _StripeGroup:
+    """Client-side bundle of K lane connections acting as ONE logical peer
+    connection — it lives in the transport's conn cache and duck-types
+    ``_PeerConnection`` (alive / send / drain_one / inbox / close), so the
+    progress() pump, eviction, zombie retirement, and failure sweeps all work
+    on it unchanged.
+
+    Requests and non-fetch AMs travel on lane 0; fetch replies return as a
+    size manifest plus self-addressing chunks striped across every lane
+    (core/definitions.py, AM ids 5-6).  ``alive`` is all-lanes-alive: a chunk
+    lost with one lane makes the group's in-flight batches unrecoverable, so
+    a single dead lane fails the whole bundle fast."""
+
+    def __init__(self, group_id: int, lanes: List[_PeerConnection]) -> None:
+        self.group_id = group_id
+        self.lanes = lanes
+
+    @property
+    def alive(self) -> bool:
+        return all(lane.alive for lane in self.lanes)
+
+    @property
+    def inbox(self) -> bool:
+        # truthiness only (zombie retirement): any lane still holding frames
+        return any(lane.inbox for lane in self.lanes)
+
+    def send(self, frame: bytes) -> None:
+        self.lanes[0].send(frame)
+
+    def drain_one(self) -> Optional[Tuple[AmId, bytes, bytes, bool]]:
+        for lane in self.lanes:
+            frame = lane.drain_one()
+            if frame is not None:
+                return frame
+        return None
+
+    def close(self) -> None:
+        for lane in self.lanes:
+            lane.close()
+
+    def lane_stats(self) -> List[Dict[str, int]]:
+        return [
+            {
+                "lane": lane.lane,
+                "rx_bytes": lane.rx_bytes,
+                "rx_syscalls": lane.rx_syscalls,
+                "rx_stall_ns": lane.rx_stall_ns,
+                "rx_stall_p99_ns": _stall_p99_ns(lane),
+            }
+            for lane in self.lanes
+        ]
+
+
+def _stall_p99_ns(conn: "_PeerConnection") -> int:
+    """p99 of the connection's recent frame-stall samples (time spent waiting
+    for the next frame header).  Snapshot + sort of a bounded deque; the recv
+    thread appends concurrently, which at worst skews one sample."""
+    samples = sorted(conn.stall_samples)
+    if not samples:
+        return 0
+    return samples[min(len(samples) - 1, int(0.99 * len(samples)))]
+
+
+class _StripeRx:
+    """Per-tag striped-receive accounting; every field is guarded by the
+    transport's ``_tag_lock`` (mutated from multiple lane recv threads)."""
+
+    __slots__ = ("manifest", "total", "received")
+
+    def __init__(self) -> None:
+        self.manifest: Optional[bytes] = None  # manifest header, once landed
+        self.total: Optional[int] = None  # payload bytes promised by the sizes
+        self.received = 0  # chunk payload bytes landed across all lanes
+
+
 class PeerTransport(ShuffleTransport):
     """ShuffleTransport over TCP peers — the socket twin of the loopback
     transport, used by multi-process deployments and the Spark shim."""
@@ -497,7 +872,7 @@ class PeerTransport(ShuffleTransport):
         # num_client_workers parallel connections per peer by thread identity —
         # the reference's thread->worker routing ``threadId % numWorkers``
         # (UcxShuffleTransport.scala:277-279, UcxShuffleConf.scala:80-86).
-        self._conns: Dict[Tuple[ExecutorId, int], _PeerConnection] = {}  #: guarded by self._conn_lock
+        self._conns: Dict[Tuple[ExecutorId, int], Union[_PeerConnection, _StripeGroup]] = {}  #: guarded by self._conn_lock
         self._conn_addrs: Dict[ExecutorId, Tuple[str, int]] = {}  #: guarded by self._conn_lock
         self._conn_lock = threading.Lock()
         self._slot_local = threading.local()
@@ -505,8 +880,13 @@ class PeerTransport(ShuffleTransport):
         self._connecting: Dict[Tuple[ExecutorId, int], threading.Event] = {}  #: guarded by self._conn_lock
         self._next_tag = 0  #: guarded by self._tag_lock
         self._tag_lock = threading.Lock()
-        self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]], Optional[_PeerConnection]]] = {}  #: guarded by self._tag_lock
-        self._scattering: set = set()  #: guarded by self._tag_lock
+        self._inflight: Dict[int, Tuple[List[Request], List[MemoryBlock], List[Optional[OperationCallback]], Optional[Union[_PeerConnection, _StripeGroup]]]] = {}  #: guarded by self._tag_lock
+        # tag -> count of lane recv threads currently writing the tag's result
+        # buffers (a counter, not a set: with striping, several lanes scatter
+        # one tag concurrently and set-discard would drop siblings' marks)
+        self._scattering: Dict[int, int] = {}  #: guarded by self._tag_lock
+        #: striped-receive progress per in-flight tag (striped groups only)
+        self._stripe_rx: Dict[int, _StripeRx] = {}  #: guarded by self._tag_lock
         self._zombies: List[_PeerConnection] = []  #: guarded by self._conn_lock (evicted, not yet drained)
         self.stats_agg = StatsAggregator() if self.conf.collect_stats else None
         #: Wakeup doorbell (conf.use_wakeup): recv threads set it when an ack
@@ -523,12 +903,108 @@ class PeerTransport(ShuffleTransport):
             entry = self._inflight.get(tag)
             if entry is None:
                 return None
-            self._scattering.add(tag)
+            self._scattering[tag] = self._scattering.get(tag, 0) + 1
             return list(entry[1])
 
     def _ack_buffers_done(self, tag: int) -> None:
         with self._tag_lock:
-            self._scattering.discard(tag)
+            self._unmark_scattering_locked(tag)
+
+    def _unmark_scattering_locked(self, tag: int) -> None:
+        """Caller holds self._tag_lock."""
+        left = self._scattering.get(tag, 0) - 1
+        if left > 0:
+            self._scattering[tag] = left
+        else:
+            self._scattering.pop(tag, None)
+
+    # -- striped-wire receive callbacks (called from lane recv threads) ----
+
+    def _chunk_buffers(self, tag: int, block: int, offset: int, nbytes: int) -> Optional[memoryview]:
+        """Resolve one chunk's destination: a view of the batch's result
+        buffer at the chunk's final offset (the zero-copy scatter target).
+        Marks the tag as scattering so eviction cannot fail-and-release the
+        buffer mid-write; ``_chunk_done`` clears the mark and accounts."""
+        with self._tag_lock:
+            entry = self._inflight.get(tag)
+            if entry is None or not 0 <= block < len(entry[1]):
+                return None
+            buf = entry[1][block]
+            view = buf.host_view() if buf is not None else None
+            if view is None or offset + nbytes > view.size:
+                return None  # oversized block: drain; progress() reports failure
+            self._scattering[tag] = self._scattering.get(tag, 0) + 1
+            return memoryview(view)[offset : offset + nbytes]
+
+    def _chunk_done(self, tag: int, nbytes: int, scattered: bool) -> Optional[bytes]:
+        """Account one received chunk.  Returns the manifest header iff this
+        chunk completed the batch (manifest seen AND all payload bytes in), so
+        the calling lane parks the completion frame for progress()."""
+        with self._tag_lock:
+            if scattered:
+                self._unmark_scattering_locked(tag)
+            rx = self._stripe_rx.get(tag)
+            if rx is None:
+                return None
+            rx.received += nbytes
+            return self._stripe_complete_locked(tag)
+
+    def _on_manifest(self, header: bytes) -> Optional[bytes]:
+        """A striped reply's size manifest landed (FetchBlockReqAck with an
+        empty body).  Returns the header iff the batch is now complete —
+        either here or, for unknown tags, immediately (parked for the generic
+        frame handler, which drops stale tags)."""
+        if len(header) < _TAG.size + _COUNT.size:
+            return header  # runt header: parked; _handle_frame ignores it
+        (tag,) = _TAG.unpack_from(header, 0)
+        (count,) = _COUNT.unpack_from(header, _TAG.size)
+        if len(header) < _TAG.size + _COUNT.size + count * _SIZE.size:
+            return header  # truncated sizes: let _handle_frame fail the batch
+        total = 0
+        for i in range(count):
+            (s,) = _SIZE.unpack_from(header, _TAG.size + _COUNT.size + i * _SIZE.size)
+            if s > 0:
+                total += s
+        with self._tag_lock:
+            rx = self._stripe_rx.get(tag)
+            if rx is None:
+                return header  # unknown/failed tag: park; handler discards
+            rx.manifest = bytes(header)
+            rx.total = total
+            return self._stripe_complete_locked(tag)
+
+    def _stripe_complete_locked(self, tag: int) -> Optional[bytes]:
+        """Caller holds self._tag_lock."""
+        rx = self._stripe_rx.get(tag)
+        if rx is None or rx.total is None or rx.received < rx.total:
+            return None
+        del self._stripe_rx[tag]
+        return rx.manifest
+
+    def wire_lane_stats(self) -> List[Dict[str, int]]:
+        """Per-lane receive telemetry for striped connections: bytes,
+        recv_into syscalls, and cumulative frame-stall time per lane.
+        Single-lane connections report as lane 0 of their key."""
+        with self._conn_lock:
+            conns = list(self._conns.items())
+        out: List[Dict[str, int]] = []
+        for (eid, slot), conn in conns:
+            if isinstance(conn, _StripeGroup):
+                for s in conn.lane_stats():
+                    out.append({"executor": eid, "slot": slot, **s})
+            else:
+                out.append(
+                    {
+                        "executor": eid,
+                        "slot": slot,
+                        "lane": 0,
+                        "rx_bytes": conn.rx_bytes,
+                        "rx_syscalls": conn.rx_syscalls,
+                        "rx_stall_ns": conn.rx_stall_ns,
+                        "rx_stall_p99_ns": _stall_p99_ns(conn),
+                    }
+                )
+        return out
 
     def wait_for_activity(self, timeout: float = 0.01) -> None:
         """Park until a recv thread posts an ack (or timeout) — the wakeup-mode
@@ -550,6 +1026,14 @@ class PeerTransport(ShuffleTransport):
         return self.server.address_bytes()
 
     def close(self) -> None:
+        if self.stats_agg is not None:
+            for s in self.wire_lane_stats():
+                self.stats_agg.record_counters(
+                    "wire",
+                    rx_bytes=s["rx_bytes"],
+                    rx_syscalls=s["rx_syscalls"],
+                    rx_stall_ns=s["rx_stall_ns"],
+                )
         with self._conn_lock:
             conns = list(self._conns.values()) + self._zombies
             self._conns.clear()
@@ -561,6 +1045,7 @@ class PeerTransport(ShuffleTransport):
         with self._tag_lock:
             inflight = list(self._inflight.values())
             self._inflight.clear()
+            self._stripe_rx.clear()
         for reqs, _, _, _ in inflight:
             for r in reqs:
                 if not r.completed():
@@ -628,12 +1113,7 @@ class PeerTransport(ShuffleTransport):
                     break
             pending.wait(timeout=60)
         try:
-            conn = _PeerConnection(
-                addr,
-                ack_buffers=self._ack_buffers,
-                ack_done=self._ack_buffers_done,
-                activity=self._activity,
-            )
+            conn = self._open_connection(addr)
         except OSError:
             with self._conn_lock:
                 self._connecting.pop(key, None)
@@ -644,6 +1124,44 @@ class PeerTransport(ShuffleTransport):
             self._connecting.pop(key, None)
         pending.set()
         return conn
+
+    def _open_connection(self, addr: Tuple[str, int]) -> Union[_PeerConnection, _StripeGroup]:
+        """One lane (wire.streams = 1, the byte-identical historical wire) or
+        a K-lane stripe group announced to the server via WIRE_HELLO."""
+        streams = max(1, self.conf.wire_streams)
+        if streams == 1:
+            return _PeerConnection(
+                addr,
+                ack_buffers=self._ack_buffers,
+                ack_done=self._ack_buffers_done,
+                activity=self._activity,
+                conf=self.conf,
+            )
+        group_id = int.from_bytes(os.urandom(8), "little")
+        lanes: List[_PeerConnection] = []
+        try:
+            for lane in range(streams):
+                c = _PeerConnection(
+                    addr,
+                    activity=self._activity,
+                    conf=self.conf,
+                    lane=lane,
+                    chunk_sink=self._chunk_buffers,
+                    chunk_done=self._chunk_done,
+                    manifest_sink=self._on_manifest,
+                )
+                lanes.append(c)
+                c.send(
+                    pack_frame(
+                        AmId.WIRE_HELLO,
+                        pack_wire_hello(group_id, lane, streams, self.conf.wire_chunk_bytes),
+                    )
+                )
+        except OSError:
+            for c in lanes:
+                c.close()
+            raise
+        return _StripeGroup(group_id, lanes)
 
     # -- server side -------------------------------------------------------
 
@@ -715,6 +1233,11 @@ class PeerTransport(ShuffleTransport):
             with self._tag_lock:
                 if tag in self._inflight:
                     self._inflight[tag] = (reqs, bufs, cbs, conn)
+                    if isinstance(conn, _StripeGroup):
+                        # reply will arrive as manifest + chunks on the
+                        # group's lanes: start the receive accounting now,
+                        # before any chunk can race the request send
+                        self._stripe_rx[tag] = _StripeRx()
             conn.send(pack_frame(AmId.FETCH_BLOCK_REQ, pack_batch_fetch_req(tag, bids)))
         except (TransportError, OSError) as e:
             # endpoint failure: evict the cached connection and fail the batch —
@@ -731,6 +1254,7 @@ class PeerTransport(ShuffleTransport):
             self._evict(executor_id)
             with self._tag_lock:
                 self._inflight.pop(tag, None)
+                self._stripe_rx.pop(tag, None)
             err = e if isinstance(e, TransportError) else TransportError(str(e))
             for req, buf, cb in zip(reqs, bufs, cbs):
                 req.stats.mark_done()
@@ -774,6 +1298,7 @@ class PeerTransport(ShuffleTransport):
             ]
             for tag, _ in doomed:
                 del self._inflight[tag]
+                self._stripe_rx.pop(tag, None)
         for tag, (reqs, bufs, cbs, _) in doomed:
             logger.warning("connection lost with %d in-flight request(s)", len(reqs))
             err = TransportError("peer connection lost")
@@ -821,6 +1346,9 @@ class PeerTransport(ShuffleTransport):
         (count,) = _COUNT.unpack_from(header, _TAG.size)
         with self._tag_lock:
             entry = self._inflight.pop(tag, None)
+            # normally already gone for striped tags; covers the server's
+            # unstriped-fallback reply and malformed manifests
+            self._stripe_rx.pop(tag, None)
         if entry is None:
             return
         reqs, bufs, cbs, _conn = entry

@@ -50,6 +50,8 @@ come with a guard annotation the analyzer can check.
 
 from __future__ import annotations
 
+import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Tuple
@@ -57,6 +59,65 @@ from typing import Any, Callable, List, Optional, Tuple
 from sparkucx_tpu.core.operation import OperationStats
 from sparkucx_tpu.utils.stats import StatsAggregator
 from sparkucx_tpu.utils.trace import span
+
+
+class CreditGate:
+    """Byte-budget flow control shared by the fetch reader and the pipeline.
+
+    ``acquire(n)`` blocks until ``used + n <= budget`` — except that a request
+    larger than the whole budget is admitted *alone* (when nothing else is in
+    flight), so one oversized round can never deadlock the gate.  ``release``
+    returns credits and wakes waiters.  The gate never lets concurrent
+    admissions exceed the budget (modulo the documented oversized-alone case)
+    and drains back to zero when all holders release — tests/test_wire.py pins
+    both properties.
+    """
+
+    def __init__(self, budget: int) -> None:
+        if budget <= 0:
+            raise ValueError(f"credit budget must be positive, got {budget}")
+        self.budget = budget
+        self._lock = threading.Condition()
+        self._used = 0  #: guarded by self._lock
+        self._stall_ns = 0  #: guarded by self._lock (time spent waiting for credit)
+
+    def acquire(self, nbytes: int, timeout: Optional[float] = None) -> bool:
+        nbytes = max(0, int(nbytes))
+        t0 = time.monotonic_ns()
+        with self._lock:
+            ok = self._lock.wait_for(
+                lambda: self._used + nbytes <= self.budget or self._used == 0,
+                timeout=timeout,
+            )
+            if not ok:
+                return False
+            self._used += nbytes
+            self._stall_ns += time.monotonic_ns() - t0
+        return True
+
+    def try_acquire(self, nbytes: int) -> bool:
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            if self._used + nbytes <= self.budget or self._used == 0:
+                self._used += nbytes
+                return True
+            return False
+
+    def release(self, nbytes: int) -> None:
+        nbytes = max(0, int(nbytes))
+        with self._lock:
+            self._used = max(0, self._used - nbytes)
+            self._lock.notify_all()
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            return self._used
+
+    @property
+    def stall_ns(self) -> int:
+        with self._lock:
+            return self._stall_ns
 
 
 class RoundPipeline:
@@ -72,9 +133,13 @@ class RoundPipeline:
         stats: Optional[StatsAggregator] = None,
         result_bytes: Optional[Callable[[Any], int]] = None,
         result_rows: Optional[Callable[[Any], Tuple[int, int]]] = None,
+        credits: Optional[CreditGate] = None,
+        round_bytes: Optional[Callable[[int], int]] = None,
     ) -> None:
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        if credits is not None and round_bytes is None:
+            raise ValueError("credits requires round_bytes to cost each round")
         self.depth = depth
         self._submit_cb = submit
         self._drain_cb = drain
@@ -84,13 +149,27 @@ class RoundPipeline:
         # result_rows(result) -> (used_rows, padded_rows): staging occupancy
         # of the round, surfaced as the drain span's padding telemetry
         self._result_rows = result_rows
+        # Optional byte-budget gate shared with the wire path: round k's
+        # submit blocks until its round_bytes(k) fit the budget alongside the
+        # rounds already in flight; the credits return when the round drains
+        # (or its stage raises).  Composes with the depth window — depth
+        # bounds rounds, credits bound bytes, whichever is tighter wins.
+        self._credits = credits
+        self._round_bytes = round_bytes
 
     # -- instrumented stage wrappers --------------------------------------
 
     def _submit(self, rnd: int) -> Any:
+        if self._credits is not None:
+            self._credits.acquire(self._round_bytes(rnd))
         op = OperationStats()
-        with span(f"{self.name}.submit", round=rnd, depth=self.depth):
-            ticket = self._submit_cb(rnd)
+        try:
+            with span(f"{self.name}.submit", round=rnd, depth=self.depth):
+                ticket = self._submit_cb(rnd)
+        except BaseException:
+            if self._credits is not None:  # round never reaches drain
+                self._credits.release(self._round_bytes(rnd))
+            raise
         op.mark_done()
         if self.stats is not None:
             self.stats.record(f"{self.name}.submit", op)
@@ -98,8 +177,12 @@ class RoundPipeline:
 
     def _drain(self, rnd: int, ticket: Any) -> Any:
         op = OperationStats()
-        with span(f"{self.name}.drain", round=rnd, depth=self.depth):
-            result = self._drain_cb(rnd, ticket)
+        try:
+            with span(f"{self.name}.drain", round=rnd, depth=self.depth):
+                result = self._drain_cb(rnd, ticket)
+        finally:
+            if self._credits is not None:
+                self._credits.release(self._round_bytes(rnd))
         op.mark_done(
             recv_size=self._result_bytes(result) if self._result_bytes else 0
         )

@@ -64,6 +64,9 @@ class StatsAggregator:
         # worker alongside the timing counters — same lock, same discipline
         self._used_rows: Dict[str, int] = {}  #: guarded by self._lock
         self._padded_rows: Dict[str, int] = {}  #: guarded by self._lock
+        # free-form named counters (striped-wire per-lane bytes / syscalls /
+        # stall time): kind -> counter name -> accumulated value
+        self._counters: Dict[str, Dict[str, int]] = {}  #: guarded by self._lock
 
     def record(
         self,
@@ -93,6 +96,19 @@ class StatsAggregator:
             self._used_rows[kind] = self._used_rows.get(kind, 0) + used_rows
             self._padded_rows[kind] = self._padded_rows.get(kind, 0) + padded_rows
 
+    def record_counters(self, kind: str, **counters: int) -> None:
+        """Accumulate named counters under a kind — the wire path's per-lane
+        telemetry (rx_bytes / rx_syscalls / rx_stall_ns) lands here, where an
+        operator's report() can pick it up next to the op summaries."""
+        with self._lock:
+            dst = self._counters.setdefault(kind, {})
+            for name, value in counters.items():
+                dst[name] = dst.get(name, 0) + int(value)
+
+    def counters(self, kind: str) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters.get(kind, {}))
+
     def summary(self, kind: str) -> StatsSummary:
         with self._lock:
             ops = self._ops.get(kind, 0)
@@ -116,7 +132,7 @@ class StatsAggregator:
 
     def kinds(self) -> List[str]:
         with self._lock:
-            return sorted(set(self._ops) | set(self._used_rows))
+            return sorted(set(self._ops) | set(self._used_rows) | set(self._counters))
 
     def report(self) -> str:
         lines = []
@@ -132,5 +148,8 @@ class StatsAggregator:
                     f" used_rows={s.used_rows} padded_rows={s.padded_rows} "
                     f"padding={s.padding_fraction:.1%}"
                 )
+            counters = self.counters(kind)
+            if counters:
+                line += "".join(f" {k}={v}" for k, v in sorted(counters.items()))
             lines.append(line)
         return "\n".join(lines)

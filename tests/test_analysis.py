@@ -172,6 +172,36 @@ class TestLockDiscipline:
         )
         assert findings == []
 
+    def test_flags_unguarded_credit_counter(self):
+        # The striped-wire CreditGate pattern: a byte counter annotated as
+        # guarded by a Condition named _lock.  Mutating it without the lock
+        # (the augmented-assign form the accounting paths use) must flag;
+        # the guarded twin must not.
+        findings = run_source(
+            src(
+                """
+                import threading
+
+                class Gate:
+                    def __init__(self, budget):
+                        self.budget = budget
+                        self._lock = threading.Condition()
+                        self._used = 0  #: guarded by self._lock
+
+                    def release_racy(self, n):
+                        self._used -= n
+
+                    def release(self, n):
+                        with self._lock:
+                            self._used -= n
+                            self._lock.notify_all()
+                """
+            ),
+            passes=["lock-discipline"],
+        )
+        assert len(findings) == 1
+        assert "_used" in findings[0].message
+
 
 # ----------------------------------------------------------------------
 # host-sync

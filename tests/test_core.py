@@ -187,8 +187,12 @@ class TestWireFrames:
         assert MapperInfo.unpack(mi.pack()) == mi
 
     def test_am_ids_match_reference(self):
-        # Definitions.scala:22-29
-        assert [int(a) for a in AmId] == [0, 1, 2, 3, 4]
+        # 0-4: Definitions.scala:22-29 verbatim.  5-6: striped-wire extensions
+        # (FetchBlockChunk / WireHello, docs/SHIM_PROTOCOL.md) — peer plane
+        # only, never emitted at wire.streams=1, so reference parity holds for
+        # every frame a stock deployment sees.
+        assert [int(a) for a in AmId] == [0, 1, 2, 3, 4, 5, 6]
+        assert AmId.FETCH_BLOCK_CHUNK == 5 and AmId.WIRE_HELLO == 6
 
 
 class TestConf:
