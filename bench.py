@@ -517,6 +517,31 @@ def main():
             }
         except Exception as e:
             RESULT["skew_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            # FAST-scheduled ring exchange (ops/ici_exchange.py) vs the stock
+            # collective at the widest mesh this backend exposes, plus the
+            # fused send side's single-launch check.  Bit equality between the
+            # impls is asserted inside measure_ici; through a one-chip tunnel
+            # only n=1 exists and the honest skip lands in ici_error.
+            if budget_left() < 90:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            from sparkucx_tpu.perf.benchmark import measure_ici
+
+            ic = measure_ici((2, 4, 8), 1024, 128, iterations=REPEATS)
+            widest = max(ic["per_n"])
+            p = ic["per_n"][widest]
+            RESULT["ici"] = {
+                "executors": widest,
+                "stock_gbps": round(p["stock_gbps"], 3),
+                "pallas_gbps": round(p["pallas_gbps"], 3),
+                "pallas_per_link_gbps": round(p["pallas_per_link_gbps"], 4),
+                "supersteps": p["supersteps"],
+                "chunks": p["chunks"],
+                "lowering": p["lowering"],
+                "fused_single_launch": ic["fused"]["launches"] == 1,
+            }
+        except Exception as e:
+            RESULT["ici_error"] = f"{type(e).__name__}: {e}"[:200]
 
     emit_once()
 

@@ -102,6 +102,9 @@ DONATING_BUILDERS = {
     "build_exchange": (0,),
     "build_hierarchical_exchange": (0,),
     "build_block_scatter": (4,),  # fn(starts, counts, outs, packed, dst): dst
+    "build_ici_exchange": (0,),  # scheduled-ring exchange: same donation rule
+    # fused send side fn(starts, counts, outs, packed, staging, sizes): staging
+    "build_fused_ici_exchange": (4,),
     "_exchange_fn": (0,),  # TpuShuffleCluster cache front-end for build_exchange
 }
 
@@ -139,4 +142,5 @@ BUCKETING_MARKERS = (
     "bit_length",
     "quota_slot_rows",
     "plan_exchange",
+    "schedule_chunks",  # pow2 chunk-count clamp (ops/ici_exchange.py)
 )

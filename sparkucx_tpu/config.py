@@ -169,6 +169,14 @@ class TpuShuffleConf:
     #: Ragged block-gather lowering: 'auto' (pipelined DMA kernel on TPU, XLA
     #: gather elsewhere) | 'dma' | 'tiled' | 'xla'.
     gather_impl: str = "auto"
+    #: Inter-chip exchange implementation (ops/ici_exchange.py): 'stock'
+    #: (default — the byte-for-byte ragged_all_to_all/dense collective path),
+    #: 'pallas' (hand-rolled bidirectional-ring supersteps with FAST-style
+    #: per-destination chunk interleaving: remote-DMA kernel on TPU, scheduled
+    #: ppermute lowering elsewhere — bit-identical results, pinned by
+    #: tests/test_ici_exchange.py), or 'auto' (pallas on multi-chip TPU
+    #: meshes, stock everywhere else).
+    exchange_impl: str = "stock"
     #: Map-side partial aggregation below the exchange for GROUP BY jobs —
     #: Spark's HashAggregateExec(partial) under the ShuffleExchange, on by
     #: default exactly as in Spark.  Consumed by ``AggregateSpec.from_conf``
@@ -275,6 +283,7 @@ class TpuShuffleConf:
             ("meshAxisName", "mesh_axis_name", str),
             ("keepDeviceRecv", "keep_device_recv", lambda v: str(v).lower() == "true"),
             ("gatherImpl", "gather_impl", str),
+            ("exchange.impl", "exchange_impl", str),
             ("partialAggregation", "partial_aggregation", lambda v: str(v).lower() == "true"),
             ("hostRecvMode", "host_recv_mode", str),
             ("spillToDisk", "spill_to_disk", lambda v: str(v).lower() == "true"),
@@ -308,6 +317,8 @@ class TpuShuffleConf:
             raise ValueError("num_executors must be positive")
         if self.gather_impl not in ("auto", "dma", "tiled", "xla"):
             raise ValueError(f"unknown gather_impl {self.gather_impl!r}")
+        if self.exchange_impl not in ("stock", "pallas", "auto"):
+            raise ValueError(f"unknown exchange_impl {self.exchange_impl!r}")
         if self.num_slices <= 0:
             raise ValueError("num_slices must be positive")
         if self.num_slices > 1 and self.num_executors % self.num_slices:

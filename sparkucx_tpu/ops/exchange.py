@@ -195,6 +195,11 @@ def _gather_sizes(spec: ExchangeSpec, size_row: jnp.ndarray):
     return me, sizes
 
 
+# Public alias: the scheduled ICI lowering (ops/ici_exchange.py) shares the
+# size-matrix gather so its receive metadata is bit-identical to this module's.
+gather_size_matrix = _gather_sizes
+
+
 def _exchange_shard_ragged(spec: ExchangeSpec, data: jnp.ndarray, size_row: jnp.ndarray):
     """Slot-region staging -> ragged_all_to_all over rows -> tight sender-major recv.
 

@@ -40,20 +40,130 @@ from sparkucx_tpu.ops._compat import shard_map
 from sparkucx_tpu.ops.exchange import ExchangeSpec, exclusive_cumsum
 
 
+def device_slice_ids(devices) -> "list":
+    """Per-device slice ids from the runtime topology, or None when the
+    runtime exposes none (CPU meshes, single-slice TPUs without the attr).
+
+    TPU devices carry ``slice_index`` on multi-slice deployments; this is the
+    probe the mesh factorization and hop classification derive from.  Pure
+    python over device attributes — unit-testable with stand-in objects."""
+    ids = [getattr(d, "slice_index", None) for d in devices]
+    if any(i is None for i in ids):
+        return None
+    return [int(i) for i in ids]
+
+
+def probe_topology(devices):
+    """(num_slices, chips_per_slice, devices-in-slice-major-order).
+
+    Derives the (dcn, ici) factorization from ``slice_index`` when the
+    runtime exposes it — devices are GROUPED by slice (stable within a
+    slice), so each mesh row is one physical slice whatever enumeration
+    order ``jax.devices()`` used.  Without slice ids (the pure-python
+    fallback: CPU meshes, tests) the flat order is taken as a single slice.
+    Raises if the slices are ragged — a (dcn, ici) mesh needs equal rows."""
+    devs = list(devices)
+    ids = device_slice_ids(devs)
+    if ids is None:
+        return 1, len(devs), devs
+    order = sorted(set(ids))
+    groups = [[d for d, i in zip(devs, ids) if i == s] for s in order]
+    chips = len(groups[0])
+    if any(len(g) != chips for g in groups):
+        raise ValueError(
+            f"ragged slices: {[len(g) for g in groups]} devices per slice_index"
+        )
+    return len(groups), chips, [d for g in groups for d in g]
+
+
 def make_hierarchical_mesh(
     num_slices: int, chips_per_slice: int, devices=None
 ) -> Mesh:
-    """(dcn, ici) mesh over the first S*C devices, slice-major."""
+    """(dcn, ici) mesh over the first S*C devices, slice-major.
+
+    When the devices report a genuinely multi-slice topology
+    (``slice_index`` with more than one distinct value) the rows follow the
+    PHYSICAL slices (probe_topology groups them), not the flat enumeration
+    order, and a request that disagrees with the hardware raises.  Devices
+    with no slice ids — or all on one slice — take the requested
+    factorization as a LOGICAL split (CPU meshes, and single-slice tests of
+    the two-phase route)."""
     devs = list(devices if devices is not None else jax.devices())
     n = num_slices * chips_per_slice
     if len(devs) < n:
         raise ValueError(f"need {n} devices, have {len(devs)}")
+    devs = devs[:n]
+    ids = device_slice_ids(devs)
+    if ids is not None and len(set(ids)) > 1:
+        s, c, devs = probe_topology(devs)
+        if (s, c) != (num_slices, chips_per_slice):
+            raise ValueError(
+                f"runtime topology is {s}x{c} (slice_index), "
+                f"requested {num_slices}x{chips_per_slice}"
+            )
     return Mesh(
-        np.array(devs[:n]).reshape(num_slices, chips_per_slice), ("dcn", "ici")
+        np.array(devs).reshape(num_slices, chips_per_slice), ("dcn", "ici")
     )
 
 
-def _region_permutation(order_outer: int, order_inner: int, slot: int) -> jnp.ndarray:
+def hop_kinds(devices) -> np.ndarray:
+    """(n, n) hop classification between executors: 'local' | 'ici' | 'dcn'.
+
+    Same-slice pairs ride ICI, cross-slice pairs cross DCN; without slice
+    ids every pair is ICI (single-slice fallback).  Pure python + numpy —
+    the unit-testable core of the topology probe."""
+    devs = list(devices)
+    ids = device_slice_ids(devs) or [0] * len(devs)
+    n = len(devs)
+    kinds = np.empty((n, n), dtype=object)
+    for i in range(n):
+        for j in range(n):
+            kinds[i, j] = (
+                "local" if i == j else ("ici" if ids[i] == ids[j] else "dcn")
+            )
+    return kinds
+
+
+def hop_schedule(mesh: Mesh, *, chunks_per_dest: int = 1, slot_rows=None):
+    """Flow schedule(s) for ``mesh``, classified by fabric — the input the
+    scheduled exchange kernel (ops/ici_exchange.py) consumes.
+
+    * (dcn, ici) mesh: a :class:`HierarchicalSchedule` — a ring schedule per
+      phase, so intra-slice ICI hops and inter-slice DCN hops get DISTINCT
+      schedules (different dims, different chunking, different fabrics).
+    * flat mesh, single slice (or no topology attrs): one ICI ring schedule.
+    * flat mesh spanning slices: one ring schedule with every hop
+      conservatively classified 'dcn' (some source crosses DCN at every
+      offset under flat ordering) — use the hierarchical mesh to split them.
+
+    ``chunks_per_dest`` is clamped per phase to a pow2 divisor of that
+    phase's transfer-group rows when ``slot_rows`` is given
+    (``schedule_chunks``)."""
+    from sparkucx_tpu.ops.ici_exchange import (
+        HierarchicalSchedule,
+        ring_schedule,
+        schedule_chunks,
+    )
+
+    def clamp(group_rows):
+        if group_rows is None:
+            return max(1, int(chunks_per_dest))
+        return schedule_chunks(group_rows, chunks_per_dest)
+
+    if set(mesh.axis_names) == {"dcn", "ici"}:
+        s, c = mesh.shape["dcn"], mesh.shape["ici"]
+        ici_group = s * slot_rows if slot_rows is not None else None
+        dcn_group = c * slot_rows if slot_rows is not None else None
+        ici = ring_schedule(c, clamp(ici_group), kind="ici") if c > 1 else None
+        dcn = ring_schedule(s, clamp(dcn_group), kind="dcn") if s > 1 else None
+        return HierarchicalSchedule(num_slices=s, chips_per_slice=c, ici=ici, dcn=dcn)
+    n = mesh.devices.size
+    ids = device_slice_ids(mesh.devices.reshape(-1))
+    kind = "ici" if ids is None or len(set(ids)) == 1 else "dcn"
+    return ring_schedule(n, clamp(slot_rows), kind=kind)
+
+
+def region_permutation(order_outer: int, order_inner: int, slot: int) -> jnp.ndarray:
     """Row indices permuting a slot grid from (inner-major regions) to
     (outer-major): new region k = outer*inner_count... returns (rows,) int32.
 
@@ -69,7 +179,7 @@ def _region_permutation(order_outer: int, order_inner: int, slot: int) -> jnp.nd
     return jnp.asarray(idx)
 
 
-def _compact_slots(flat: jnp.ndarray, recv_sizes: jnp.ndarray, slot: int, recv_rows: int):
+def compact_slots(flat: jnp.ndarray, recv_sizes: jnp.ndarray, slot: int, recv_rows: int):
     """Pack a sender-major slot grid into the tight layout (the dense
     lowering's compaction, shared shape — ops/exchange.py)."""
     n = recv_sizes.shape[0]
@@ -96,7 +206,7 @@ def _hier_shard(spec: ExchangeSpec, num_slices: int, chips: int, data, size_row)
 
     # phase A prep: regions are dest-flat-major (s' outer, c' inner); regroup
     # to c'-outer so each ICI peer's group is contiguous
-    perm_a = _region_permutation(num_slices, chips, slot)  # (s',c') -> (c',s')
+    perm_a = region_permutation(num_slices, chips, slot)  # (s',c') -> (c',s')
     grouped = data[perm_a]
 
     # phase A: ICI all_to_all over the chip axis — after it, this chip holds
@@ -106,7 +216,7 @@ def _hier_shard(spec: ExchangeSpec, num_slices: int, chips: int, data, size_row)
         "ici", split_axis=0, concat_axis=0, tiled=True,
     ).reshape(chips * num_slices * slot, spec.lane)
     # layout now: (c_src, s') regions — regroup to s'-outer for the DCN phase
-    perm_b = _region_permutation(chips, num_slices, slot)  # (c_src,s') -> (s',c_src)
+    perm_b = region_permutation(chips, num_slices, slot)  # (c_src,s') -> (s',c_src)
     staged = a[perm_b]
 
     # phase B: DCN all_to_all over the slice axis — one crossing per datum,
@@ -116,7 +226,7 @@ def _hier_shard(spec: ExchangeSpec, num_slices: int, chips: int, data, size_row)
         "dcn", split_axis=0, concat_axis=0, tiled=True,
     ).reshape(num_slices * chips * slot, spec.lane)
     # layout: (s_src, c_src) regions = flat sender id ascending — compact
-    out = _compact_slots(b, recv_sizes, slot, spec.recv_rows)
+    out = compact_slots(b, recv_sizes, slot, spec.recv_rows)
     return out, recv_sizes[None, :]
 
 

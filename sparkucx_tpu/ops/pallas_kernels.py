@@ -444,6 +444,269 @@ def build_block_scatter(
     return fn
 
 
+# Public alias: the fused scatter+exchange lowering (ops/ici_exchange.py)
+# composes the window-scan scatter with the scheduled ring inside ONE jit.
+xla_scatter_windows = _xla_scatter
+
+
+# ----------------------------------------------------------------------------
+# Scheduled inter-chip ring exchange (ops/ici_exchange.py's TPU lowering)
+# ----------------------------------------------------------------------------
+#
+# One kernel invocation per device (inside shard_map over the ring axis)
+# executes a static flow schedule of remote DMAs: per step, at most one chunk
+# window per ICI link direction (``pltpu.make_async_remote_copy`` — the
+# bidirectional-ring pattern of SNIPPETS.md [1]/[3]).  The schedule arrives as
+# plain ``(offset, chunk, direction)`` tuples so this module stays free of the
+# schedule dataclasses (ops/ici_exchange.py owns those and depends on us).
+
+
+def _ring_exchange_steps(
+    num_devices, slot_rows, window_rows, steps, me, data_ref, out_ref,
+    send_sem, recv_sem,
+):
+    """Shared schedule walk: remote-copy every (offset, chunk) window.
+
+    Sender ``me`` pushes its staging window for destination ``me+d`` into the
+    destination's sender-major grid region (rows ``me*slot + chunk*w``).  The
+    schedule is SPMD-symmetric, so each step's ``wait()`` pairs my outgoing
+    descriptor with the incoming copy of the same (offset, chunk) from
+    ``me-d`` — same window size, same semaphore index, both directions of the
+    ring in flight at once."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    for step in steps:
+        copies = []
+        for offset, chunk, direction in step:
+            dst_dev = jax.lax.rem(me + offset, num_devices)
+            sem_idx = 0 if direction >= 0 else 1
+            copy = pltpu.make_async_remote_copy(
+                src_ref=data_ref.at[
+                    pl.ds(dst_dev * slot_rows + chunk * window_rows, window_rows)
+                ],
+                dst_ref=out_ref.at[
+                    pl.ds(me * slot_rows + chunk * window_rows, window_rows)
+                ],
+                send_sem=send_sem.at[sem_idx],
+                recv_sem=recv_sem.at[sem_idx],
+                device_id=(dst_dev,),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            copy.start()
+            copies.append(copy)
+        for copy in copies:
+            copy.wait()
+
+
+def _ring_barrier(num_devices, offsets, me):
+    """Rendezvous with every schedule partner before the first remote write —
+    a peer's out buffer must exist before bytes land in it (pallas collective
+    discipline: barrier on the collective_id semaphore)."""
+    import jax
+    from jax.experimental.pallas import tpu as pltpu
+
+    barrier = pltpu.get_barrier_semaphore()
+    for d in offsets:
+        pltpu.semaphore_signal(
+            barrier,
+            1,
+            device_id=(jax.lax.rem(me + d, num_devices),),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+    pltpu.semaphore_wait(barrier, len(offsets))
+
+
+def ring_exchange_grid(
+    axis_name: str,
+    num_devices: int,
+    slot_rows: int,
+    window_rows: int,
+    steps,
+    data,
+    *,
+    interpret: bool = False,
+    collective_id: int = 13,
+):
+    """Pallas scheduled ring exchange: destination-major slots in, sender-major
+    received grid out — the remote-DMA equivalent of one tiled all_to_all.
+
+    * ``data``: (num_devices * slot_rows, lane) per-device staging shard.
+    * ``steps``: sequence of steps; each step a sequence of
+      ``(offset, chunk, direction)`` with at most one item per ring direction
+      (ops/ici_exchange.ring_schedule guarantees it).
+    * returns (num_devices * slot_rows, lane): row ``k*slot_rows + r`` = row r
+      of what sender k staged for me — identical layout to the dense
+      lowering's all_to_all output (ops/exchange._exchange_shard_dense).
+
+    Must be called inside shard_map over ``axis_name``.  TPU-only (remote
+    DMA); ``interpret=True`` is for single-device structural debugging.
+    """
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    steps = tuple(tuple(step) for step in steps)
+    offsets = sorted({offset for step in steps for offset, _, _ in step})
+
+    def kernel(data_ref, out_ref, send_sem, recv_sem, local_sem):
+        me = jax.lax.axis_index(axis_name)
+        _ring_barrier(num_devices, offsets, me)
+        # own slot never crosses a link: one local HBM->HBM DMA
+        local = pltpu.make_async_copy(
+            data_ref.at[pl.ds(me * slot_rows, slot_rows)],
+            out_ref.at[pl.ds(me * slot_rows, slot_rows)],
+            local_sem,
+        )
+        local.start()
+        local.wait()
+        _ring_exchange_steps(
+            num_devices, slot_rows, window_rows, steps, me,
+            data_ref, out_ref, send_sem, recv_sem,
+        )
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (num_devices * slot_rows, data.shape[1]), data.dtype
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=tpu_compiler_params(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interpret,
+    )(data)
+
+
+def fused_scatter_ring_grid(
+    axis_name: str,
+    num_devices: int,
+    slot_rows: int,
+    window_rows: int,
+    steps,
+    starts,
+    counts,
+    outs,
+    packed,
+    staging,
+    *,
+    interpret: bool = False,
+    collective_id: int = 14,
+):
+    """Fused send side: block scatter + scheduled ring exchange, ONE kernel.
+
+    Phase 1 places the packed map-output blocks into the slot-layout staging
+    (the ``_scatter_dma_kernel`` pipeline, staging aliased in-place); phase 2
+    runs the ring schedule straight out of that staging — the bytes never
+    round-trip HBM between the staging write and the wire, and the separate
+    scatter kernel launch disappears.
+
+    Returns ``(grid, staged)``: the sender-major received grid plus the
+    staging with blocks placed (aliased to the ``staging`` operand).  Same
+    plan contract as ``build_block_scatter`` (starts=dst rows, counts,
+    outs=packed offsets; zero-count blocks are no-ops).
+    """
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    steps = tuple(tuple(step) for step in steps)
+    offsets = sorted({offset for step in steps for offset, _, _ in step})
+    k = DMA_PIPELINE_DEPTH
+
+    def kernel(
+        starts_ref, counts_ref, outs_ref, packed_ref, staging_ref,
+        grid_ref, staged_ref, send_sem, recv_sem, local_sem, scatter_sems,
+    ):
+        del staging_ref  # aliased to staged_ref; all writes go through it
+        me = jax.lax.axis_index(axis_name)
+        num_blocks = starts_ref.shape[0]
+
+        def get_dma(i):
+            return pltpu.make_async_copy(
+                packed_ref.at[pl.ds(outs_ref[i], counts_ref[i])],
+                staged_ref.at[pl.ds(starts_ref[i], counts_ref[i])],
+                scatter_sems.at[jax.lax.rem(i, k)],
+            )
+
+        def body(i, _):
+            @pl.when(jnp.logical_and(i >= k, counts_ref[jnp.maximum(i - k, 0)] > 0))
+            def _wait_prev():
+                get_dma(i - k).wait()
+
+            @pl.when(counts_ref[i] > 0)
+            def _start():
+                get_dma(i).start()
+
+            return 0
+
+        jax.lax.fori_loop(0, num_blocks, body, 0)
+
+        def drain(i, _):
+            @pl.when(counts_ref[i] > 0)
+            def _wait():
+                get_dma(i).wait()
+
+            return 0
+
+        jax.lax.fori_loop(jnp.maximum(num_blocks - k, 0), num_blocks, drain, 0)
+
+        # staging is complete on THIS device; the barrier also orders every
+        # peer's scatter before any remote read of their staging
+        _ring_barrier(num_devices, offsets, me)
+        local = pltpu.make_async_copy(
+            staged_ref.at[pl.ds(me * slot_rows, slot_rows)],
+            grid_ref.at[pl.ds(me * slot_rows, slot_rows)],
+            local_sem,
+        )
+        local.start()
+        local.wait()
+        _ring_exchange_steps(
+            num_devices, slot_rows, window_rows, steps, me,
+            staged_ref, grid_ref, send_sem, recv_sem,
+        )
+
+    lane = packed.shape[1]
+    # staging is operand 4 of the FULL input tuple (scalar-prefetch args
+    # included in the alias numbering), aliased to output 1 (staged)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((num_devices * slot_rows, lane), packed.dtype),
+            jax.ShapeDtypeStruct(staging.shape, staging.dtype),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA((DMA_PIPELINE_DEPTH,)),
+            ],
+        ),
+        input_output_aliases={4: 1},
+        compiler_params=tpu_compiler_params(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interpret,
+    )(starts, counts, outs, packed, staging)
+
+
 def pack_plan(
     offsets_lengths: Sequence[Tuple[int, int]], row_bytes: int
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:

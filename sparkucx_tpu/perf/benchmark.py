@@ -57,6 +57,12 @@ Modes:
   devices; prints M rows/s.  The on-device analogue of the workload the
   reference gates on — ``GroupByTest`` generates random (key, value) pairs and
   groups them by key (buildlib/test.sh:163-173, BASELINE.json configs[0]).
+* ``ici`` — the FAST-scheduled ring exchange (ops/ici_exchange.py) vs the
+  stock collective at mesh widths 2/4/8 (``--executors N`` pins one width):
+  aggregate and per-directed-link GB/s for both impls, superstep/occupancy
+  telemetry (utils/stats.py), bit-equality asserted, plus the fused
+  scatter+exchange single-launch check.  ``--chunks`` sets the FAST
+  per-destination interleave depth.
 * ``join`` — time the device-resident hash join (ops/relational.py): a PK-FK
   inner join in the TPC-H shape (BASELINE.json configs[2]) — ``--build-rows``
   dimension rows (unique keys, 8 int32 lanes) probed by -n fact rows (16
@@ -85,7 +91,7 @@ def _parse_args(argv):
         "mode",
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
-            "columnar", "groupby", "join", "write", "skew", "wire",
+            "columnar", "groupby", "join", "write", "skew", "wire", "ici",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -156,6 +162,11 @@ def _parse_args(argv):
         "--quota", type=int, default=0,
         help="slot quota in rows (skew mode); 0 picks the pow2 ceiling of the "
         "mean lane size automatically",
+    )
+    p.add_argument(
+        "--chunks", type=int, default=0,
+        help="FAST chunks per destination (ici mode); 0 picks the default "
+        "interleave depth (ops/ici_exchange.py DEFAULT_CHUNKS_PER_DEST)",
     )
     return p.parse_args(argv)
 
@@ -864,6 +875,237 @@ def run_skew(args) -> None:
     )
 
 
+def measure_ici(
+    executors_list=(2, 4, 8), slot_rows: int = 1024, lane: int = 128,
+    chunks_per_dest: int = 0, iterations: int = 5, report=None, stats=None,
+) -> dict:
+    """Measurement core of the ``ici`` mode — the FAST-scheduled ring exchange
+    (ops/ici_exchange.py) head-to-head against the stock collective
+    (ops/exchange.py) at each mesh width in ``executors_list`` (clamped to the
+    devices actually present).
+
+    Per width: both impls are compiled over the same mesh, fed identical
+    seeded slot-layout payloads with ragged per-peer sizes, asserted
+    bit-identical (recv bytes AND recv_sizes), then timed over chained
+    donated iterations.  Bandwidth is reported two ways: aggregate GB/s
+    (remote bytes / wall) and per-link GB/s (a width-n bidirectional ring has
+    2n directed ICI links, so per-link = aggregate / 2n — the number that maps
+    onto a chip's per-direction ICI bandwidth).  Per-superstep span and link
+    occupancy land in ``stats`` (utils/stats.py StatsAggregator,
+    ``record_counters`` under kind ``ici_n{n}``): supersteps per exchange,
+    busy/idle directed-link slots from ``step_occupancy``, and the measured
+    mean span per superstep.  The fused send side
+    (build_fused_ici_exchange: block scatter + exchange, ONE launch) is
+    checked at the widest mesh against the two-launch scatter-then-exchange
+    reference — bit-equality asserted, staging-launch elimination recorded.
+    ``report(impl, n, it, seconds, bytes)`` per iteration.  Shared by the CLI
+    and bench.py."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
+    from sparkucx_tpu.ops.ici_exchange import (
+        DEFAULT_CHUNKS_PER_DEST,
+        build_fused_ici_exchange,
+        build_ici_exchange,
+        schedule_chunks,
+        step_occupancy,
+    )
+
+    if chunks_per_dest <= 0:
+        chunks_per_dest = DEFAULT_CHUNKS_PER_DEST
+    avail = jax.device_count()
+    widths = sorted({n for n in executors_list if 2 <= n <= avail})
+    if not widths:
+        raise RuntimeError(
+            f"ici mode needs >=2 devices (have {avail}); widths {executors_list}"
+        )
+    row_bytes = lane * 4
+    per_n: dict = {}
+    for n in widths:
+        slot = max(chunks_per_dest, slot_rows)
+        chunks = schedule_chunks(slot, chunks_per_dest)
+        send_rows = n * slot
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=send_rows, recv_rows=send_rows, lane=lane
+        )
+        mesh = make_mesh(n)
+        sharding = NamedSharding(mesh, P("ex", None))
+        stock = build_exchange(mesh, spec)
+        pallas = build_ici_exchange(mesh, spec, chunks_per_dest=chunks_per_dest)
+        sched = pallas.schedule
+
+        rng = np.random.default_rng(7)
+        sizes_host = rng.integers(1, slot + 1, size=(n, n)).astype(np.int32)
+        data_host = rng.integers(
+            -100, 100, size=(n * send_rows, lane), dtype=np.int32
+        )
+        sizes = jax.device_put(sizes_host, sharding)
+
+        def shot(fn):
+            data = jax.device_put(data_host, sharding)
+            recv, rs = fn(data, sizes)
+            jax.block_until_ready(recv)
+            return np.asarray(recv), np.asarray(rs)
+
+        recv_s, rs_s = shot(stock)  # warmup/compile + oracle
+        recv_p, rs_p = shot(pallas)
+        assert np.array_equal(rs_s, rs_p), f"recv_sizes diverged at n={n}"
+        assert recv_s.tobytes() == recv_p.tobytes(), (
+            f"scheduled exchange diverged from stock at n={n}"
+        )
+        # every device ships (n-1) remote slots per exchange; local slot is
+        # a same-chip copy, not ICI traffic
+        remote_bytes = n * (n - 1) * slot * row_bytes
+
+        def time_impl(name, fn):
+            best = 0.0
+            for it in range(iterations):
+                data = jax.device_put(data_host, sharding)
+                t0 = time.perf_counter()
+                cur = data
+                for _ in range(4):  # chained: donation recycles the buffer
+                    cur, _ = fn(cur, sizes)
+                jax.block_until_ready(cur)
+                dt = time.perf_counter() - t0
+                best = max(best, 4 * remote_bytes / dt / 1e9)
+                if report is not None:
+                    report(name, n, it, dt, 4 * remote_bytes)
+            return best
+
+        stock_gbps = time_impl("stock", stock)
+        pallas_gbps = time_impl("pallas", pallas)
+        occ = step_occupancy(sched)
+        if stats is not None:
+            span_ns = int(remote_bytes / max(pallas_gbps, 1e-9) / sched.num_steps)
+            stats.record_counters(
+                f"ici_n{n}",
+                supersteps=sched.num_steps,
+                busy_link_slots=sum(b for b, _ in occ),
+                idle_link_slots=sum(i for _, i in occ),
+                superstep_span_ns=span_ns,
+            )
+            used = int(sizes_host.sum())
+            stats.record_rows(f"ici_n{n}", used, n * n * slot - used)
+        per_n[n] = {
+            "stock_gbps": stock_gbps,
+            "pallas_gbps": pallas_gbps,
+            "pallas_per_link_gbps": pallas_gbps / (2 * n),
+            "stock_per_link_gbps": stock_gbps / (2 * n),
+            "supersteps": sched.num_steps,
+            "chunks": sched.chunks,
+            "lowering": pallas.lowering,
+            "bit_identical": True,
+        }
+
+    # Fused send side at the widest mesh: scatter + exchange in one launch
+    # vs the two-launch reference (host-built staged layout -> stock fn).
+    n = widths[-1]
+    slot = max(chunks_per_dest, slot_rows)
+    send_rows = n * slot
+    spec = ExchangeSpec(
+        num_executors=n, send_rows=send_rows, recv_rows=send_rows, lane=lane
+    )
+    mesh = make_mesh(n)
+    sharding = NamedSharding(mesh, P("ex", None))
+    rng = np.random.default_rng(11)
+    sizes_host = rng.integers(1, slot + 1, size=(n, n)).astype(np.int32)
+    # one block per destination: packed rows consecutive per sender, scattered
+    # to the head of each destination slot (build_block_scatter plan triple)
+    starts = np.zeros((n, n), dtype=np.int32)
+    counts = np.zeros((n, n), dtype=np.int32)
+    outs = np.zeros((n, n), dtype=np.int32)
+    packed_host = np.zeros((n * send_rows, lane), dtype=np.int32)
+    staged_ref = np.zeros((n * send_rows, lane), dtype=np.int32)
+    for i in range(n):
+        off = 0
+        for j in range(n):
+            c = int(sizes_host[i, j])
+            rows = rng.integers(-100, 100, size=(c, lane), dtype=np.int32)
+            packed_host[i * send_rows + off : i * send_rows + off + c] = rows
+            staged_ref[i * send_rows + j * slot : i * send_rows + j * slot + c] = rows
+            starts[i, j], counts[i, j], outs[i, j] = j * slot, c, off
+            off += c
+    fused = build_fused_ici_exchange(
+        mesh, spec, n, chunks_per_dest=chunks_per_dest, max_block_rows=slot
+    )
+    stock = build_exchange(mesh, spec)
+    sizes = jax.device_put(sizes_host, sharding)
+    recv_ref, rs_ref = stock(jax.device_put(staged_ref, sharding), sizes)
+    recv_f, rs_f = fused(
+        jax.device_put(starts, sharding),
+        jax.device_put(counts, sharding),
+        jax.device_put(outs, sharding),
+        jax.device_put(packed_host, sharding),
+        jax.device_put(np.zeros((n * send_rows, lane), dtype=np.int32), sharding),
+        sizes,
+    )
+    assert np.array_equal(np.asarray(rs_ref), np.asarray(rs_f)), (
+        "fused recv_sizes diverged"
+    )
+    assert np.asarray(recv_ref).tobytes() == np.asarray(recv_f).tobytes(), (
+        "fused scatter+exchange diverged from scatter-then-exchange"
+    )
+    return {
+        "slot_rows": max(chunks_per_dest, slot_rows),
+        "chunks_per_dest": chunks_per_dest,
+        "per_n": per_n,
+        "fused": {
+            "executors": n,
+            "bit_identical": True,
+            # one jitted launch covers scatter AND exchange; the reference
+            # needs a separate staging launch before its exchange
+            "launches": 1,
+            "reference_launches": 2,
+        },
+    }
+
+
+def run_ici(args) -> None:
+    from sparkucx_tpu.utils.stats import StatsAggregator
+
+    size = parse_size(args.block_size)
+    slot_rows = max(1, size // 512)
+    stats = StatsAggregator()
+
+    def report(impl, n, it, dt, tot):
+        print(
+            f"n={n} {impl:6} iter {it}: {tot} remote bytes in {dt*1e3:.1f} ms "
+            f"= {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    widths = (2, 4, 8) if args.executors <= 1 else (args.executors,)
+    r = measure_ici(
+        widths, slot_rows, 128, chunks_per_dest=args.chunks,
+        iterations=args.iterations, report=report, stats=stats,
+    )
+    print(
+        f"slot {r['slot_rows']} rows, {r['chunks_per_dest']} chunks/dest "
+        f"requested",
+        flush=True,
+    )
+    for n, p in sorted(r["per_n"].items()):
+        print(
+            f"n={n}: stock {p['stock_gbps']:.2f} GB/s, pallas "
+            f"{p['pallas_gbps']:.2f} GB/s ({p['pallas_per_link_gbps']:.3f} "
+            f"GB/s/link over {2*n} links), {p['supersteps']} supersteps x "
+            f"{p['chunks']} chunks [{p['lowering']}]; bit-identical",
+            flush=True,
+        )
+    f = r["fused"]
+    print(
+        f"fused send side (n={f['executors']}): scatter+exchange in "
+        f"{f['launches']} launch vs {f['reference_launches']} "
+        f"(separate staging launch eliminated); bit-identical",
+        flush=True,
+    )
+    print(stats.report(), flush=True)
+
+
 def run_write(args) -> None:
     size = parse_size(args.block_size)
     impls = (
@@ -1324,6 +1566,8 @@ def main(argv=None) -> None:
         run_write(args)
     elif args.mode == "skew":
         run_skew(args)
+    elif args.mode == "ici":
+        run_ici(args)
     elif args.mode == "sort":
         run_sort(args)
     elif args.mode == "columnar":

@@ -289,7 +289,14 @@ class SpmdShuffleExecutor:
         size can never become a compile-cache key."""
         n = self.num_executors
         bucketed_rows = bucket_send_rows(bucketed_rows, n)
-        key = (bucketed_rows, lane, self.conf.num_slices)
+        from sparkucx_tpu.ops.ici_exchange import resolve_exchange_impl
+
+        impl = resolve_exchange_impl(
+            self.conf.exchange_impl,
+            self.mesh.devices.reshape(-1)[0].platform,
+            n,
+        )
+        key = (bucketed_rows, lane, self.conf.num_slices, impl)
         fn = self._exchange_fns.get(key)
         if fn is None:
             spec = ExchangeSpec(
@@ -309,7 +316,29 @@ class SpmdShuffleExecutor:
                     n // self.conf.num_slices,
                     devices=list(self.mesh.devices.reshape(-1)),
                 )
-                fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
+                if impl == "pallas":
+                    from sparkucx_tpu.ops.ici_exchange import (
+                        DEFAULT_CHUNKS_PER_DEST,
+                        build_ici_exchange,
+                    )
+
+                    fn = build_ici_exchange(
+                        hmesh, spec.resolve_impl(),
+                        chunks_per_dest=DEFAULT_CHUNKS_PER_DEST,
+                    )
+                else:
+                    fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
+            elif impl == "pallas":
+                # FAST-scheduled ring exchange (ops/ici_exchange.py):
+                # bit-identical, remote-DMA on TPU, scheduled permutes here
+                from sparkucx_tpu.ops.ici_exchange import (
+                    DEFAULT_CHUNKS_PER_DEST,
+                    build_ici_exchange,
+                )
+
+                fn = build_ici_exchange(
+                    self.mesh, spec, chunks_per_dest=DEFAULT_CHUNKS_PER_DEST
+                )
             else:
                 fn = build_exchange(self.mesh, spec)
             self._exchange_fns[key] = fn

@@ -225,7 +225,17 @@ class TpuShuffleCluster:
         # bucketed slot layout — rebucket_slots; padding rows carry zero sizes
         # and never cross the wire under the ragged lowering).
         send_rows = bucket_send_rows(send_rows, self.num_executors)
-        key = (self.num_executors, send_rows, self.row_bytes, self.conf.num_slices)
+        from sparkucx_tpu.ops.ici_exchange import resolve_exchange_impl
+
+        impl = resolve_exchange_impl(
+            self.conf.exchange_impl,
+            self.mesh.devices.reshape(-1)[0].platform,
+            self.num_executors,
+        )
+        key = (
+            self.num_executors, send_rows, self.row_bytes,
+            self.conf.num_slices, impl,
+        )
         with self._lock:
             fn = self._exchange_cache.get(key)
             if fn is None:
@@ -250,7 +260,30 @@ class TpuShuffleCluster:
                         self.num_executors // self.conf.num_slices,
                         devices=list(self.mesh.devices.reshape(-1)),
                     )
-                    fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
+                    if impl == "pallas":
+                        from sparkucx_tpu.ops.ici_exchange import (
+                            DEFAULT_CHUNKS_PER_DEST,
+                            build_ici_exchange,
+                        )
+
+                        fn = build_ici_exchange(
+                            hmesh, spec.resolve_impl(),
+                            chunks_per_dest=DEFAULT_CHUNKS_PER_DEST,
+                        )
+                    else:
+                        fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
+                elif impl == "pallas":
+                    # FAST-scheduled ring exchange (ops/ici_exchange.py):
+                    # bit-identical results, remote-DMA kernel on TPU,
+                    # scheduled permutes elsewhere
+                    from sparkucx_tpu.ops.ici_exchange import (
+                        DEFAULT_CHUNKS_PER_DEST,
+                        build_ici_exchange,
+                    )
+
+                    fn = build_ici_exchange(
+                        self.mesh, spec, chunks_per_dest=DEFAULT_CHUNKS_PER_DEST
+                    )
                 else:
                     fn = build_exchange(self.mesh, spec)
                 self._exchange_cache[key] = fn

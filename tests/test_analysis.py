@@ -114,6 +114,27 @@ class TestUseAfterDonate:
         assert len(findings) == 1
         assert "out" in findings[0].message
 
+    def test_ici_exchange_donates_staging(self):
+        """The scheduled-exchange builders (ops/ici_exchange.py) carry the
+        same donation contracts as their stock counterparts: arg 0 of the
+        plain exchange, the staging buffer (arg 4) of the fused send side."""
+        findings = run_source(
+            src(
+                """
+                def run(mesh, spec, data, sizes, staging):
+                    fn = build_ici_exchange(mesh, spec)
+                    fn(data, sizes)
+                    fused = build_fused_ici_exchange(mesh, spec, 8)
+                    fused(a, b, c, d, staging, sizes)
+                    return data.sum() + staging.sum()
+                """
+            ),
+            passes=["use-after-donate"],
+        )
+        assert len(findings) == 2
+        assert any("data" in f.message for f in findings)
+        assert any("staging" in f.message for f in findings)
+
 
 # ----------------------------------------------------------------------
 # lock-discipline
@@ -333,6 +354,51 @@ class TestCacheHygiene:
                         if key not in self._exchange_cache:
                             self._exchange_cache[key] = build_thing(rows, depth)
                         return self._exchange_cache[key]
+                """
+            ),
+            passes=["cache-hygiene"],
+        )
+        assert findings == []
+
+    def test_ici_cache_raw_shape_key_flagged(self):
+        """A compiled-schedule cache in front of build_ici_exchange keyed on
+        raw send_rows is the same recompile bomb the exchange cache pass
+        exists to catch — ISSUE 6's cache must stay pow2-bucketed."""
+        findings = run_source(
+            src(
+                """
+                class T:
+                    def get(self, send_rows, chunks):
+                        key = (send_rows, chunks)
+                        if key not in self._ici_cache:
+                            self._ici_cache[key] = build_ici_exchange(
+                                self.mesh, make_spec(send_rows), chunks_per_dest=chunks
+                            )
+                        return self._ici_cache[key]
+                """
+            ),
+            passes=["cache-hygiene"],
+        )
+        msgs = messages(findings)
+        assert any("'send_rows'" in m for m in msgs)
+
+    def test_ici_cache_bucketed_rebind_clean(self):
+        """bucket_send_rows sanctifies the slot geometry and schedule_chunks
+        (the pow2 chunk-count clamp, BUCKETING_MARKERS) sanctifies the chunk
+        key — the shape the real transports put in front of the cache."""
+        findings = run_source(
+            src(
+                """
+                class T:
+                    def get(self, send_rows, chunks):
+                        send_rows = bucket_send_rows(send_rows, self.n)
+                        chunks = schedule_chunks(send_rows // self.n, chunks)
+                        key = (send_rows, chunks)
+                        if key not in self._ici_cache:
+                            self._ici_cache[key] = build_ici_exchange(
+                                self.mesh, make_spec(send_rows), chunks_per_dest=chunks
+                            )
+                        return self._ici_cache[key]
                 """
             ),
             passes=["cache-hygiene"],

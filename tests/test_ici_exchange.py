@@ -1,0 +1,534 @@
+"""FAST-scheduled ring exchange (ops/ici_exchange.py; conf exchange.impl).
+
+Four layers of pinning, mirroring the skew suite's structure:
+
+* schedule model — pure-python property tests over ``ring_schedule`` /
+  ``simulate_ring``: every (src, dst, chunk) window delivered exactly once,
+  at most one window per link direction per superstep, chunk-major FAST
+  interleaving, antipodal alternation, pow2 chunk clamping;
+* lowering bit-equality — the scheduled-permute exchange (flat, hierarchical,
+  and the fused scatter+exchange send side) must produce byte-for-byte the
+  stock collective's receive state on the 8-way CPU mesh;
+* topology probe — slice_index-derived hop classification and mesh
+  factorization with stand-in device objects (the pure-python fallback);
+* cluster bit-equality — ``exchange.impl=pallas`` through the full
+  TpuShuffleCluster must match the stock default across host_recv_modes and
+  quota planning, plus a true two-process SPMD lockstep run.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus
+from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
+from sparkucx_tpu.ops.hierarchy import (
+    build_hierarchical_exchange,
+    device_slice_ids,
+    hop_kinds,
+    hop_schedule,
+    make_hierarchical_mesh,
+    probe_topology,
+)
+from sparkucx_tpu.ops.ici_exchange import (
+    DEFAULT_CHUNKS_PER_DEST,
+    HierarchicalSchedule,
+    RingSchedule,
+    build_fused_ici_exchange,
+    build_ici_exchange,
+    resolve_exchange_impl,
+    resolve_ici_lowering,
+    ring_schedule,
+    schedule_chunks,
+    simulate_ring,
+    step_occupancy,
+)
+from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+N = 8
+LANE = 32
+ROW_BYTES = LANE * 4
+
+
+# ----------------------------------------------------------------------
+# schedule model (pure python, no mesh)
+
+
+class TestRingSchedule:
+    @pytest.mark.parametrize("dim", [2, 3, 4, 5, 8])
+    @pytest.mark.parametrize("chunks", [1, 2, 4])
+    def test_exactly_once_and_link_cap(self, dim, chunks):
+        sched = ring_schedule(dim, chunks)
+        deliveries, link_load = simulate_ring(sched)
+        # every remote (src, dst, chunk) window exactly once, nothing local
+        for src in range(dim):
+            for dst in range(dim):
+                for c in range(chunks):
+                    want = 0 if src == dst else 1
+                    assert deliveries.get((src, dst, c), 0) == want, (src, dst, c)
+        # <= 1 window per device per ring direction per superstep
+        assert all(v <= 1 for v in link_load.values())
+
+    @pytest.mark.parametrize("dim", [3, 4, 8])
+    def test_chunk_major_interleaving(self, dim):
+        """FAST hot-lane interleaving: chunk 0 of EVERY destination is
+        scheduled before chunk 1 of any — per ring direction the chunk
+        sequence is non-decreasing."""
+        sched = ring_schedule(dim, 4)
+        for direction in (1, -1):
+            seq = [it.chunk for it in sched.items() if it.direction == direction]
+            assert seq == sorted(seq)
+
+    @pytest.mark.parametrize("dim", [2, 4, 8])
+    def test_antipodal_alternates_directions(self, dim):
+        """The half-way offset has no short way; its chunks split across both
+        rings by parity so neither direction carries the whole hot lane."""
+        sched = ring_schedule(dim, 4)
+        anti = [it for it in sched.items() if 2 * it.offset == dim]
+        assert anti, "even dims have an antipodal offset"
+        for it in anti:
+            assert it.direction == (1 if it.chunk % 2 == 0 else -1)
+
+    def test_step_count_and_occupancy(self):
+        # n=8, 2 chunks: 14 items split 8 (+) / 6 (-) by short-way -> 8 steps
+        sched = ring_schedule(8, 2)
+        assert sched.num_steps == max(
+            sum(1 for it in sched.items() if it.direction == 1),
+            sum(1 for it in sched.items() if it.direction == -1),
+        )
+        occ = step_occupancy(sched)
+        assert sum(b for b, _ in occ) == 2 * (8 - 1)
+        assert all(b + i == 2 for b, i in occ)
+
+    def test_rejects_bad_dims(self):
+        with pytest.raises(ValueError, match="dim"):
+            ring_schedule(1)
+        with pytest.raises(ValueError, match="chunks_per_dest"):
+            ring_schedule(4, 0)
+
+
+class TestScheduleChunks:
+    def test_pow2_divisor_clamp(self):
+        assert schedule_chunks(16, 3) == 4  # pow2 ceil of 3
+        assert schedule_chunks(16, 64) == 16  # capped at the group
+        assert schedule_chunks(12, 8) == 4  # largest pow2 divisor of 12
+        assert schedule_chunks(8, 1) == 1
+        assert schedule_chunks(7, 4) == 1  # odd groups stay unchunked
+
+    def test_rejects_nonpositive_group(self):
+        with pytest.raises(ValueError, match="group_rows"):
+            schedule_chunks(0, 2)
+
+
+class TestResolvers:
+    def test_exchange_impl_matrix(self):
+        assert resolve_exchange_impl("stock", "tpu", 8) == "stock"
+        assert resolve_exchange_impl("pallas", "cpu", 8) == "pallas"
+        assert resolve_exchange_impl("auto", "tpu", 8) == "pallas"
+        assert resolve_exchange_impl("auto", "tpu", 1) == "stock"
+        assert resolve_exchange_impl("auto", "cpu", 8) == "stock"
+        with pytest.raises(ValueError, match="exchange impl"):
+            resolve_exchange_impl("bogus", "cpu", 8)
+
+    def test_lowering_matrix(self):
+        assert resolve_ici_lowering("auto", "tpu") == "dma"
+        assert resolve_ici_lowering("auto", "cpu") == "xla"
+        assert resolve_ici_lowering("interpret", "tpu") == "interpret"
+        with pytest.raises(ValueError, match="lowering"):
+            resolve_ici_lowering("bogus", "cpu")
+
+
+# ----------------------------------------------------------------------
+# topology probe (stand-in device objects; the pure-python fallback path)
+
+
+class _Dev:
+    def __init__(self, slice_index=None):
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+
+class TestTopologyProbe:
+    def test_slice_ids_absent(self):
+        assert device_slice_ids([_Dev(), _Dev()]) is None
+        assert device_slice_ids([_Dev(0), _Dev()]) is None  # partial = none
+
+    def test_flat_fallback(self):
+        devs = [_Dev() for _ in range(4)]
+        assert probe_topology(devs)[:2] == (1, 4)
+        assert hop_kinds(devs)[0, 1] == "ici"
+        assert hop_kinds(devs)[2, 2] == "local"
+
+    def test_groups_interleaved_enumeration(self):
+        """jax.devices() order is NOT trusted: devices are regrouped by
+        slice_index so each mesh row is one physical slice."""
+        devs = [_Dev(0), _Dev(1), _Dev(0), _Dev(1)]
+        s, c, ordered = probe_topology(devs)
+        assert (s, c) == (2, 2)
+        assert [d.slice_index for d in ordered] == [0, 0, 1, 1]
+
+    def test_ragged_slices_raise(self):
+        with pytest.raises(ValueError, match="ragged"):
+            probe_topology([_Dev(0), _Dev(0), _Dev(1)])
+
+    def test_hop_kinds_cross_slice(self):
+        devs = [_Dev(0), _Dev(0), _Dev(1), _Dev(1)]
+        kinds = hop_kinds(devs)
+        assert kinds[0, 1] == "ici" and kinds[2, 3] == "ici"
+        assert kinds[0, 2] == "dcn" and kinds[3, 0] == "dcn"
+
+    def test_mesh_topology_mismatch_raises(self):
+        devs = [_Dev(0), _Dev(0), _Dev(1), _Dev(1)]
+        with pytest.raises(ValueError, match="topology"):
+            make_hierarchical_mesh(4, 1, devices=devs)
+
+
+class TestHopSchedule:
+    def test_flat_mesh_single_ring(self):
+        sched = hop_schedule(make_mesh(4), chunks_per_dest=2, slot_rows=16)
+        assert isinstance(sched, RingSchedule)
+        assert (sched.dim, sched.chunks, sched.kind) == (4, 2, "ici")
+
+    def test_hierarchical_mesh_distinct_fabrics(self):
+        mesh = make_hierarchical_mesh(2, 4)
+        sched = hop_schedule(mesh, chunks_per_dest=2, slot_rows=16)
+        assert isinstance(sched, HierarchicalSchedule)
+        assert sched.ici is not None and sched.ici.dim == 4
+        assert sched.ici.kind == "ici"
+        assert sched.dcn is not None and sched.dcn.dim == 2
+        assert sched.dcn.kind == "dcn"
+
+    def test_chunks_clamped_per_phase(self):
+        # ici phase group = S*slot = 2*6 = 12 rows -> pow2 divisor 4
+        mesh = make_hierarchical_mesh(2, 4)
+        sched = hop_schedule(mesh, chunks_per_dest=8, slot_rows=6)
+        assert sched.ici.chunks == 4
+        assert sched.dcn.chunks == 8  # dcn group = C*slot = 24 -> 8 divides
+
+
+# ----------------------------------------------------------------------
+# lowering bit-equality vs the stock collective (8-way CPU mesh)
+
+
+def _random_case(rng, n, slot):
+    sizes = rng.integers(0, slot + 1, size=(n, n)).astype(np.int32)
+    data = rng.integers(-100, 100, size=(n * n * slot, LANE), dtype=np.int32)
+    return data, sizes
+
+
+def _run(fn, mesh, data, sizes):
+    sharding = NamedSharding(mesh, P(tuple(mesh.axis_names), None))
+    d = jax.device_put(data, sharding)
+    s = jax.device_put(sizes, sharding)
+    recv, rs = fn(d, s)
+    return np.asarray(recv), np.asarray(rs)
+
+
+class TestFlatBitEquality:
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    @pytest.mark.parametrize("chunks", [1, 2])
+    def test_matches_stock(self, rng, n, chunks):
+        slot = 16
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=n * slot, recv_rows=n * slot, lane=LANE
+        )
+        mesh = make_mesh(n)
+        stock = build_exchange(mesh, spec)
+        sched = build_ici_exchange(mesh, spec, chunks_per_dest=chunks)
+        assert sched.lowering == "xla"  # CPU mesh: scheduled permutes
+        data, sizes = _random_case(rng, n, slot)
+        recv_s, rs_s = _run(stock, mesh, data, sizes)
+        recv_p, rs_p = _run(sched, mesh, data, sizes)
+        np.testing.assert_array_equal(rs_s, rs_p)
+        assert recv_s.tobytes() == recv_p.tobytes()
+
+    def test_asymmetric_recv_rows_no_donation(self, rng):
+        """send_rows != recv_rows disables donation (the build_exchange rule)
+        and still compacts identically."""
+        n, slot = 4, 8
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=n * slot, recv_rows=2 * n * slot, lane=LANE
+        )
+        mesh = make_mesh(n)
+        stock = build_exchange(mesh, spec)
+        sched = build_ici_exchange(mesh, spec, chunks_per_dest=2)
+        data, sizes = _random_case(rng, n, slot)
+        recv_s, rs_s = _run(stock, mesh, data, sizes)
+        recv_p, rs_p = _run(sched, mesh, data, sizes)
+        np.testing.assert_array_equal(rs_s, rs_p)
+        assert recv_s.tobytes() == recv_p.tobytes()
+
+    def test_n1_delegates_to_stock(self):
+        spec = ExchangeSpec(num_executors=1, send_rows=8, recv_rows=8, lane=LANE)
+        fn = build_ici_exchange(make_mesh(1), spec)
+        assert not hasattr(fn, "schedule"), "n=1 must take the stock builder"
+
+    def test_builder_validation(self):
+        spec = ExchangeSpec(num_executors=4, send_rows=32, recv_rows=32, lane=LANE)
+        mesh = make_mesh(4)
+        with pytest.raises(ValueError, match="mesh size"):
+            build_ici_exchange(make_mesh(2), spec)
+        with pytest.raises(ValueError, match="schedule dim"):
+            build_ici_exchange(mesh, spec, schedule=ring_schedule(8, 1))
+        with pytest.raises(ValueError, match="divide"):
+            build_ici_exchange(mesh, spec, schedule=ring_schedule(4, 3))
+        with pytest.raises(ValueError, match="RingSchedule"):
+            build_ici_exchange(
+                mesh, spec,
+                schedule=HierarchicalSchedule(2, 2, ring_schedule(2), ring_schedule(2)),
+            )
+
+
+class TestHierarchicalBitEquality:
+    @pytest.mark.parametrize("chunks", [1, 2])
+    def test_matches_two_phase_stock(self, rng, chunks):
+        S, C, slot = 2, 4, 8
+        n = S * C
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=n * slot, recv_rows=n * slot, lane=LANE
+        )
+        mesh = make_hierarchical_mesh(S, C)
+        stock = build_hierarchical_exchange(mesh, spec.resolve_impl())
+        sched = build_ici_exchange(mesh, spec, chunks_per_dest=chunks)
+        assert isinstance(sched.schedule, HierarchicalSchedule)
+        data, sizes = _random_case(rng, n, slot)
+        recv_s, rs_s = _run(stock, mesh, data, sizes)
+        recv_p, rs_p = _run(sched, mesh, data, sizes)
+        np.testing.assert_array_equal(rs_s, rs_p)
+        assert recv_s.tobytes() == recv_p.tobytes()
+
+    def test_needs_hierarchical_schedule(self):
+        S, C, slot = 2, 4, 8
+        n = S * C
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=n * slot, recv_rows=n * slot, lane=LANE
+        )
+        with pytest.raises(ValueError, match="Hierarchical"):
+            build_ici_exchange(
+                make_hierarchical_mesh(S, C), spec, schedule=ring_schedule(n, 1)
+            )
+
+
+class TestFusedSendSide:
+    def test_matches_scatter_then_exchange(self, rng):
+        """The fused plan (scatter + scheduled exchange, one launch) equals
+        staging the blocks first and running the stock collective after."""
+        n, slot = 4, 16
+        send_rows = n * slot
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=send_rows, recv_rows=send_rows, lane=LANE
+        )
+        mesh = make_mesh(n)
+        sizes = rng.integers(1, slot + 1, size=(n, n)).astype(np.int32)
+        starts = np.zeros((n, n), dtype=np.int32)
+        counts = np.zeros((n, n), dtype=np.int32)
+        outs = np.zeros((n, n), dtype=np.int32)
+        packed = np.zeros((n * send_rows, LANE), dtype=np.int32)
+        staged_ref = np.zeros((n * send_rows, LANE), dtype=np.int32)
+        for i in range(n):
+            off = 0
+            for j in range(n):
+                c = int(sizes[i, j])
+                rows = rng.integers(-100, 100, size=(c, LANE), dtype=np.int32)
+                packed[i * send_rows + off : i * send_rows + off + c] = rows
+                staged_ref[
+                    i * send_rows + j * slot : i * send_rows + j * slot + c
+                ] = rows
+                starts[i, j], counts[i, j], outs[i, j] = j * slot, c, off
+                off += c
+        fused = build_fused_ici_exchange(
+            mesh, spec, n, chunks_per_dest=2, max_block_rows=slot
+        )
+        stock = build_exchange(mesh, spec)
+        sharding = NamedSharding(mesh, P("ex", None))
+        put = lambda a: jax.device_put(a, sharding)
+        recv_ref, rs_ref = stock(put(staged_ref), put(sizes))
+        recv_f, rs_f = fused(
+            put(starts), put(counts), put(outs), put(packed),
+            put(np.zeros((n * send_rows, LANE), dtype=np.int32)), put(sizes),
+        )
+        np.testing.assert_array_equal(np.asarray(rs_ref), np.asarray(rs_f))
+        assert np.asarray(recv_ref).tobytes() == np.asarray(recv_f).tobytes()
+
+    def test_rejects_hierarchical_mesh(self):
+        spec = ExchangeSpec(num_executors=8, send_rows=64, recv_rows=64, lane=LANE)
+        with pytest.raises(ValueError, match="flat"):
+            build_fused_ici_exchange(make_hierarchical_mesh(2, 4), spec, 4)
+
+
+# ----------------------------------------------------------------------
+# conf plumbing
+
+
+class TestConf:
+    def test_from_spark_conf(self):
+        conf = TpuShuffleConf.from_spark_conf(
+            {"spark.shuffle.tpu.exchange.impl": "pallas"}
+        )
+        assert conf.exchange_impl == "pallas"
+
+    def test_default_is_stock(self):
+        assert TpuShuffleConf().exchange_impl == "stock"
+
+    def test_validate_rejects_unknown(self):
+        conf = TpuShuffleConf(exchange_impl="bogus")
+        with pytest.raises(ValueError, match="exchange_impl"):
+            conf.validate()
+
+
+# ----------------------------------------------------------------------
+# cluster bit-equality: exchange.impl=pallas through the full transport
+# (the skew suite's idiom: seeded skewed writes, byte-compared receive state)
+
+N_EXEC = 4
+
+
+def _buf(n):
+    return MemoryBlock(np.zeros(n, dtype=np.uint8), size=n)
+
+
+def _write_skewed(cluster, shuffle_id, M, R, seed=77):
+    meta = cluster.create_shuffle(shuffle_id, M, R)
+    rng = np.random.default_rng(seed)
+    oracle = {}
+    for m in range(M):
+        t = cluster.transport(meta.map_owner[m])
+        w = t.store.map_writer(shuffle_id, m)
+        for r in range(R):
+            size = int(rng.integers(2000, 3000)) if r == 0 else int(rng.integers(1, 300))
+            payload = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+            oracle[(m, r)] = payload
+            w.write_partition(r, payload)
+        t.commit_block(w.commit().pack())
+    return meta, oracle
+
+
+def _fetch_all(cluster, meta, shuffle_id, M, R, oracle):
+    for r in range(R):
+        consumer = meta.owner_of_reduce(r)
+        t = cluster.transport(consumer)
+        bufs = [_buf(8192) for _ in range(M)]
+        reqs = t.fetch_blocks_by_block_ids(
+            consumer, [ShuffleBlockId(shuffle_id, m, r) for m in range(M)],
+            bufs, [None] * M,
+        )
+        for m in range(M):
+            res = reqs[m].wait(5)
+            assert res.status == OperationStatus.SUCCESS, str(res.error)
+            assert bufs[m].host_view()[: bufs[m].size].tobytes() == oracle[(m, r)]
+
+
+def _conf(impl, quota=0, mode="array", **kw):
+    return TpuShuffleConf(
+        staging_capacity_per_executor=N_EXEC * 4096,
+        block_alignment=128,
+        num_executors=N_EXEC,
+        host_recv_mode=mode,
+        slot_quota_rows=quota,
+        exchange_impl=impl,
+        **kw,
+    )
+
+
+def _exchange(conf, M=3 * N_EXEC, R=8):
+    cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+    meta, oracle = _write_skewed(cluster, 0, M, R)
+    cluster.run_exchange(0)
+    return cluster, meta, oracle
+
+
+class TestClusterBitEquality:
+    def _assert_matches(self, base_meta, meta):
+        assert len(meta.recv_sizes) == len(base_meta.recv_sizes)
+        for rnd in range(len(base_meta.recv_sizes)):
+            np.testing.assert_array_equal(
+                meta.recv_sizes[rnd], base_meta.recv_sizes[rnd]
+            )
+            for j in range(N_EXEC):
+                used = int(base_meta.recv_sizes[rnd][j].sum()) * 128
+                assert bytes(meta.recv_shards[rnd][j][:used]) == bytes(
+                    base_meta.recv_shards[rnd][j][:used]
+                )
+
+    @pytest.mark.parametrize("mode", ["array", "memmap"])
+    def test_pallas_matches_stock(self, mode, tmp_path):
+        kw = {"spill_dir": str(tmp_path)} if mode == "memmap" else {}
+        _, base_meta, oracle = _exchange(_conf("stock", mode=mode, **kw))
+        cluster, meta, _ = _exchange(_conf("pallas", mode=mode, **kw))
+        assert len(base_meta.recv_sizes) > 1, "should spill multiple rounds"
+        self._assert_matches(base_meta, meta)
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    def test_device_mode(self):
+        conf = _conf("pallas", mode="device", keep_device_recv=True)
+        cluster, meta, oracle = _exchange(conf)
+        assert meta.recv_shards is None and meta.recv_device is not None
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    @pytest.mark.parametrize("impl", ["pallas", "auto"])
+    def test_quota_composition(self, impl):
+        """The scheduled exchange under the skew planner's sub-round chunking:
+        every sub-round routes through the scheduled kernel and the spliced
+        receive state still matches the stock single-shot default."""
+        _, base_meta, oracle = _exchange(_conf("stock"))
+        cluster, meta, _ = _exchange(_conf(impl, quota=8))
+        self._assert_matches(base_meta, meta)
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    def test_auto_resolves_stock_on_cpu(self):
+        """auto on a CPU mesh must take the stock path (cache key proves the
+        resolution; ISSUE 6 acceptance: stock stays the byte-for-byte
+        default off-TPU)."""
+        cluster, meta, oracle = _exchange(_conf("auto"))
+        keys = [k for k in cluster._exchange_cache if k[0] != "gather"]
+        assert keys and all(k[-1] == "stock" for k in keys)
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    def test_pallas_cache_key_is_pallas(self):
+        cluster, meta, oracle = _exchange(_conf("pallas"))
+        keys = [k for k in cluster._exchange_cache if k[0] != "gather"]
+        assert keys and all(k[-1] == "pallas" for k in keys)
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+
+# ----------------------------------------------------------------------
+# true multi-controller lockstep (the test_spmd.py harness, pallas impl)
+
+
+def test_two_process_spmd_exchange_pallas():
+    """Both processes resolve exchange.impl=pallas and must build the SAME
+    schedule: the scheduled permutes are collectives, so any asymmetry
+    deadlocks or corrupts — CHILD's oracle check catches both."""
+    from test_spmd import CHILD, ROOT, _free_port
+    from sparkucx_tpu.parallel.bootstrap import DriverEndpoint
+
+    driver = DriverEndpoint()
+    coord = f"127.0.0.1:{_free_port()}"
+    driver_addr = f"{driver.address[0]}:{driver.address[1]}"
+    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["TEST_EXCHANGE_IMPL"] = "pallas"
+    script = CHILD.format(root=ROOT)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(pid), coord, driver_addr],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=ROOT, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"child {pid} failed:\n{out[-3000:]}"
+            assert f"CHILD_PASS pid={pid}" in out, out[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        driver.close()

@@ -35,6 +35,7 @@ CHILD = textwrap.dedent(
         host_recv_mode=os.environ.get("TEST_HOST_RECV_MODE", "array"),
         spill_dir=os.environ.get("TEST_SPILL_DIR") or None,
         slot_quota_rows=int(os.environ.get("TEST_SLOT_QUOTA_ROWS", "0")),
+        exchange_impl=os.environ.get("TEST_EXCHANGE_IMPL", "stock"),
     )
     ex = SpmdShuffleExecutor(conf, coordinator_address=coord, num_processes=2, process_id=pid)
     assert ex.num_executors == 2, ex.num_executors
