@@ -84,10 +84,15 @@ def make_hierarchical_mesh(
     When the devices report a genuinely multi-slice topology
     (``slice_index`` with more than one distinct value) the rows follow the
     PHYSICAL slices (probe_topology groups them), not the flat enumeration
-    order, and a request that disagrees with the hardware raises.  Devices
-    with no slice ids — or all on one slice — take the requested
-    factorization as a LOGICAL split (CPU meshes, and single-slice tests of
-    the two-phase route)."""
+    order.  A request that disagrees with the probed factorization is still
+    accepted when it is COMPATIBLE — the requested ``chips_per_slice``
+    divides the physical one, so every ici row stays inside one physical
+    slice (e.g. splitting a 2x8 deployment as 4x4; the extra dcn hops between
+    same-slice rows just ride the conservative DCN path).  An incompatible
+    request — one that would put chips of different slices on one ici row,
+    where remote DMA cannot reach — raises.  Devices with no slice ids — or
+    all on one slice — take the requested factorization as a LOGICAL split
+    (CPU meshes, and single-slice tests of the two-phase route)."""
     devs = list(devices if devices is not None else jax.devices())
     n = num_slices * chips_per_slice
     if len(devs) < n:
@@ -95,11 +100,12 @@ def make_hierarchical_mesh(
     devs = devs[:n]
     ids = device_slice_ids(devs)
     if ids is not None and len(set(ids)) > 1:
-        s, c, devs = probe_topology(devs)
-        if (s, c) != (num_slices, chips_per_slice):
+        s, c, devs = probe_topology(devs)  # slice-major order either way
+        if (s, c) != (num_slices, chips_per_slice) and c % chips_per_slice:
             raise ValueError(
-                f"runtime topology is {s}x{c} (slice_index), "
-                f"requested {num_slices}x{chips_per_slice}"
+                f"runtime topology is {s}x{c} (slice_index); a "
+                f"{num_slices}x{chips_per_slice} factorization would mix "
+                f"slices on one ici row — chips_per_slice must divide {c}"
             )
     return Mesh(
         np.array(devs).reshape(num_slices, chips_per_slice), ("dcn", "ici")
@@ -152,9 +158,19 @@ def hop_schedule(mesh: Mesh, *, chunks_per_dest: int = 1, slot_rows=None):
 
     if set(mesh.axis_names) == {"dcn", "ici"}:
         s, c = mesh.shape["dcn"], mesh.shape["ici"]
+        # the ici phase is intra-slice ICI only if every mesh row really
+        # stays inside one physical slice (make_hierarchical_mesh guarantees
+        # it; a hand-built mesh may not) — a mixed row is conservatively
+        # 'dcn' so the lowering guard keeps remote DMA off it
+        ids = device_slice_ids(mesh.devices.reshape(-1))
+        ici_kind = "ici"
+        if ids is not None and any(
+            len(set(ids[r * c : (r + 1) * c])) > 1 for r in range(s)
+        ):
+            ici_kind = "dcn"
         ici_group = s * slot_rows if slot_rows is not None else None
         dcn_group = c * slot_rows if slot_rows is not None else None
-        ici = ring_schedule(c, clamp(ici_group), kind="ici") if c > 1 else None
+        ici = ring_schedule(c, clamp(ici_group), kind=ici_kind) if c > 1 else None
         dcn = ring_schedule(s, clamp(dcn_group), kind="dcn") if s > 1 else None
         return HierarchicalSchedule(num_slices=s, chips_per_slice=c, ici=ici, dcn=dcn)
     n = mesh.devices.size

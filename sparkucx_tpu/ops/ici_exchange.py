@@ -27,8 +27,18 @@ transfer.  This module applies that argument to the TPU ICI torus:
     ``jax.lax.ppermute`` per item inside shard_map.  This is what the 8-way
     CPU mesh and the SPMD suite run, so CI exercises the full schedule logic
     (delivery, placement, compaction) without TPU hardware.
-  - ``'interpret'`` — the Pallas kernel under ``interpret=True`` (structural
-    debugging).
+  - ``'interpret'`` — the Pallas kernel under ``interpret=True``: on flat
+    meshes CI runs it on CPU and asserts bit-equality with stock, so the
+    kernel body (schedule walk, remote-copy placement, ring-position ->
+    logical-device-id rebasing) is executed without TPU hardware.
+    Hierarchical meshes fall back to 'xla' here (jax's interpret discharge
+    of remote DMA is single-axis only).
+
+  Remote DMA cannot cross slice boundaries: any ring classified ``'dcn'``
+  by the topology probe (flat meshes spanning slices, hand-built (dcn, ici)
+  meshes with mixed rows) is forced onto the 'xla' tier by
+  :func:`resolve_schedule_lowering`, mirroring the hardcoded permute tier
+  of the hierarchical DCN phase.
 
   Both lowerings land received windows in the SAME sender-major slot grid the
   dense lowering's all_to_all produces and share its compaction math
@@ -69,7 +79,11 @@ from sparkucx_tpu.ops.exchange import (
     build_exchange,
     gather_size_matrix,
 )
-from sparkucx_tpu.ops.hierarchy import compact_slots, region_permutation
+from sparkucx_tpu.ops.hierarchy import (
+    compact_slots,
+    device_slice_ids,
+    region_permutation,
+)
 
 LOWERINGS = ("auto", "dma", "xla", "interpret")
 
@@ -236,6 +250,18 @@ def resolve_ici_lowering(lowering: str, platform: str) -> str:
     return lowering
 
 
+def resolve_schedule_lowering(lowering: str, kind: str) -> str:
+    """Fabric guard: remote DMA cannot cross slices, so any ring whose hops
+    are classified ``'dcn'`` (hierarchy.hop_schedule — flat meshes spanning
+    slices, or hand-built (dcn, ici) meshes whose rows mix slices) is forced
+    onto the scheduled-XLA lowering — the same rule the hierarchical route
+    hardcodes for its DCN phase.  'interpret' is left alone (debug tier, no
+    real DMA)."""
+    if kind == "dcn" and lowering == "dma":
+        return "xla"
+    return lowering
+
+
 # ----------------------------------------------------------------------------
 # Lowerings
 # ----------------------------------------------------------------------------
@@ -269,9 +295,14 @@ def _axis_grid_xla(ax, dim: int, group_rows: int, sched: Optional[RingSchedule],
     return grid
 
 
-def _axis_grid(ax, dim, group_rows, sched, flat, me, lowering):
-    """Dispatch one exchange phase to its lowering tier."""
-    if lowering == "xla" or sched is None:
+def _axis_grid(ax, dim, group_rows, sched, flat, me, lowering, mesh_axes=None):
+    """Dispatch one exchange phase to its lowering tier.  ``mesh_axes`` (full
+    ordered (name, size) mesh layout) rebases ring positions to logical
+    device ids for the remote-DMA tier when ``ax`` is a sub-axis."""
+    if sched is None:
+        return _axis_grid_xla(ax, dim, group_rows, sched, flat, me)
+    lowering = resolve_schedule_lowering(lowering, sched.kind)
+    if lowering == "xla":
         return _axis_grid_xla(ax, dim, group_rows, sched, flat, me)
     from sparkucx_tpu.ops.pallas_kernels import ring_exchange_grid
 
@@ -282,6 +313,7 @@ def _axis_grid(ax, dim, group_rows, sched, flat, me, lowering):
         group_rows // sched.chunks,
         sched.raw_steps(),
         flat,
+        mesh_axes=mesh_axes,
         interpret=(lowering == "interpret"),
     )
 
@@ -316,7 +348,12 @@ def _hier_sched_shard(
 
     perm_a = region_permutation(S, C, slot)  # (s',c') -> (c',s')
     grouped = data[perm_a]
-    a = _axis_grid("ici", C, S * slot, sched.ici, grouped, c_idx, lowering)
+    # the ICI ring runs over a SUB-axis: ring position c is logical device
+    # s_idx * C + c, so the DMA tier needs the full mesh layout to rebase
+    a = _axis_grid(
+        "ici", C, S * slot, sched.ici, grouped, c_idx, lowering,
+        mesh_axes=(("dcn", S), ("ici", C)),
+    )
     perm_b = region_permutation(C, S, slot)  # (c_src,s') -> (s',c_src)
     staged = a[perm_b]
     b = _axis_grid("dcn", S, C * slot, sched.dcn, staged, s_idx, "xla")
@@ -369,6 +406,45 @@ def build_ici_exchange(
     if hierarchical:
         if not isinstance(schedule, HierarchicalSchedule):
             raise ValueError("hierarchical mesh needs a HierarchicalSchedule")
+        S, C = mesh.shape["dcn"], mesh.shape["ici"]
+        if (schedule.num_slices, schedule.chips_per_slice) != (S, C):
+            raise ValueError(
+                f"schedule factorization {schedule.num_slices}x"
+                f"{schedule.chips_per_slice} != mesh {S}x{C}"
+            )
+        # per-phase mirror of the flat branch's checks: a chunk count that
+        # doesn't divide the phase's transfer group would truncate
+        # window_rows and silently drop the tail of every transfer
+        if schedule.ici is not None:
+            if schedule.ici.dim != C:
+                raise ValueError(
+                    f"ici schedule dim {schedule.ici.dim} != mesh ici axis {C}"
+                )
+            if (S * resolved.slot_rows) % schedule.ici.chunks:
+                raise ValueError(
+                    f"ici chunks {schedule.ici.chunks} must divide the ICI "
+                    f"transfer group {S * resolved.slot_rows} rows"
+                )
+        if schedule.dcn is not None:
+            if schedule.dcn.dim != S:
+                raise ValueError(
+                    f"dcn schedule dim {schedule.dcn.dim} != mesh dcn axis {S}"
+                )
+            if (C * resolved.slot_rows) % schedule.dcn.chunks:
+                raise ValueError(
+                    f"dcn chunks {schedule.dcn.chunks} must divide the DCN "
+                    f"transfer group {C * resolved.slot_rows} rows"
+                )
+        # effective tier: the DCN phase always rides xla; the ICI phase keeps
+        # the DMA tier only when its hops really are intra-slice ICI
+        if schedule.ici is None:
+            low = "xla"
+        else:
+            low = resolve_schedule_lowering(low, schedule.ici.kind)
+            if low == "interpret":
+                # jax's interpret discharge of remote DMA only supports
+                # single-axis meshes; the schedule logic is still exercised
+                low = "xla"
         body = functools.partial(_hier_sched_shard, resolved, schedule, low)
         pspec = P(("dcn", "ici"), None)
     else:
@@ -382,6 +458,9 @@ def build_ici_exchange(
             raise ValueError(
                 f"chunks {schedule.chunks} must divide slot_rows {resolved.slot_rows}"
             )
+        # flat mesh spanning slices: hop_schedule classifies every hop 'dcn'
+        # (remote DMA cannot cross slices) — ride scheduled permutes instead
+        low = resolve_schedule_lowering(low, schedule.kind)
         body = functools.partial(_ici_shard, resolved, schedule, low)
         pspec = P(resolved.axis_name, None)
 
@@ -446,12 +525,17 @@ def build_fused_ici_exchange(
         raise ValueError("fused ici exchange needs num_executors > 1")
     low = resolve_ici_lowering(lowering, platform)
     if schedule is None:
+        # same fabric classification as hierarchy.hop_schedule: a flat mesh
+        # spanning slices means every offset crosses DCN for some source
+        ids = device_slice_ids(mesh.devices.reshape(-1))
+        kind = "ici" if ids is None or len(set(ids)) == 1 else "dcn"
         chunks = schedule_chunks(resolved.slot_rows, chunks_per_dest)
-        schedule = ring_schedule(resolved.num_executors, chunks)
+        schedule = ring_schedule(resolved.num_executors, chunks, kind=kind)
     if resolved.slot_rows % schedule.chunks:
         raise ValueError(
             f"chunks {schedule.chunks} must divide slot_rows {resolved.slot_rows}"
         )
+    low = resolve_schedule_lowering(low, schedule.kind)
     window = max(1, max_block_rows if max_block_rows is not None else resolved.slot_rows)
     n = resolved.num_executors
     slot = resolved.slot_rows

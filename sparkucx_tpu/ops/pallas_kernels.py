@@ -459,10 +459,55 @@ xla_scatter_windows = _xla_scatter
 # bidirectional-ring pattern of SNIPPETS.md [1]/[3]).  The schedule arrives as
 # plain ``(offset, chunk, direction)`` tuples so this module stays free of the
 # schedule dataclasses (ops/ici_exchange.py owns those and depends on us).
+#
+# Remote targets are LOGICAL device ids — the linearized index into the FULL
+# shard_map mesh — while the schedule speaks ring POSITIONS along one mesh
+# axis.  When that axis is a sub-axis (the ICI phase of a (dcn, ici) mesh)
+# the two differ: chip c of slice s is logical id ``s * C + c``, not ``c``.
+# ``ring_axis_layout`` provides the position->id affine map; every remote
+# signal/copy below goes through it.
+
+
+def ring_axis_layout(mesh_axes, axis_name):
+    """Row-major strides mapping ring positions on one mesh axis to logical
+    device ids.
+
+    ``mesh_axes``: ordered ``(name, size)`` pairs of the FULL shard_map mesh
+    (row-major, matching ``Mesh(devices.reshape(...), names)``).  Returns
+    ``(ring_stride, other_axes)`` with ``other_axes`` = ``(name, stride)`` for
+    every non-ring axis, such that the logical id of ring position ``p`` is::
+
+        p * ring_stride + sum(axis_index(name) * stride for other axes)
+
+    Pure python — unit-testable without a mesh (tests/test_ici_exchange.py).
+    """
+    mesh_axes = tuple((str(n), int(s)) for n, s in mesh_axes)
+    names = [n for n, _ in mesh_axes]
+    if axis_name not in names:
+        raise ValueError(f"ring axis {axis_name!r} not in mesh axes {names}")
+    strides = {}
+    stride = 1
+    for name, size in reversed(mesh_axes):
+        strides[name] = stride
+        stride *= size
+    others = tuple((n, strides[n]) for n, _ in mesh_axes if n != axis_name)
+    return strides[axis_name], others
+
+
+def _ring_device_id(mesh_axes, axis_name):
+    """Kernel-side ring-position -> logical-device-id map (traced; must run
+    inside shard_map over ``mesh_axes``)."""
+    import jax
+
+    ring_stride, other_axes = ring_axis_layout(mesh_axes, axis_name)
+    base = 0
+    for name, stride in other_axes:
+        base = base + jax.lax.axis_index(name) * stride
+    return lambda pos: base + pos * ring_stride
 
 
 def _ring_exchange_steps(
-    num_devices, slot_rows, window_rows, steps, me, data_ref, out_ref,
+    num_devices, slot_rows, window_rows, steps, me, dev_id, data_ref, out_ref,
     send_sem, recv_sem,
 ):
     """Shared schedule walk: remote-copy every (offset, chunk) window.
@@ -480,18 +525,18 @@ def _ring_exchange_steps(
     for step in steps:
         copies = []
         for offset, chunk, direction in step:
-            dst_dev = jax.lax.rem(me + offset, num_devices)
+            dst_pos = jax.lax.rem(me + offset, num_devices)
             sem_idx = 0 if direction >= 0 else 1
             copy = pltpu.make_async_remote_copy(
                 src_ref=data_ref.at[
-                    pl.ds(dst_dev * slot_rows + chunk * window_rows, window_rows)
+                    pl.ds(dst_pos * slot_rows + chunk * window_rows, window_rows)
                 ],
                 dst_ref=out_ref.at[
                     pl.ds(me * slot_rows + chunk * window_rows, window_rows)
                 ],
                 send_sem=send_sem.at[sem_idx],
                 recv_sem=recv_sem.at[sem_idx],
-                device_id=(dst_dev,),
+                device_id=dev_id(dst_pos),
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
             copy.start()
@@ -500,7 +545,7 @@ def _ring_exchange_steps(
             copy.wait()
 
 
-def _ring_barrier(num_devices, offsets, me):
+def _ring_barrier(num_devices, offsets, me, dev_id):
     """Rendezvous with every schedule partner before the first remote write —
     a peer's out buffer must exist before bytes land in it (pallas collective
     discipline: barrier on the collective_id semaphore)."""
@@ -512,7 +557,7 @@ def _ring_barrier(num_devices, offsets, me):
         pltpu.semaphore_signal(
             barrier,
             1,
-            device_id=(jax.lax.rem(me + d, num_devices),),
+            device_id=dev_id(jax.lax.rem(me + d, num_devices)),
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
     pltpu.semaphore_wait(barrier, len(offsets))
@@ -526,6 +571,7 @@ def ring_exchange_grid(
     steps,
     data,
     *,
+    mesh_axes=None,
     interpret: bool = False,
     collective_id: int = 13,
 ):
@@ -536,23 +582,42 @@ def ring_exchange_grid(
     * ``steps``: sequence of steps; each step a sequence of
       ``(offset, chunk, direction)`` with at most one item per ring direction
       (ops/ici_exchange.ring_schedule guarantees it).
+    * ``mesh_axes``: ordered (name, size) pairs of the FULL shard_map mesh
+      when ``axis_name`` is a sub-axis (e.g. the ICI phase of a (dcn, ici)
+      mesh) — remote DMA targets logical device ids, so ring positions must
+      be rebased per ``ring_axis_layout``.  Defaults to a flat
+      ``((axis_name, num_devices),)`` mesh where position == id.
     * returns (num_devices * slot_rows, lane): row ``k*slot_rows + r`` = row r
       of what sender k staged for me — identical layout to the dense
       lowering's all_to_all output (ops/exchange._exchange_shard_dense).
 
-    Must be called inside shard_map over ``axis_name``.  TPU-only (remote
-    DMA); ``interpret=True`` is for single-device structural debugging.
+    Must be called inside shard_map over ``axis_name``.  The compiled kernel
+    is TPU-only (remote DMA); ``interpret=True`` runs the same kernel body
+    under the Pallas interpreter — works on single-axis meshes on any
+    platform (the barrier is skipped: interpret discharge is synchronous and
+    the barrier semaphore is TPU-only) and is bit-equality-tested against
+    the stock collective on the CPU mesh in CI.
     """
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if mesh_axes is None:
+        mesh_axes = ((axis_name, num_devices),)
+    mesh_axes = tuple((str(n), int(s)) for n, s in mesh_axes)
+    if dict(mesh_axes)[axis_name] != num_devices:
+        raise ValueError(
+            f"ring axis {axis_name!r} has size {dict(mesh_axes)[axis_name]} in "
+            f"mesh_axes, expected num_devices={num_devices}"
+        )
     steps = tuple(tuple(step) for step in steps)
     offsets = sorted({offset for step in steps for offset, _, _ in step})
 
     def kernel(data_ref, out_ref, send_sem, recv_sem, local_sem):
         me = jax.lax.axis_index(axis_name)
-        _ring_barrier(num_devices, offsets, me)
+        dev_id = _ring_device_id(mesh_axes, axis_name)
+        if not interpret:  # interpret discharge is synchronous; the barrier
+            _ring_barrier(num_devices, offsets, me, dev_id)  # is TPU-only
         # own slot never crosses a link: one local HBM->HBM DMA
         local = pltpu.make_async_copy(
             data_ref.at[pl.ds(me * slot_rows, slot_rows)],
@@ -562,7 +627,7 @@ def ring_exchange_grid(
         local.start()
         local.wait()
         _ring_exchange_steps(
-            num_devices, slot_rows, window_rows, steps, me,
+            num_devices, slot_rows, window_rows, steps, me, dev_id,
             data_ref, out_ref, send_sem, recv_sem,
         )
 
@@ -597,6 +662,7 @@ def fused_scatter_ring_grid(
     packed,
     staging,
     *,
+    mesh_axes=None,
     interpret: bool = False,
     collective_id: int = 14,
 ):
@@ -617,6 +683,14 @@ def fused_scatter_ring_grid(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if mesh_axes is None:
+        mesh_axes = ((axis_name, num_devices),)
+    mesh_axes = tuple((str(n), int(s)) for n, s in mesh_axes)
+    if dict(mesh_axes)[axis_name] != num_devices:
+        raise ValueError(
+            f"ring axis {axis_name!r} has size {dict(mesh_axes)[axis_name]} in "
+            f"mesh_axes, expected num_devices={num_devices}"
+        )
     steps = tuple(tuple(step) for step in steps)
     offsets = sorted({offset for step in steps for offset, _, _ in step})
     k = DMA_PIPELINE_DEPTH
@@ -660,7 +734,9 @@ def fused_scatter_ring_grid(
 
         # staging is complete on THIS device; the barrier also orders every
         # peer's scatter before any remote read of their staging
-        _ring_barrier(num_devices, offsets, me)
+        dev_id = _ring_device_id(mesh_axes, axis_name)
+        if not interpret:  # interpret discharge is synchronous; the barrier
+            _ring_barrier(num_devices, offsets, me, dev_id)  # is TPU-only
         local = pltpu.make_async_copy(
             staged_ref.at[pl.ds(me * slot_rows, slot_rows)],
             grid_ref.at[pl.ds(me * slot_rows, slot_rows)],
@@ -669,7 +745,7 @@ def fused_scatter_ring_grid(
         local.start()
         local.wait()
         _ring_exchange_steps(
-            num_devices, slot_rows, window_rows, steps, me,
+            num_devices, slot_rows, window_rows, steps, me, dev_id,
             staged_ref, grid_ref, send_sem, recv_sem,
         )
 

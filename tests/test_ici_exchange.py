@@ -19,6 +19,7 @@ Four layers of pinning, mirroring the skew suite's structure:
 import os
 import subprocess
 import sys
+from types import SimpleNamespace
 
 import jax
 import numpy as np
@@ -45,11 +46,13 @@ from sparkucx_tpu.ops.ici_exchange import (
     build_ici_exchange,
     resolve_exchange_impl,
     resolve_ici_lowering,
+    resolve_schedule_lowering,
     ring_schedule,
     schedule_chunks,
     simulate_ring,
     step_occupancy,
 )
+from sparkucx_tpu.ops.pallas_kernels import ring_axis_layout
 from sparkucx_tpu.transport.tpu import TpuShuffleCluster
 
 N = 8
@@ -144,6 +147,50 @@ class TestResolvers:
         with pytest.raises(ValueError, match="lowering"):
             resolve_ici_lowering("bogus", "cpu")
 
+    def test_fabric_guard_forces_xla_for_dcn(self):
+        """Remote DMA cannot cross slices: any dcn-classified ring must drop
+        from the dma tier to scheduled permutes; ici rings keep their tier."""
+        assert resolve_schedule_lowering("dma", "dcn") == "xla"
+        assert resolve_schedule_lowering("dma", "ici") == "dma"
+        assert resolve_schedule_lowering("xla", "dcn") == "xla"
+        assert resolve_schedule_lowering("interpret", "dcn") == "interpret"
+
+
+class TestRingAxisLayout:
+    """Ring-position -> LOGICAL device id mapping of the Pallas remote-DMA
+    tier: on a (dcn, ici) mesh the ICI phase's ring position c is logical
+    device ``s * C + c``, NOT c — the wrong-device-write bug class the kernel
+    rebases away."""
+
+    def test_flat_mesh_identity(self):
+        stride, others = ring_axis_layout((("ex", 8),), "ex")
+        assert (stride, others) == (1, ())
+
+    def test_hierarchical_ici_axis(self):
+        stride, others = ring_axis_layout((("dcn", 2), ("ici", 4)), "ici")
+        assert stride == 1
+        assert others == (("dcn", 4),)
+        # slice s, ring position p -> global logical id s*4 + p
+        for s in range(2):
+            for p in range(4):
+                assert s * 4 + p * stride == s * 4 + p
+
+    def test_hierarchical_dcn_axis(self):
+        stride, others = ring_axis_layout((("dcn", 2), ("ici", 4)), "dcn")
+        assert stride == 4
+        assert others == (("ici", 1),)
+
+    def test_three_axis_mesh(self):
+        stride, others = ring_axis_layout(
+            (("a", 2), ("b", 3), ("c", 5)), "b"
+        )
+        assert stride == 5
+        assert others == (("a", 15), ("c", 1))
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(ValueError, match="ring axis"):
+            ring_axis_layout((("dcn", 2), ("ici", 4)), "ex")
+
 
 # ----------------------------------------------------------------------
 # topology probe (stand-in device objects; the pure-python fallback path)
@@ -184,10 +231,22 @@ class TestTopologyProbe:
         assert kinds[0, 1] == "ici" and kinds[2, 3] == "ici"
         assert kinds[0, 2] == "dcn" and kinds[3, 0] == "dcn"
 
-    def test_mesh_topology_mismatch_raises(self):
+    def test_mesh_incompatible_factorization_raises(self):
+        """A request whose ici rows would mix physical slices (remote DMA
+        cannot reach across them) is rejected: chips_per_slice=4 does not
+        divide the physical 2."""
         devs = [_Dev(0), _Dev(0), _Dev(1), _Dev(1)]
         with pytest.raises(ValueError, match="topology"):
-            make_hierarchical_mesh(4, 1, devices=devs)
+            make_hierarchical_mesh(1, 4, devices=devs)
+
+    def test_mesh_compatible_refactorization_allowed(self):
+        """Splitting a physical slice axis differently is fine as long as
+        every ici row stays inside one slice — 2x2 hardware as a 4x1 mesh
+        (rows slice-major, extra same-slice hops ride the DCN path)."""
+        devs = [_Dev(0), _Dev(1), _Dev(0), _Dev(1)]
+        mesh = make_hierarchical_mesh(4, 1, devices=devs)
+        rows = [d.slice_index for d in mesh.devices.reshape(-1)]
+        assert rows == [0, 0, 1, 1]  # regrouped slice-major before reshape
 
 
 class TestHopSchedule:
@@ -211,6 +270,40 @@ class TestHopSchedule:
         sched = hop_schedule(mesh, chunks_per_dest=8, slot_rows=6)
         assert sched.ici.chunks == 4
         assert sched.dcn.chunks == 8  # dcn group = C*slot = 24 -> 8 divides
+
+    def test_flat_mesh_spanning_slices_is_dcn(self):
+        """A flat ring over a multi-slice deployment: some source crosses DCN
+        at every offset, so the whole schedule is classified 'dcn' and the
+        lowering guard keeps it off the remote-DMA tier."""
+        devs = [_Dev(0), _Dev(0), _Dev(1), _Dev(1)]
+        mesh = SimpleNamespace(
+            axis_names=("ex",), shape={"ex": 4},
+            devices=np.array(devs, dtype=object),
+        )
+        sched = hop_schedule(mesh, chunks_per_dest=2, slot_rows=16)
+        assert isinstance(sched, RingSchedule)
+        assert sched.kind == "dcn"
+
+    def test_hierarchical_mixed_rows_conservative(self):
+        """A hand-built (dcn, ici) mesh whose ici rows mix slices: the ici
+        phase is conservatively classified 'dcn' (remote DMA can't serve
+        those hops)."""
+        devs = [_Dev(0), _Dev(1), _Dev(0), _Dev(1)]  # rows mix slices
+        mesh = SimpleNamespace(
+            axis_names=("dcn", "ici"), shape={"dcn": 2, "ici": 2},
+            devices=np.array(devs, dtype=object).reshape(2, 2),
+        )
+        sched = hop_schedule(mesh, chunks_per_dest=1, slot_rows=8)
+        assert sched.ici is not None and sched.ici.kind == "dcn"
+
+    def test_hierarchical_slice_pure_rows_stay_ici(self):
+        devs = [_Dev(0), _Dev(0), _Dev(1), _Dev(1)]  # rows slice-pure
+        mesh = SimpleNamespace(
+            axis_names=("dcn", "ici"), shape={"dcn": 2, "ici": 2},
+            devices=np.array(devs, dtype=object).reshape(2, 2),
+        )
+        sched = hop_schedule(mesh, chunks_per_dest=1, slot_rows=8)
+        assert sched.ici is not None and sched.ici.kind == "ici"
 
 
 # ----------------------------------------------------------------------
@@ -246,6 +339,29 @@ class TestFlatBitEquality:
         data, sizes = _random_case(rng, n, slot)
         recv_s, rs_s = _run(stock, mesh, data, sizes)
         recv_p, rs_p = _run(sched, mesh, data, sizes)
+        np.testing.assert_array_equal(rs_s, rs_p)
+        assert recv_s.tobytes() == recv_p.tobytes()
+
+    @pytest.mark.parametrize("chunks", [1, 2])
+    def test_interpret_kernel_matches_stock(self, rng, chunks):
+        """The Pallas kernel BODY — barrier-free interpret discharge of the
+        schedule walk, remote-copy placement, and ring-position -> logical
+        device id mapping — must be bit-identical to the stock collective.
+        This is the tier that actually executes ring_exchange_grid on the
+        CPU mesh (the xla tier never enters the kernel)."""
+        n, slot = 4, 8
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=n * slot, recv_rows=n * slot, lane=LANE
+        )
+        mesh = make_mesh(n)
+        stock = build_exchange(mesh, spec)
+        interp = build_ici_exchange(
+            mesh, spec, chunks_per_dest=chunks, lowering="interpret"
+        )
+        assert interp.lowering == "interpret"
+        data, sizes = _random_case(rng, n, slot)
+        recv_s, rs_s = _run(stock, mesh, data, sizes)
+        recv_p, rs_p = _run(interp, mesh, data, sizes)
         np.testing.assert_array_equal(rs_s, rs_p)
         assert recv_s.tobytes() == recv_p.tobytes()
 
@@ -313,6 +429,46 @@ class TestHierarchicalBitEquality:
         with pytest.raises(ValueError, match="Hierarchical"):
             build_ici_exchange(
                 make_hierarchical_mesh(S, C), spec, schedule=ring_schedule(n, 1)
+            )
+
+    def test_user_schedule_validation(self):
+        """A user-supplied HierarchicalSchedule whose chunks don't divide the
+        phase transfer group must raise (not silently truncate window_rows
+        and drop the tail of every transfer), mirroring the flat branch."""
+        S, C, slot = 2, 4, 8  # ici group = S*slot = 16, dcn group = C*slot = 32
+        n = S * C
+        spec = ExchangeSpec(
+            num_executors=n, send_rows=n * slot, recv_rows=n * slot, lane=LANE
+        )
+        mesh = make_hierarchical_mesh(S, C)
+
+        def sched(ici, dcn, s=S, c=C):
+            return HierarchicalSchedule(s, c, ici, dcn)
+
+        good_ici = ring_schedule(C, 1, kind="ici")
+        good_dcn = ring_schedule(S, 1, kind="dcn")
+        with pytest.raises(ValueError, match="ici chunks"):
+            build_ici_exchange(
+                mesh, spec, schedule=sched(ring_schedule(C, 3, kind="ici"), good_dcn)
+            )
+        with pytest.raises(ValueError, match="dcn chunks"):
+            build_ici_exchange(
+                mesh, spec, schedule=sched(good_ici, ring_schedule(S, 3, kind="dcn"))
+            )
+        with pytest.raises(ValueError, match="ici schedule dim"):
+            build_ici_exchange(
+                mesh, spec, schedule=sched(ring_schedule(2, 1, kind="ici"), good_dcn)
+            )
+        with pytest.raises(ValueError, match="dcn schedule dim"):
+            build_ici_exchange(
+                mesh, spec, schedule=sched(good_ici, ring_schedule(4, 1, kind="dcn"))
+            )
+        with pytest.raises(ValueError, match="factorization"):
+            build_ici_exchange(
+                mesh, spec,
+                schedule=HierarchicalSchedule(
+                    C, S, ring_schedule(S, 1), ring_schedule(C, 1)
+                ),
             )
 
 
