@@ -22,7 +22,10 @@ analogue).  ``vs_baseline`` = tpu_gbps / tcp_gbps.
 Sub-metrics (same JSON line): ``gather_gbps`` — the device-side ragged block
 gather (ops/pallas_kernels.py), ``sort_mrows_s`` — the device-resident TeraSort
 step (ops/sort.py), ``wire`` — the striped loopback peer wire (streams=1 vs 4,
-perf/benchmark.py measure_wire; TPU-free, measured after the TCP baseline).
+perf/benchmark.py measure_wire; TPU-free, measured after the TCP baseline),
+``failover`` — executor-loss robustness (perf/benchmark.py measure_failover;
+TPU-free): steady loopback fetch GB/s vs GB/s with the primary executor killed
+at t=50%, plus recovery time and p99 frame stall.
 
 A small end-to-end shuffle (stage -> commit -> exchange -> fetch vs oracle) runs
 untimed first as an integrity gate.
@@ -310,6 +313,24 @@ def main():
             RESULT["wire"]["syscalls_per_mb"] = round(w[4]["syscalls_per_mb"], 3)
     except Exception as e:
         RESULT["wire_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # 1c. Failover sub-metric — also TPU-free (3-executor loopback cluster
+    # with replication.factor=1, testing/faults.kill_executor as the SIGKILL
+    # stand-in): steady fetch GB/s vs GB/s with the primary killed at t=50%,
+    # recovery time (kill -> first replica-served block), p99 frame stall.
+    try:
+        from sparkucx_tpu.perf.benchmark import measure_failover
+
+        fo = measure_failover(num_blocks=8, block_bytes=8 << 20, iterations=3)
+        RESULT["failover"] = {
+            "steady_gbps": round(fo["steady_gbps"], 3),
+            "killed_gbps": round(fo["killed_gbps"], 3),
+            "recovery_ms": round(fo["recovery_ms"], 1),
+            "failovers": fo["failovers"],
+            "rx_stall_p99_ms": round(fo["rx_stall_p99_ms"], 2),
+        }
+    except Exception as e:
+        RESULT["failover_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # 2. Bounded chip probe — never touch the backend in-process before this.
     platform, probe_err = probe_tpu(budget_left)

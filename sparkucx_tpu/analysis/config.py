@@ -49,6 +49,13 @@ from __future__ import annotations
 #:   it slices on-device via jnp), guarded by ``isinstance(p, jax.Array)``;
 #:   same documented mixed-mode D2H cost, same scope.
 #:
+#: - testing/faults.py ``kill_executor``: the chaos harness's whole job is to
+#:   kill an executor the way SIGKILL would — yanking the live connection
+#:   cache (``._conns``/``._zombies``) out from under the transport is the
+#:   fault being injected, not an API to encourage.  Test-only module (no
+#:   production import path reaches it with nothing armed); reviewed with the
+#:   robustness PR.
+#:
 #: cache-hygiene:
 #: - hbm_store.py ``out_rows``: the scatter output shape IS the staging
 #:   geometry — ``out_rows`` comes from ``staging_capacity_per_executor``
@@ -56,6 +63,8 @@ from __future__ import annotations
 #:   distinct configs.  Bucketing it would over-allocate the HBM staging
 #:   array itself rather than a transient pad.
 ALLOWLIST = {
+    ("testing/faults.py", "private-access", "._conns"),
+    ("testing/faults.py", "private-access", "._zombies"),
     ("store/hbm_store.py", "private-access", "._lock"),
     ("store/hbm_store.py", "private-access", "._rollover"),  # also ._rollover_device
     ("core/block.py", "private-access", "._mmap"),

@@ -105,6 +105,31 @@ class TpuShuffleConf:
     #: SO_SNDBUF/SO_RCVBUF for every peer/daemon socket, both ends; 0 keeps
     #: the platform default plus the transport's builtin 4 MiB reply windows.
     wire_sock_buf_bytes: int = 0
+    #: Socket timeout (ms) for connect/handshake and every mid-frame read on
+    #: both client and server wire paths.  A peer that hangs (alive socket, no
+    #: bytes) mid-frame for longer than this raises a TransportError naming the
+    #: peer address instead of blocking forever.  Idle waits between frames are
+    #: exempt — only a partially received frame can time out.  0 = no timeout
+    #: (the historical block-forever behavior).
+    wire_timeout_ms: int = 30000
+
+    # fault tolerance (replication + reducer failover)
+    #: Number of ring-neighbor executors that receive an asynchronous copy of
+    #: each sealed round's host snapshot (REPLICA_PUT frames).  0 (default)
+    #: disables replication entirely — no frames, no replica storage, wire and
+    #: store behavior byte-identical to pre-replication builds.  With factor k,
+    #: executor e pushes to the k successors of e in the sorted executor ring,
+    #: and reducers fail over to those replicas when the primary dies.
+    replication_factor: int = 0
+    #: Reduce-side fetch deadline (ms) per window: if a window's requests have
+    #: not completed within this budget the reader declares the peer hung,
+    #: fails the window locally, and enters the retry/failover path.  0 = wait
+    #: forever (historical behavior).
+    fetch_deadline_ms: int = 30000
+    #: Base backoff (ms) between reduce-side fetch retry attempts; actual
+    #: sleep is jittered uniformly in [base/2, base] and doubles per attempt
+    #: (bounded exponential backoff, decorrelated across reducers).
+    fetch_backoff_ms: int = 50
 
     # staged store (HBM; NVKV analogue).  512 = one exchange row (128 int32
     # lanes, the native XLA:TPU tile width) and exactly NVKV's sector alignment
@@ -272,6 +297,10 @@ class TpuShuffleConf:
             ("wire.chunkBytes", "wire_chunk_bytes", parse_size),
             ("wire.creditBytes", "wire_credit_bytes", parse_size),
             ("wire.sockBufBytes", "wire_sock_buf_bytes", parse_size),
+            ("wire.timeoutMs", "wire_timeout_ms", int),
+            ("replication.factor", "replication_factor", int),
+            ("fetch.deadlineMs", "fetch_deadline_ms", int),
+            ("fetch.backoffMs", "fetch_backoff_ms", int),
             ("blockAlignment", "block_alignment", parse_size),
             ("stagingCapacity", "staging_capacity_per_executor", parse_size),
             ("storePort", "store_port", int),
@@ -335,6 +364,14 @@ class TpuShuffleConf:
             raise ValueError("wire_credit_bytes must be >= 0 (0 = no pipelining)")
         if self.wire_sock_buf_bytes < 0:
             raise ValueError("wire_sock_buf_bytes must be >= 0 (0 = platform default)")
+        if self.wire_timeout_ms < 0:
+            raise ValueError("wire_timeout_ms must be >= 0 (0 = no timeout)")
+        if self.replication_factor < 0:
+            raise ValueError("replication_factor must be >= 0 (0 = replication off)")
+        if self.fetch_deadline_ms < 0:
+            raise ValueError("fetch_deadline_ms must be >= 0 (0 = no deadline)")
+        if self.fetch_backoff_ms < 0:
+            raise ValueError("fetch_backoff_ms must be >= 0")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

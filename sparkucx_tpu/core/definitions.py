@@ -14,6 +14,9 @@ FetchBlockChunk        5  striped-wire continuation: one chunk of a streaming
                           fetch reply (tag, block, seq, offset) + payload
 WireHello              6  striped-wire lane handshake: (group, lane, nlanes,
                           chunk_bytes) — joins this connection to a stripe group
+ReplicaPut             7  neighbor replication: one sealed round's host snapshot
+                          {shuffle, srcExecutor, round, (map,reduce,len)*N} + body
+ReplicaAck             8  replication ack: echoes (shuffle, srcExecutor, round)
 ====================  ==  =======================================================
 
 Ids 5-6 extend the reference schema for the striped zero-copy wire path: a
@@ -49,6 +52,8 @@ class AmId(enum.IntEnum):
     FETCH_BLOCK_REQ_ACK = 4
     FETCH_BLOCK_CHUNK = 5
     WIRE_HELLO = 6
+    REPLICA_PUT = 7
+    REPLICA_ACK = 8
 
 
 _FRAME = struct.Struct("<IQQ")
@@ -113,6 +118,43 @@ def pack_wire_hello(group: int, lane: int, nlanes: int, chunk_bytes: int) -> byt
 
 def unpack_wire_hello(data) -> Tuple[int, int, int, int]:
     return _HELLO.unpack_from(data)
+
+
+#: ReplicaPut header prefix: (shuffle_id, src_executor, round, num_blocks);
+#: followed by num_blocks ``_REPLICA_ENT`` entries (map_id, reduce_id, length)
+#: describing the body — the concatenated unpadded block payloads in table
+#: order.  ReplicaAck reuses the prefix with num_blocks = 0 and no body.
+_REPLICA_HDR = struct.Struct("<iiiI")
+_REPLICA_ENT = struct.Struct("<iiq")
+
+
+def pack_replica_put(
+    shuffle_id: int, src_executor: int, round_idx: int, entries: List[Tuple[int, int, int]]
+) -> bytes:
+    """Pack a ReplicaPut header; ``entries`` = (map_id, reduce_id, length)."""
+    out = bytearray(_REPLICA_HDR.pack(shuffle_id, src_executor, round_idx, len(entries)))
+    for map_id, reduce_id, length in entries:
+        out += _REPLICA_ENT.pack(map_id, reduce_id, length)
+    return bytes(out)
+
+
+def unpack_replica_put(data) -> Tuple[int, int, int, List[Tuple[int, int, int]]]:
+    sid, src, rnd, n = _REPLICA_HDR.unpack_from(data)
+    entries: List[Tuple[int, int, int]] = []
+    pos = _REPLICA_HDR.size
+    for _ in range(n):
+        entries.append(_REPLICA_ENT.unpack_from(data, pos))
+        pos += _REPLICA_ENT.size
+    return sid, src, rnd, entries
+
+
+def pack_replica_ack(shuffle_id: int, src_executor: int, round_idx: int) -> bytes:
+    return _REPLICA_HDR.pack(shuffle_id, src_executor, round_idx, 0)
+
+
+def unpack_replica_ack(data) -> Tuple[int, int, int]:
+    sid, src, rnd, _ = _REPLICA_HDR.unpack_from(data)
+    return sid, src, rnd
 
 
 @dataclass(frozen=True)

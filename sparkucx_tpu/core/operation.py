@@ -34,6 +34,24 @@ class TransportError(RuntimeError):
     """ShuffleTransport.scala:60-62 (``TransportError`` wraps an error message)."""
 
 
+class BlockNotFoundError(TransportError):
+    """A fetch named a block the serving executor does not hold.
+
+    Subclasses TransportError so existing catch-sites keep working, but is
+    typed + addressed so the reducer can tell "retryable: not yet committed /
+    primary lost, try a replica" apart from programming errors (bad ids).
+    """
+
+    def __init__(self, shuffle_id: int, map_id: int, reduce_id: int, detail: str = "") -> None:
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.reduce_id = reduce_id
+        msg = f"no block (shuffle={shuffle_id}, map={map_id}, reduce={reduce_id}) found"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 @dataclass
 class OperationStats:
     """Per-operation timing/size stats (ShuffleTransport.scala:64-69).

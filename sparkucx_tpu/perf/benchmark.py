@@ -31,6 +31,13 @@ Modes:
   Prints GB/s, receive syscalls/MB, and p99 frame stall per streams value;
   ``--streams 1`` is the byte-identical pre-striping wire, so it doubles as
   the before/after baseline.
+* ``failover`` — executor-loss robustness under traffic: a 3-executor
+  loopback cluster with ``replication.factor = 1`` (seal pushes every round
+  to the ring neighbor), a reducer streaming -n blocks of -s bytes from the
+  primary.  Steady-state fetch GB/s first, then one pass where the primary
+  is killed at t=50% (testing/faults.kill_executor) and the reader fails
+  over to the replica holder.  Prints both GB/s, the recovery time (kill ->
+  first replica-served block), failovers, and p99 frame stall.
 * ``superstep`` — the TPU-only mode with no reference counterpart: time the
   collective exchange on the local mesh (what bench.py wraps).
 * ``pipeline`` — multi-round (spilled) shuffle throughput with host staging in
@@ -92,6 +99,7 @@ def _parse_args(argv):
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "wire", "ici",
+            "failover",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -379,6 +387,114 @@ def measure_wire(
     return results
 
 
+def measure_failover(
+    num_blocks: int = 8,
+    block_bytes: int = 4 << 20,
+    iterations: int = 3,
+    report=None,
+) -> dict:
+    """Measurement core of the ``failover`` mode — fetch throughput through
+    executor loss.
+
+    Three loopback executors with ``replication.factor = 1``: executor 1
+    stages ``num_blocks`` blocks of ``block_bytes`` and seals (the background
+    replicator pushes every round to ring neighbor 2); executor 0 streams the
+    set back with a failover-enabled reader.  Phase one measures steady-state
+    GB/s over ``iterations`` passes.  Phase two runs one more pass and kills
+    executor 1 after half the blocks have landed — the reader re-resolves the
+    rest to the replica holder.  Returns steady vs killed GB/s, recovery time
+    (kill -> first replica-served block), failover/retry counts, and the worst
+    lane's p99 frame stall.  ``report(phase, it, seconds, bytes)`` per pass.
+    Shared by the CLI and bench.py."""
+    from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+    from sparkucx_tpu.shuffle.resolver import ring_neighbors
+    from sparkucx_tpu.testing import faults
+
+    conf = TpuShuffleConf(
+        replication_factor=1,
+        wire_timeout_ms=10_000,
+        staging_capacity_per_executor=num_blocks * block_bytes + (1 << 20),
+    )
+    executors = [0, 1, 2]
+    ts = [PeerTransport(conf, executor_id=i) for i in executors]
+    addrs = [t.init() for t in ts]
+    for t in ts:
+        for j, a in enumerate(addrs):
+            if j != t.executor_id:
+                t.add_executor(j, a)
+    total = num_blocks * block_bytes
+    try:
+        rng = np.random.default_rng(0)
+        payload = rng.integers(0, 256, size=block_bytes, dtype=np.uint8).tobytes()
+        ts[1].store.create_shuffle(0, 1, num_blocks)
+        w = ts[1].store.map_writer(0, 0)
+        for r in range(num_blocks):
+            w.write_partition(r, payload)
+        w.commit()
+        ts[1].store.seal(0)
+        assert ts[1].replication_wait(0, timeout=60.0), "replication did not settle"
+
+        def make_reader():
+            return TpuShuffleReader(
+                ts[0],
+                executor_id=0,
+                shuffle_id=0,
+                start_partition=0,
+                end_partition=num_blocks,
+                num_mappers=1,
+                block_sizes=lambda m, r: block_bytes,
+                max_blocks_per_request=1,  # one window per block: the kill
+                sender_of=lambda m: 1,     # lands between windows, mid-stream
+                replica_of=lambda p: ring_neighbors(p, executors, 1),
+                fetch_retries=3,
+                fetch_deadline_ms=2000,
+                fetch_backoff_ms=10,
+            )
+
+        def consume(reader, kill_at=None):
+            """Drain the reader; returns (seconds, kill->next-block seconds)."""
+            n = 0
+            t_kill = recovery = None
+            t0 = time.perf_counter()
+            for blk in reader.fetch_blocks():
+                blk.release()
+                n += 1
+                if t_kill is not None and recovery is None:
+                    recovery = time.perf_counter() - t_kill
+                if n == kill_at:
+                    t_kill = time.perf_counter()
+                    faults.kill_executor(ts[1])
+            assert n == num_blocks
+            return time.perf_counter() - t0, recovery
+
+        consume(make_reader())  # warmup: connect (+ stripe handshake), page in
+        steady = 0.0
+        for it in range(iterations):
+            dt, _ = consume(make_reader())
+            steady = max(steady, total / dt / 1e9)
+            if report is not None:
+                report("steady", it, dt, total)
+        kill_reader = make_reader()
+        dt, recovery = consume(kill_reader, kill_at=max(1, num_blocks // 2))
+        if report is not None:
+            report("killed", 0, dt, total)
+        lanes = ts[0].wire_lane_stats()
+        return {
+            "steady_gbps": steady,
+            "killed_gbps": total / dt / 1e9,
+            "recovery_ms": (recovery or 0.0) * 1e3,
+            "failovers": kill_reader.metrics.failovers,
+            "blocks_retried": kill_reader.metrics.blocks_retried,
+            "fetch_timeouts": kill_reader.metrics.fetch_timeouts,
+            "rx_stall_p99_ms": max(
+                (s["rx_stall_p99_ns"] for s in lanes), default=0
+            ) / 1e6,
+        }
+    finally:
+        for t in ts:
+            t.close()
+
+
 def measure_pipeline(
     executors: int, round_bytes: int, rounds: int, iterations: int,
     depths=(1, 2, 3), report=None,
@@ -530,6 +646,29 @@ def run_wire(args) -> None:
             f"p99 frame stall {r['p99_frame_stall_ms']:.2f} ms{speedup}",
             flush=True,
         )
+
+
+def run_failover(args) -> None:
+    size = parse_size(args.block_size)
+
+    def report(phase, it, dt, tot):
+        print(
+            f"{phase} iter {it}: {args.num_blocks} x {size} B in "
+            f"{dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    r = measure_failover(args.num_blocks, size, args.iterations, report=report)
+    ratio = r["killed_gbps"] / max(r["steady_gbps"], 1e-9)
+    print(
+        f"failover: steady {r['steady_gbps']:.2f} GB/s, "
+        f"primary killed at t=50% {r['killed_gbps']:.2f} GB/s ({ratio:.2f}x), "
+        f"recovery {r['recovery_ms']:.1f} ms, "
+        f"{r['failovers']} failovers / {r['blocks_retried']} retried / "
+        f"{r['fetch_timeouts']} timeouts, "
+        f"p99 frame stall {r['rx_stall_p99_ms']:.2f} ms",
+        flush=True,
+    )
 
 
 def run_pipeline(args) -> None:
@@ -1558,6 +1697,8 @@ def main(argv=None) -> None:
         run_client(args)
     elif args.mode == "wire":
         run_wire(args)
+    elif args.mode == "failover":
+        run_failover(args)
     elif args.mode == "pipeline":
         run_pipeline(args)
     elif args.mode == "gather":

@@ -32,7 +32,7 @@ from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.transport import ExecutorId
 from sparkucx_tpu.memory.pool import MemoryPool
 from sparkucx_tpu.shuffle.reader import TpuShuffleReader, default_deserializer
-from sparkucx_tpu.shuffle.resolver import TpuShuffleBlockResolver
+from sparkucx_tpu.shuffle.resolver import TpuShuffleBlockResolver, ring_neighbors
 from sparkucx_tpu.shuffle.writer import TpuShuffleMapOutputWriter
 from sparkucx_tpu.transport.tpu import TpuShuffleCluster
 
@@ -119,6 +119,16 @@ class TpuShuffleManager:
             info = meta.mapper_infos.get(m)
             return info.partitions[r][1] if info is not None else 0
 
+        replica_of = None
+        if self.conf.replication_factor > 0:
+            # failover candidates derive from the same ring the replicator
+            # pushes to — no placement-metadata exchange needed
+            executors = list(range(self.cluster.num_executors))
+            factor = self.conf.replication_factor
+
+            def replica_of(primary):
+                return ring_neighbors(primary, executors, factor)
+
         return TpuShuffleReader(
             transport,
             executor_id,
@@ -134,6 +144,9 @@ class TpuShuffleManager:
             key_ordering=key_ordering,
             fetch_retries=self.conf.fetch_retries,
             credit_bytes=self.conf.wire_credit_bytes,
+            replica_of=replica_of,
+            fetch_deadline_ms=self.conf.fetch_deadline_ms,
+            fetch_backoff_ms=self.conf.fetch_backoff_ms,
             memory_budget=self.conf.reduce_memory_budget,
             spill_dir=self.conf.spill_dir,
             merge_combiners=merge_combiners,

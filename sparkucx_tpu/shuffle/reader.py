@@ -20,9 +20,10 @@ fetch_wait_time (UcxShuffleReader.scala:118-123,148-153).
 
 from __future__ import annotations
 
+import random
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -45,6 +46,10 @@ class ShuffleReadMetrics:
     blocks_retried: int = 0
     #: combine/sort runs spilled to disk (the ExternalSorter spill counter)
     spills: int = 0
+    #: blocks served by a replica executor after the primary died / hung
+    failovers: int = 0
+    #: fetch windows or retry attempts abandoned at the fetch deadline
+    fetch_timeouts: int = 0
 
 
 class BlockFetchResult:
@@ -190,6 +195,9 @@ class TpuShuffleReader:
         spill_dir: Optional[str] = None,
         merge_combiners: Optional[Callable[[Any, Any], Any]] = None,
         credit_bytes: int = 0,
+        replica_of: Optional[Callable[[ExecutorId], Sequence[ExecutorId]]] = None,
+        fetch_deadline_ms: int = 0,
+        fetch_backoff_ms: int = 50,
     ) -> None:
         self.transport = transport
         self.executor_id = executor_id
@@ -213,6 +221,20 @@ class TpuShuffleReader:
         #: the budget (``spark.shuffle.tpu.wire.creditBytes``); 0 = the
         #: historical strictly-serial window loop
         self.credit_bytes = max(0, credit_bytes)
+        #: primary executor -> its replica executors (replication-ring
+        #: successors; shuffle/resolver.ring_neighbors) — where a block is
+        #: re-resolved when the primary dies.  None/empty = no failover.
+        self.replica_of = replica_of
+        #: per-window (and per retry attempt) completion deadline; a window
+        #: that misses it is failed locally and enters the retry/failover path
+        #: instead of spinning forever on a hung peer.  0 = wait forever.
+        self.fetch_deadline_ms = max(0, fetch_deadline_ms)
+        #: base for the jittered, doubling backoff between retry attempts
+        self.fetch_backoff_ms = max(0, fetch_backoff_ms)
+        #: timed-out fetches whose result buffer may still be a recv-thread
+        #: scatter target — kept alive until their request completes, then
+        #: closed by _sweep_abandoned (single reader thread; no lock)
+        self._abandoned: List[Tuple[MemoryBlock, Request]] = []
         self.metrics = ShuffleReadMetrics()
 
     # -- raw block iterator ------------------------------------------------
@@ -248,6 +270,7 @@ class TpuShuffleReader:
             requests = self._issue_window(window)
             self._await_window(requests, len(window))
             yield from self._yield_window(requests)
+        self._sweep_abandoned()
 
     def _fetch_windows_pipelined(self, windows) -> Iterator[BlockFetchResult]:
         from collections import deque
@@ -275,9 +298,11 @@ class TpuShuffleReader:
                 yield from self._yield_window(requests)
             finally:
                 # credits return when the window is consumed (or the caller
-                # abandons the iterator / a fetch raises) — the gate drains
-                # to zero either way
+                # abandons the iterator / a fetch raises or times out) — the
+                # gate drains to zero either way, so one dead peer's windows
+                # can never wedge the pipeline's budget
                 gate.release(cost)
+        self._sweep_abandoned()
 
     def _issue_window(
         self, window: List[ShuffleBlockId]
@@ -303,12 +328,19 @@ class TpuShuffleReader:
 
     def _await_window(self, requests, num_blocks: int) -> None:
         t0 = time.monotonic_ns()
+        deadline_ns = self.fetch_deadline_ms * 1_000_000
         # wakeup park between polls when the transport supports it
         # (use_wakeup; GlobalWorkerRpcThread.scala:46-58) — a local fetch
         # completes on the first poll so the wait never fires there
         park = getattr(self.transport, "wait_for_activity", None)
         with span("read.window", shuffle_id=self.shuffle_id, blocks=num_blocks):
             while not all(req.completed() for _, _, req in requests):
+                if deadline_ns and time.monotonic_ns() - t0 > deadline_ns:
+                    # hung peer: stop spinning, let _yield_window fail the
+                    # incomplete fetches over to replicas — this bounds the
+                    # fetch_wait charge per window to the deadline
+                    self.metrics.fetch_timeouts += 1
+                    break
                 self.transport.progress()
                 if park is not None and not all(
                     req.completed() for _, _, req in requests
@@ -319,10 +351,19 @@ class TpuShuffleReader:
     def _yield_window(self, requests) -> Iterator[BlockFetchResult]:
         prev: Optional[BlockFetchResult] = None
         try:
+            self._sweep_abandoned()
             for bid, buf, req in requests:
-                result = req.wait(0)
-                if result.status != OperationStatus.SUCCESS:
-                    result = self._retry_fetch(bid, buf, result)
+                if not req.completed():
+                    # window hit its deadline with this fetch outstanding; the
+                    # recv thread may still scatter into buf, so quarantine it
+                    # (closed by a later sweep once the request settles) and
+                    # fail over with a fresh buffer
+                    self._abandoned.append((buf, req))
+                    result, buf = self._retry_fetch(bid, None, None)
+                else:
+                    result = req.wait(0)
+                    if result.status != OperationStatus.SUCCESS:
+                        result, buf = self._retry_fetch(bid, buf, result)
                 # Zero-copy hand-off: a read-only view of the recv bytes.
                 # The old `bytes(...)` here copied every fetched block a
                 # second time; now the copy happens only in detach(), and
@@ -344,40 +385,113 @@ class TpuShuffleReader:
             if prev is not None:
                 prev.detach()
 
-    def _retry_fetch(self, bid: ShuffleBlockId, buf: MemoryBlock, failed):
-        """Per-block pull-path retry — the straggler/failure escape hatch next
-        to the batch path.  The reference logs failed sends and gives up
-        (SURVEY.md section 5.3: "No retry, no re-fetch fallback"); here a failed
-        batch fetch falls back to ``transport.fetch_block`` (the per-block AM
-        ids 3/4 analogue) up to ``fetch_retries`` times before raising."""
-        last_error = failed.error
-        for _ in range(self.fetch_retries):
-            req = self.transport.fetch_block(
-                self.sender_of(bid.map_id), bid.shuffle_id, bid.map_id, bid.reduce_id, buf
-            )
-            t0 = time.monotonic_ns()
-            # same wakeup park as the batch window loop above — the retry path
-            # exists exactly for slow/straggling peers, where busy-spinning
-            # progress() would burn the GIL against the recv thread
-            park = getattr(self.transport, "wait_for_activity", None)
-            while not req.completed():
-                self.transport.progress()
-                if park is not None and not req.completed():
-                    park(0.002)
-            self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
-            result = req.wait(0)
-            if result.status == OperationStatus.SUCCESS:
-                self.metrics.blocks_retried += 1
-                instant(
-                    "fetch.retry",
-                    shuffle_id=bid.shuffle_id, map_id=bid.map_id, reduce_id=bid.reduce_id,
-                )
-                return result
-            last_error = result.error
-        buf.close()
+    def _alloc_buf(self, size: int) -> MemoryBlock:
+        if self.pool is not None:
+            return self.pool.get_many([size])[0]
+        return MemoryBlock(np.zeros(size, dtype=np.uint8), size=size)
+
+    def _sweep_abandoned(self) -> None:
+        """Close quarantined buffers whose requests have since settled; a
+        buffer whose request is still live may be a recv-scatter target and
+        must stay alive (bounded: one per timed-out fetch attempt)."""
+        still: List[Tuple[MemoryBlock, Request]] = []
+        for buf, req in self._abandoned:
+            if req.completed():
+                buf.close()
+            else:
+                still.append((buf, req))
+        self._abandoned = still
+
+    def _retry_fetch(self, bid: ShuffleBlockId, buf: Optional[MemoryBlock], failed):
+        """Per-block pull-path retry + replica failover — the straggler/failure
+        escape hatch next to the batch path.  The reference logs failed sends
+        and gives up (SURVEY.md section 5.3: "No retry, no re-fetch fallback");
+        here a failed/timed-out batch fetch falls back to
+        ``transport.fetch_block`` (the per-block AM ids 3/4 analogue), up to
+        ``fetch_retries`` attempts against the primary and then the same
+        against each replica executor (``replica_of``, the replication-ring
+        successors), with a jittered doubling backoff between attempts.  A
+        replica refetch must be deterministic — same bytes the primary staged
+        — so its size is asserted against the committed block length.
+
+        ``buf is None`` means the original buffer was quarantined (its request
+        never completed); each attempt then allocates a fresh buffer, and a
+        timed-out attempt quarantines its buffer too.  Returns
+        ``(result, buffer_holding_the_bytes)``."""
+        last_error = failed.error if failed is not None else "fetch deadline exceeded"
+        size = self.block_sizes(bid.map_id, bid.reduce_id)
+        primary = self.sender_of(bid.map_id)
+        candidates: List[ExecutorId] = [primary]
+        if self.replica_of is not None:
+            candidates += [e for e in self.replica_of(primary) if e != primary]
+        deadline_ns = self.fetch_deadline_ms * 1_000_000
+        # same wakeup park as the batch window loop above — the retry path
+        # exists exactly for slow/straggling peers, where busy-spinning
+        # progress() would burn the GIL against the recv thread
+        park = getattr(self.transport, "wait_for_activity", None)
+        attempt = 0
+        for executor in candidates:
+            for _ in range(self.fetch_retries):
+                if attempt > 0 and self.fetch_backoff_ms:
+                    base = (self.fetch_backoff_ms / 1000.0) * (2 ** min(attempt - 1, 6))
+                    time.sleep(random.uniform(base / 2.0, base))
+                attempt += 1
+                if buf is None:
+                    buf = self._alloc_buf(size)
+                try:
+                    req = self.transport.fetch_block(
+                        executor, bid.shuffle_id, bid.map_id, bid.reduce_id, buf
+                    )
+                except (TransportError, OSError) as e:
+                    last_error = e  # dead peer at connect time: next candidate
+                    continue
+                t0 = time.monotonic_ns()
+                timed_out = False
+                while not req.completed():
+                    if deadline_ns and time.monotonic_ns() - t0 > deadline_ns:
+                        timed_out = True
+                        break
+                    self.transport.progress()
+                    if park is not None and not req.completed():
+                        park(0.002)
+                self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
+                if timed_out:
+                    self.metrics.fetch_timeouts += 1
+                    self._abandoned.append((buf, req))
+                    buf = None  # never reuse a possibly-still-scattering buffer
+                    last_error = TransportError(
+                        f"fetch of {bid} from executor {executor} timed out "
+                        f"after {self.fetch_deadline_ms} ms"
+                    )
+                    continue
+                result = req.wait(0)
+                if result.status == OperationStatus.SUCCESS:
+                    if executor != primary:
+                        # deterministic-refetch contract: the replica serves
+                        # the exact bytes the primary staged, so the committed
+                        # length must match to the byte
+                        if int(result.stats.recv_size) != size:
+                            buf.close()
+                            raise TransportError(
+                                f"replica refetch of {bid} from executor "
+                                f"{executor} returned {result.stats.recv_size} B, "
+                                f"expected {size} B — replica diverges from primary"
+                            )
+                        self.metrics.failovers += 1
+                    self.metrics.blocks_retried += 1
+                    instant(
+                        "fetch.retry",
+                        shuffle_id=bid.shuffle_id, map_id=bid.map_id,
+                        reduce_id=bid.reduce_id, executor=executor,
+                        failover=executor != primary,
+                    )
+                    return result, buf
+                last_error = result.error
+        if buf is not None:
+            buf.close()
         raise TransportError(
-            f"fetch of {bid} failed after {self.fetch_retries} retr"
-            f"{'y' if self.fetch_retries == 1 else 'ies'}: {last_error}"
+            f"fetch of {bid} failed after {attempt} attempt"
+            f"{'' if attempt == 1 else 's'} across executors {candidates}: {last_error}"
         )
 
     # -- record pipeline ---------------------------------------------------

@@ -21,13 +21,31 @@ Responsibilities:
 from __future__ import annotations
 
 import threading
-from typing import Set
+from typing import List, Sequence, Set
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import Block, ShuffleBlockId
-from sparkucx_tpu.core.operation import TransportError
+from sparkucx_tpu.core.operation import BlockNotFoundError, TransportError
 from sparkucx_tpu.core.transport import ShuffleTransport
 from sparkucx_tpu.store.hbm_store import HbmBlockStore
+
+
+def ring_neighbors(executor_id, executors: Sequence, factor: int) -> List:
+    """The ``factor`` ring successors of ``executor_id`` in the sorted
+    executor ring — where this executor's sealed rounds are replicated
+    (``spark.shuffle.tpu.replication.factor``), and therefore where a reducer
+    re-resolves a block when its primary dies.  Shared by the replicator
+    (transport/peer.py) and the reader's failover path so both sides derive
+    the same placement from membership alone, with no placement-metadata
+    exchange (the redistribution-plan determinism of arXiv:2112.01075)."""
+    ring = sorted(set(executors))
+    if executor_id not in ring or len(ring) < 2 or factor <= 0:
+        return []
+    idx = ring.index(executor_id)
+    out = []
+    for k in range(1, min(factor, len(ring) - 1) + 1):
+        out.append(ring[(idx + k) % len(ring)])
+    return out
 
 
 class _StoreBackedBlock(Block):
@@ -82,15 +100,33 @@ class TpuShuffleBlockResolver:
         ``serve_from_store`` True -> read back through the staged store (the
         reference fetches back from the DPU); False -> same memory, but callers
         that bypass the store registry hit the registered Block instead
-        (UcxShuffleBlockResolver.scala:86-97 A/B)."""
+        (UcxShuffleBlockResolver.scala:86-97 A/B).
+
+        An unknown shuffle/map raises the typed, addressed
+        :class:`BlockNotFoundError` (never a bare KeyError), so callers can
+        tell "retryable: not yet committed / peer lost" from programming
+        errors."""
         if self.conf.serve_from_store:
-            return self.store.read_block(shuffle_id, map_id, reduce_id)
+            try:
+                return self.store.read_block(shuffle_id, map_id, reduce_id)
+            except BlockNotFoundError:
+                raise
+            except TransportError as e:
+                if "unknown shuffle" in str(e):
+                    raise BlockNotFoundError(shuffle_id, map_id, reduce_id, str(e)) from e
+                raise
         blk = None
         if hasattr(self.transport, "registered_block"):
             blk = self.transport.registered_block(ShuffleBlockId(shuffle_id, map_id, reduce_id))
         if blk is None:
-            raise TransportError(f"block ({shuffle_id},{map_id},{reduce_id}) not registered")
+            raise BlockNotFoundError(shuffle_id, map_id, reduce_id, "not registered")
         return blk.get_memory_block().to_bytes()
+
+    def replica_executors(self, primary_executor, executors: Sequence) -> List:
+        """Where a block whose primary executor died can be re-resolved: the
+        primary's replication-ring successors among ``executors`` (empty at
+        ``replication.factor = 0``)."""
+        return ring_neighbors(primary_executor, executors, self.conf.replication_factor)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         """removeShuffle -> unregister all the shuffle's blocks

@@ -39,13 +39,13 @@ from __future__ import annotations
 import threading
 from bisect import bisect_right
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.definitions import MapperInfo
-from sparkucx_tpu.core.operation import TransportError
+from sparkucx_tpu.core.operation import BlockNotFoundError, TransportError
 
 
 def default_peer_ranges(num_reducers: int, num_peers: int) -> List[Tuple[int, int]]:
@@ -67,6 +67,10 @@ class _BlockEntry:
     length: int  # true payload bytes
     padded: int  # bytes including alignment padding
     round: int = 0  # staging round (multi-round spill; round 0 = common case)
+    #: False for entries installed from a peer's MapperInfo — their offsets are
+    #: sender-relative, so the bytes live on the SENDER, not in local staging.
+    #: The replicator only pushes local entries.
+    local: bool = True
 
 
 class _ShuffleState:
@@ -365,6 +369,17 @@ class HbmBlockStore:
         #: the _gather_fn discipline (transport/tpu.py) applied to the write
         #: path, so varying-shape device rounds share a handful of compiles.
         self._scatter_cache: Dict[Tuple[int, int, int], object] = {}  #: guarded by self._lock
+        # -- neighbor-replication tier (REPLICA_PUT landing zone) ----------
+        #: (shuffle_id, src_executor) -> round -> ((map, reduce) -> (offset,
+        #: length) index, contiguous body array).  Bodies are whole replicated
+        #: rounds, so replica_view serves zero-copy like block_staging_view.
+        self._replicas: Dict[Tuple[int, int], Dict[int, Tuple[Dict[Tuple[int, int], Tuple[int, int]], np.ndarray]]] = {}  #: guarded by self._lock
+        self._replica_bytes = 0  #: guarded by self._lock
+        #: Post-seal hook (PeerTransport installs its replication push here).
+        #: Written once at transport construction, invoked by seal() AFTER the
+        #: store lock is released — implementations may call back into the
+        #: store freely.
+        self.on_seal: Optional[Callable[[int], None]] = None
 
     def _shm_staging(self, shuffle_id: int, nbytes: int):
         """Shared-memory staging for single-host zero-copy serving
@@ -425,10 +440,16 @@ class HbmBlockStore:
                 st.staging_closer()
             if st is not None:
                 self._release_spill(st)
+            for key in [k for k in self._replicas if k[0] == shuffle_id]:
+                for _index, arr in self._replicas[key].values():
+                    self._replica_bytes -= int(arr.size)
+                del self._replicas[key]
 
     def close(self) -> None:
         with self._lock:
             states, self._shuffles = list(self._shuffles.values()), {}
+            self._replicas.clear()
+            self._replica_bytes = 0
             for st in states:
                 if st.staging_closer is not None:
                     st.staging = None
@@ -621,7 +642,9 @@ class HbmBlockStore:
             for r, (off, ln) in enumerate(info.partitions):
                 if ln:
                     padded = -(-ln // st.alignment) * st.alignment
-                    st.blocks[(info.map_id, r)] = _BlockEntry(off, ln, padded, info.round_of(r))
+                    st.blocks[(info.map_id, r)] = _BlockEntry(
+                        off, ln, padded, info.round_of(r), local=False
+                    )
             st.committed_maps.add(info.map_id)
 
     # -- seal + exchange hand-off -----------------------------------------
@@ -673,6 +696,11 @@ class HbmBlockStore:
                     payload = jax.device_put(payload, self.device)
             out.append((payload, final_sizes))
             st.sealed_payload = [p for p, _ in out]
+        # Replication hook, outside the lock: the sealed rounds are now
+        # immutable, so the background replicator can snapshot them safely.
+        cb = self.on_seal
+        if cb is not None:
+            cb(shuffle_id)
         return out
 
     def num_rounds(self, shuffle_id: int) -> int:
@@ -741,10 +769,19 @@ class HbmBlockStore:
         that halves peak HBM), so post-exchange the HBM copy may be deleted;
         the host staging area is retained until ``remove_shuffle`` exactly so
         this read — the pull-fallback/retry path — keeps working."""
-        st = self._state(shuffle_id)
-        e = st.blocks.get((map_id, reduce_id))
+        with self._lock:
+            st = self._shuffles.get(shuffle_id)
+        e = st.blocks.get((map_id, reduce_id)) if st is not None else None
         if e is None:
-            raise TransportError(f"no block ({shuffle_id},{map_id},{reduce_id}) staged")
+            # Replica tier: a ring neighbor's pushed copy serves even for a
+            # shuffle this executor never created locally (failover serving).
+            replica = self.replica_view(shuffle_id, map_id, reduce_id)
+            if replica is not None:
+                arr, off, ln = replica
+                return arr[off : off + ln].tobytes()
+            if st is None:
+                raise TransportError(f"unknown shuffle {shuffle_id}")
+            raise BlockNotFoundError(shuffle_id, map_id, reduce_id, "not staged")
         if e.length == 0:
             return b""
         if st.sealed:
@@ -821,6 +858,102 @@ class HbmBlockStore:
             raise TransportError(f"no block ({shuffle_id},{map_id},{reduce_id}) staged")
         return e.offset
 
+    # -- neighbor-replication tier (REPLICA_PUT/failover serving) ----------
+
+    def replica_source(self, shuffle_id: int) -> List[Tuple[int, List[Tuple[int, int, int]], bytes]]:
+        """Snapshot this executor's sealed rounds for replication: one
+        ``(round, [(map, reduce, length)...], body bytes)`` per staging round,
+        body = the unpadded block payloads concatenated in table order.  Only
+        locally staged entries are included — entries installed from peers'
+        MapperInfo carry sender-relative offsets and no local bytes."""
+        st = self._state(shuffle_id)
+        out: List[Tuple[int, List[Tuple[int, int, int]], bytes]] = []
+        with self._lock:
+            for rnd in range(st.round + 1):
+                keys = sorted(
+                    k for k, e in st.blocks.items() if e.round == rnd and e.local
+                )
+                entries: List[Tuple[int, int, int]] = []
+                body = bytearray()
+                for m, r in keys:
+                    e = st.blocks[(m, r)]
+                    entries.append((m, r, e.length))
+                    if not e.length:
+                        continue
+                    if rnd < len(st.prev_rounds):
+                        staging = st.prev_rounds[rnd][0]
+                        body += staging[e.offset : e.offset + e.length].tobytes()
+                    elif st.device_mode:
+                        rows = st.device_blocks.get((m, r))
+                        if rows is None:
+                            raise TransportError(
+                                f"device block ({shuffle_id},{m},{r}) no longer "
+                                "resident — cannot replicate"
+                            )
+                        flat = np.asarray(rows).reshape(-1).view(np.uint8)
+                        body += flat[: e.length].tobytes()
+                    else:
+                        body += st.staging[e.offset : e.offset + e.length].tobytes()
+                if entries:
+                    out.append((rnd, entries, bytes(body)))
+        return out
+
+    def put_replica(
+        self,
+        shuffle_id: int,
+        src_executor: int,
+        round_idx: int,
+        entries: Sequence[Tuple[int, int, int]],
+        body,
+    ) -> None:
+        """Install one replicated round pushed by a ring neighbor.  ``body``
+        is the concatenated unpadded payloads in ``entries`` order; a repeated
+        put for the same (shuffle, src, round) replaces the old copy (the
+        replicator may re-push after a transient failure)."""
+        index: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        pos = 0
+        for m, r, ln in entries:
+            index[(m, r)] = (pos, ln)
+            pos += ln
+        if pos != len(body):
+            raise TransportError(
+                f"replica round (shuffle={shuffle_id}, src={src_executor}, "
+                f"round={round_idx}) table claims {pos} B but body is {len(body)} B"
+            )
+        arr = np.frombuffer(bytes(body), dtype=np.uint8) if len(body) else np.empty(0, dtype=np.uint8)
+        with self._lock:
+            rounds = self._replicas.setdefault((shuffle_id, src_executor), {})
+            old = rounds.get(round_idx)
+            if old is not None:
+                self._replica_bytes -= int(old[1].size)
+            rounds[round_idx] = (index, arr)
+            self._replica_bytes += int(arr.size)
+
+    def replica_view(
+        self, shuffle_id: int, map_id: int, reduce_id: int
+    ) -> Optional[Tuple[np.ndarray, int, int]]:
+        """Zero-copy serving handle into a replicated round — the failover
+        analogue of ``block_staging_view``.  None when no replica of the block
+        has landed (including: replication disabled, or still in flight)."""
+        with self._lock:
+            for (sid, _src), rounds in self._replicas.items():
+                if sid != shuffle_id:
+                    continue
+                for index, arr in rounds.values():
+                    hit = index.get((map_id, reduce_id))
+                    if hit is not None:
+                        return arr, hit[0], hit[1]
+        return None
+
+    def replica_stats(self) -> Dict[str, int]:
+        """Replica-tier accounting across all shuffles."""
+        with self._lock:
+            return {
+                "replica_bytes": self._replica_bytes,
+                "replica_rounds": sum(len(r) for r in self._replicas.values()),
+                "replica_sources": len(self._replicas),
+            }
+
     # -- introspection -----------------------------------------------------
 
     def stats(self, shuffle_id: int) -> Dict[str, object]:
@@ -837,7 +970,15 @@ class HbmBlockStore:
             occupancy.append((u, int(used.size) * slot_rows - u))
         u = int(st.region_used.sum()) // st.alignment
         occupancy.append((u, int(st.region_used.size) * slot_rows - u))
+        with self._lock:
+            replica_bytes = sum(
+                int(arr.size)
+                for (sid, _src), rounds in self._replicas.items()
+                if sid == shuffle_id
+                for _index, arr in rounds.values()
+            )
         return {
+            "replica_bytes": replica_bytes,
             "num_blocks": len(st.blocks),
             "bytes_staged": int(sum(e.length for e in st.blocks.values())),
             "bytes_padded": int(sum(e.padded for e in st.blocks.values())),

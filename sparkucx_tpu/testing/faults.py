@@ -1,0 +1,222 @@
+"""Fault-injection harness: named failure points for chaos testing.
+
+Production code calls ``faults.check("point", **ctx)`` (may raise or stall)
+and ``faults.transform("point", data, **ctx)`` (may corrupt bytes) at named
+points.  With nothing armed — the production state — both are a module
+attribute read plus a falsy branch; no locks, no dict lookups.
+
+Tests arm faults with :func:`arm` and an action built by the factories below
+(:func:`sever`, :func:`stall`, :func:`garble`, :func:`delay`, :func:`fail`),
+optionally scoped to a context match and a finite fire count, and clean up
+with :func:`reset` (or the :func:`injected_faults` context manager, which
+resets on exit even when the test body raises).
+
+Named points currently instrumented (transport/peer.py):
+
+====================  ==========================================================
+peer.client.recv      top of a client lane's recv loop, before each frame
+                      (ctx: ``peer``, ``lane``)
+peer.client.frame     transform hook over each received client frame header
+                      (ctx: ``peer``, ``lane``) — garbling it kills the lane
+peer.server.frame     server dispatch, after each decoded frame
+                      (ctx: ``peer``, ``am_id``)
+replica.push          replicator thread, before pushing a sealed shuffle
+                      (ctx: ``shuffle_id``, ``executor``)
+replica.apply         server side, before installing a received replica round
+                      (ctx: ``shuffle_id``, ``src_executor``, ``round_idx``)
+====================  ==========================================================
+
+:func:`kill_executor` force-kills a loopback-cluster executor: its server
+socket, accepted connections, and outbound client connections all die
+abruptly (peers observe EOF/reset, never a goodbye) — the in-process stand-in
+for SIGKILLing an executor process mid-superstep.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+#: Fast-path flag: every check/transform hook bails immediately when False.
+#: Written only under _lock; read racily by hooks (benign — worst case one
+#: extra locked lookup around an arm/reset edge).
+active = False
+
+_lock = threading.Lock()
+
+
+@dataclass
+class _Armed:
+    point: str
+    action: Callable[..., Any]
+    times: Optional[int] = None  # remaining fires; None = unlimited
+    match: Optional[Dict[str, Any]] = None  # ctx subset that must match
+    fired: int = 0
+
+
+_armed: List[_Armed] = []  #: guarded by _lock
+#: total fires per point (telemetry for tests); guarded by _lock
+fired: Dict[str, int] = {}
+
+
+def arm(
+    point: str,
+    action: Callable[..., Any],
+    *,
+    times: Optional[int] = None,
+    match: Optional[Dict[str, Any]] = None,
+) -> _Armed:
+    """Arm ``action`` at ``point``.  ``times`` bounds how often it fires;
+    ``match`` restricts it to calls whose context contains the given items."""
+    global active
+    entry = _Armed(point, action, times, match)
+    with _lock:
+        _armed.append(entry)
+        active = True
+    return entry
+
+
+def disarm(entry: _Armed) -> None:
+    global active
+    with _lock:
+        if entry in _armed:
+            _armed.remove(entry)
+        active = bool(_armed)
+
+
+def reset() -> None:
+    """Disarm everything and clear telemetry."""
+    global active
+    with _lock:
+        _armed.clear()
+        fired.clear()
+        active = False
+
+
+@contextlib.contextmanager
+def injected_faults(*arms):
+    """``with injected_faults((point, action), ...):`` — resets on exit even
+    when the body raises, so one chaotic test cannot poison the next."""
+    entries = [arm(point, action) for point, action in arms]
+    try:
+        yield entries
+    finally:
+        reset()
+
+
+def _select(point: str, ctx: Dict[str, Any]) -> List[_Armed]:
+    out = []
+    for entry in _armed:
+        if entry.point != point:
+            continue
+        if entry.times is not None and entry.fired >= entry.times:
+            continue
+        if entry.match and any(ctx.get(k) != v for k, v in entry.match.items()):
+            continue
+        out.append(entry)
+    return out
+
+
+def check(point: str, **ctx) -> None:
+    """Fire any armed action at ``point``.  Actions may raise (sever), sleep
+    (stall/delay), or no-op; their return value is ignored."""
+    if not active:
+        return
+    with _lock:
+        hits = _select(point, ctx)
+        for entry in hits:
+            entry.fired += 1
+        if hits:
+            fired[point] = fired.get(point, 0) + len(hits)
+    for entry in hits:  # run actions outside the lock: they may sleep
+        entry.action(point=point, **ctx)
+
+
+def transform(point: str, data, **ctx):
+    """Pass ``data`` through any armed transform at ``point``; actions return
+    the (possibly corrupted) replacement."""
+    if not active:
+        return data
+    with _lock:
+        hits = _select(point, ctx)
+        for entry in hits:
+            entry.fired += 1
+        if hits:
+            fired[point] = fired.get(point, 0) + len(hits)
+    for entry in hits:
+        data = entry.action(data, point=point, **ctx)
+    return data
+
+
+# -- action factories ------------------------------------------------------
+
+
+def sever(message: str = "fault injected: connection severed"):
+    """check-action: raise ConnectionResetError, as if the peer RST the lane."""
+
+    def _act(**_ctx):
+        raise ConnectionResetError(message)
+
+    return _act
+
+
+def stall(seconds: float):
+    """check-action: hang the calling thread, as if the peer stopped sending
+    mid-frame (long enough past ``wire.timeoutMs`` and the timeout fires)."""
+
+    def _act(**_ctx):
+        time.sleep(seconds)
+
+    return _act
+
+
+#: Replication-delay alias — same behavior, clearer chaos-test intent.
+delay = stall
+
+
+def garble(xor: int = 0xFF):
+    """transform-action: corrupt every byte (XOR) of the passing data."""
+
+    def _act(data, **_ctx):
+        out = bytearray(data)
+        for i in range(len(out)):
+            out[i] ^= xor
+        return out
+
+    return _act
+
+
+def fail(exc: BaseException):
+    """check-action: raise an arbitrary prepared exception."""
+
+    def _act(**_ctx):
+        raise exc
+
+    return _act
+
+
+# -- executor chaos --------------------------------------------------------
+
+
+def kill_executor(transport) -> None:
+    """Abruptly kill a loopback-cluster executor (a ``PeerTransport``).
+
+    Closes the listen socket, every accepted serving connection, and every
+    outbound client connection with no goodbye — peers see EOF/ECONNRESET
+    exactly as if the executor process died.  The transport object itself is
+    left unusable (fetches through it fail), matching a dead process.
+    """
+    server = getattr(transport, "server", None)
+    if server is not None:
+        server.close()
+    conn_lock = getattr(transport, "_conn_lock", None)
+    if conn_lock is not None:
+        with conn_lock:
+            conns = list(transport._conns.values()) + list(transport._zombies)
+            transport._conns.clear()
+            transport._zombies = []
+        for c in conns:
+            c.close()

@@ -44,9 +44,13 @@ from sparkucx_tpu.core.definitions import (
     pack_chunk_hdr,
     pack_frame,
     pack_frame_prefix,
+    pack_replica_ack,
+    pack_replica_put,
     pack_wire_hello,
     unpack_chunk_hdr,
     unpack_frame_header,
+    unpack_replica_ack,
+    unpack_replica_put,
     unpack_wire_hello,
 )
 from sparkucx_tpu.core.operation import (
@@ -59,6 +63,7 @@ from sparkucx_tpu.core.operation import (
 )
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.store.hbm_store import HbmBlockStore
+from sparkucx_tpu.testing import faults
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.stats import StatsAggregator
 
@@ -101,34 +106,59 @@ def apply_wire_sockopts(
                 pass
 
 
-def recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
+def _peername(sock: socket.socket) -> str:
+    """Best-effort ``host:port`` of the remote end, for error messages."""
+    try:
+        name = sock.getpeername()
+        return f"{name[0]}:{name[1]}"
+    except (OSError, AttributeError, IndexError, TypeError):
+        return "?"
+
+
+def recv_exact(
+    sock: socket.socket, n: int, *, idle_ok: bool = False, peer: str = ""
+) -> Optional[bytearray]:
     """Receive exactly ``n`` bytes into ONE preallocated buffer.
 
     ``recv_into`` a sliding memoryview of a single bytearray: the historical
     implementation collected per-``recv`` bytes chunks and paid a second full
     copy joining them.  Returns ``None`` on EOF.  A bytearray is accepted
     everywhere the old bytes was (struct unpacking, json, ``np.frombuffer``,
-    ``bytes + bytearray`` concatenation)."""
+    ``bytes + bytearray`` concatenation).
+
+    When the socket carries a timeout (``conf.wire_timeout_ms``), a read that
+    times out with part of the buffer already received means the peer hung
+    mid-frame: raise an addressed OSError.  With ``idle_ok`` (the wait for the
+    NEXT frame header), a timeout with zero bytes received is a quiet
+    connection, not a fault — keep waiting."""
     out = bytearray(n)
     mv = memoryview(out)
     got = 0
     while got < n:
-        r = sock.recv_into(mv[got:], n - got)
+        try:
+            r = sock.recv_into(mv[got:], n - got)
+        except socket.timeout:
+            if idle_ok and got == 0:
+                continue
+            raise OSError(
+                f"peer {peer or _peername(sock)} hung mid-frame: read timed out "
+                f"with {got}/{n} B received"
+            ) from None
         if r == 0:
             return None
         got += r
     return out
 
 
-def recv_frame(sock: socket.socket) -> Optional[Tuple[AmId, bytes, bytes]]:
-    hdr = recv_exact(sock, FRAME_HEADER_SIZE)
+def recv_frame(sock: socket.socket, peer: str = "") -> Optional[Tuple[AmId, bytes, bytes]]:
+    hdr = recv_exact(sock, FRAME_HEADER_SIZE, idle_ok=True, peer=peer)
     if hdr is None:
         return None
     am_id, hlen, blen = unpack_frame_header(hdr)
     if hlen + blen > _MAX_FRAME:
-        raise ValueError("frame too large")
-    header = recv_exact(sock, hlen) if hlen else b""
-    body = recv_exact(sock, blen) if blen else b""
+        raise ValueError(f"frame too large from peer {peer or _peername(sock)}")
+    header = recv_exact(sock, hlen, peer=peer) if hlen else b""
+    body = recv_exact(sock, blen, peer=peer) if blen else b""
     if (hlen and header is None) or (blen and body is None):
         return None
     return am_id, header, body
@@ -317,6 +347,10 @@ class BlockServer:
                 conn, _ = self._srv.accept()
                 # deep send window default: one reply batch is tens of MiB
                 apply_wire_sockopts(conn, self.conf, sndbuf=4 << 20)
+                # mid-frame reads (and stuck sends) may not hang forever; idle
+                # header waits are exempt inside recv_exact(idle_ok=True)
+                if self.conf.wire_timeout_ms:
+                    conn.settimeout(self.conf.wire_timeout_ms / 1000.0)
             except OSError:
                 return
             with self._accepted_lock:
@@ -346,6 +380,15 @@ class BlockServer:
                 # reply path then sends it without a second copy
                 return mb.host_view(), 0, int(mb.size)
         if self.store is not None:
+            # Replica tier BEFORE staging: apply_mapper_info installs entries
+            # for maps this executor does NOT hold into the local block table
+            # with sender-relative offsets, so block_staging_view on a
+            # non-owner would happily serve garbage bytes for a remote map.
+            # Replica keys are exactly those remote maps (ownership partitions
+            # maps across executors), so they must win the lookup.
+            view = self.store.replica_view(bid.shuffle_id, bid.map_id, bid.reduce_id)
+            if view is not None:
+                return view
             try:
                 return self.store.block_staging_view(
                     bid.shuffle_id, bid.map_id, bid.reduce_id
@@ -462,6 +505,7 @@ class BlockServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         use_sendmsg = hasattr(conn, "sendmsg")
+        peer = _peername(conn)
         # shared with this lane's stripe sender thread so control acks and
         # chunk frames interleave only at frame granularity
         send_lock = threading.Lock()
@@ -469,10 +513,11 @@ class BlockServer:
         lane = -1
         try:
             while self._running:
-                frame = recv_frame(conn)
+                frame = recv_frame(conn, peer=peer)
                 if frame is None:
                     return
                 am_id, header, body = frame
+                faults.check("peer.server.frame", peer=peer, am_id=int(am_id))
                 if am_id == AmId.FETCH_BLOCK_REQ:
                     tag, bids = unpack_batch_fetch_req(header)
                     if self._io is not None:
@@ -515,6 +560,17 @@ class BlockServer:
                             self.store.apply_mapper_info(info)
                         except TransportError:
                             pass  # shuffle not created on this server yet
+                elif am_id == AmId.REPLICA_PUT:
+                    sid, src, rnd, entries = unpack_replica_put(header)
+                    faults.check(
+                        "replica.apply", shuffle_id=sid, src_executor=src, round_idx=rnd
+                    )
+                    if self.store is not None:
+                        self.store.put_replica(sid, src, rnd, entries, body)
+                    with send_lock:
+                        conn.sendall(
+                            pack_frame(AmId.REPLICA_ACK, pack_replica_ack(sid, src, rnd))
+                        )
                 elif am_id == AmId.INIT_EXECUTOR_REQ:
                     (eid,) = _TAG.unpack_from(header)
                     self.handshaken[eid] = body
@@ -586,7 +642,19 @@ class _PeerConnection:
         chunk_done: Optional[Callable[[int, int, bool], Optional[bytes]]] = None,
         manifest_sink: Optional[Callable[[bytes], Optional[bytes]]] = None,
     ) -> None:
-        self.sock = socket.create_connection(address, timeout=30)
+        #: host:port of the server end — every raised error names it
+        self.peer = f"{address[0]}:{address[1]}"
+        timeout_ms = conf.wire_timeout_ms if conf is not None else 30000
+        self._timeout_s: Optional[float] = (timeout_ms / 1000.0) if timeout_ms else None
+        try:
+            self.sock = socket.create_connection(address, timeout=self._timeout_s or 30)
+        except socket.timeout:
+            raise OSError(f"connect to peer {self.peer} timed out after {timeout_ms} ms") from None
+        # the connect timeout persists as the socket timeout: mid-frame reads
+        # and stuck sends fail after wire_timeout_ms instead of hanging; the
+        # idle wait for the next frame header is exempt (idle_ok below).
+        # wire_timeout_ms = 0 clears it — the historical block-forever wire.
+        self.sock.settimeout(self._timeout_s)
         # deep recv window default keeps the scatter recv fed between polls
         apply_wire_sockopts(self.sock, conf, rcvbuf=4 << 20)
         self.pending: Dict[int, Callable[[bytes, bytes], None]] = {}
@@ -617,12 +685,23 @@ class _PeerConnection:
 
     # -- counted zero-copy receive primitives (recv thread only) -----------
 
-    def _recv_exact(self, n: int) -> Optional[bytearray]:
+    def _recv_exact(self, n: int, idle_ok: bool = False) -> Optional[bytearray]:
         out = bytearray(n)
         mv = memoryview(out)
         got = 0
         while got < n:
-            r = self.sock.recv_into(mv[got:], n - got)
+            try:
+                r = self.sock.recv_into(mv[got:], n - got)
+            except socket.timeout:
+                # idle between frames is normal; hung MID-frame is a fault
+                if idle_ok and got == 0:
+                    if not self.alive:
+                        return None
+                    continue
+                raise OSError(
+                    f"peer {self.peer} (lane {self.lane}) hung mid-frame: read "
+                    f"timed out with {got}/{n} B received"
+                ) from None
             if r == 0:
                 return None
             got += r
@@ -630,13 +709,20 @@ class _PeerConnection:
             self.rx_syscalls += 1
         return out
 
-    def _recv_into(self, mv: memoryview) -> None:
+    def _recv_into(self, mv: memoryview, what: str = "") -> None:
         """recv_into a caller-owned destination until full — the zero-copy
-        scatter receive (no staging allocation, no join copy)."""
+        scatter receive (no staging allocation, no join copy).  ``what``
+        carries block context (tag/block id) into any raised error."""
         while mv.nbytes:
-            n = self.sock.recv_into(mv, mv.nbytes)
+            try:
+                n = self.sock.recv_into(mv, mv.nbytes)
+            except socket.timeout:
+                raise OSError(
+                    f"peer {self.peer} (lane {self.lane}) hung mid-body{what}: "
+                    f"read timed out with {mv.nbytes} B still expected"
+                ) from None
             if n == 0:
-                raise OSError("peer closed mid-body")
+                raise OSError(f"peer {self.peer} (lane {self.lane}) closed mid-body{what}")
             self.rx_bytes += n
             self.rx_syscalls += 1
             mv = mv[n:]
@@ -668,10 +754,13 @@ class _PeerConnection:
                 continue
             view = bufs[i].host_view() if bufs[i] is not None else None
             if view is not None and size <= view.size:
-                self._recv_into(memoryview(view)[:size])
+                self._recv_into(memoryview(view)[:size], what=f" (fetch tag {tag}, block {i})")
             else:  # oversized/unknown: drain and let progress() report failure
                 if self._recv_exact(size) is None:
-                    raise OSError("peer closed mid-body")
+                    raise OSError(
+                        f"peer {self.peer} (lane {self.lane}) closed mid-body "
+                        f"(fetch tag {tag}, block {i})"
+                    )
         return True
 
     def _park(self, am_id: AmId, header: bytes, body: bytes, scattered: bool) -> None:
@@ -693,10 +782,15 @@ class _PeerConnection:
         ok = False
         try:
             if mv is not None:
-                self._recv_into(mv)
+                self._recv_into(
+                    mv, what=f" (fetch tag {tag}, block {block}, chunk offset {offset})"
+                )
             elif blen:  # unknown tag / oversized target: drain off the wire
                 if self._recv_exact(blen) is None:
-                    raise OSError("peer closed mid-chunk")
+                    raise OSError(
+                        f"peer {self.peer} (lane {self.lane}) closed mid-chunk "
+                        f"(fetch tag {tag}, block {block})"
+                    )
             ok = True
         finally:
             # the done callback must run even when the socket dies mid-chunk:
@@ -708,16 +802,18 @@ class _PeerConnection:
     def _recv_loop(self) -> None:
         try:
             while self.alive:
+                faults.check("peer.client.recv", peer=self.peer, lane=self.lane)
                 t0 = time.monotonic_ns()
-                hdr = self._recv_exact(FRAME_HEADER_SIZE)
+                hdr = self._recv_exact(FRAME_HEADER_SIZE, idle_ok=True)
                 stall = time.monotonic_ns() - t0
                 self.rx_stall_ns += stall
                 self.stall_samples.append(stall)
                 if hdr is None:
                     break
+                hdr = faults.transform("peer.client.frame", hdr, peer=self.peer, lane=self.lane)
                 am_id, hlen, blen = unpack_frame_header(hdr)
                 if hlen + blen > _MAX_FRAME:
-                    raise ValueError("frame too large")
+                    raise ValueError(f"frame too large from peer {self.peer}")
                 header = self._recv_exact(hlen) if hlen else b""
                 if hlen and header is None:
                     break
@@ -793,6 +889,10 @@ class _StripeGroup:
     def __init__(self, group_id: int, lanes: List[_PeerConnection]) -> None:
         self.group_id = group_id
         self.lanes = lanes
+
+    @property
+    def peer(self) -> str:
+        return self.lanes[0].peer if self.lanes else "?"
 
     @property
     def alive(self) -> bool:
@@ -888,11 +988,26 @@ class PeerTransport(ShuffleTransport):
         #: striped-receive progress per in-flight tag (striped groups only)
         self._stripe_rx: Dict[int, _StripeRx] = {}  #: guarded by self._tag_lock
         self._zombies: List[_PeerConnection] = []  #: guarded by self._conn_lock (evicted, not yet drained)
+        # -- neighbor replication (client side of REPLICA_PUT/REPLICA_ACK) --
+        #: outstanding REPLICA_ACKs per shuffle this executor pushed
+        self._replica_pending: Dict[int, int] = {}  #: guarded by self._tag_lock
+        #: shuffles whose replicator thread is still enumerating/sending
+        self._replica_pushing: set = set()  #: guarded by self._tag_lock
+        #: replication telemetry: rounds/bytes pushed, acks seen, failed sends
+        self.replica_stats: Dict[str, int] = {
+            "pushed_rounds": 0,
+            "pushed_bytes": 0,
+            "acks": 0,
+            "failed": 0,
+        }  #: guarded by self._tag_lock
         self.stats_agg = StatsAggregator() if self.conf.collect_stats else None
         #: Wakeup doorbell (conf.use_wakeup): recv threads set it when an ack
         #: parks, so fetch loops can sleep in wait_for_activity() instead of
         #: busy-spinning progress() against the receiver's GIL slices.
         self._activity = threading.Event()
+        # asynchronous neighbor replication: seal() hands the sealed shuffle
+        # to a background push thread (no frames at replication_factor = 0)
+        self.store.on_seal = self._on_store_seal
 
     def _ack_buffers(self, tag: int) -> Optional[list]:
         """Recv-thread lookup: the batch's result buffers, WITHOUT popping the
@@ -1299,9 +1414,12 @@ class PeerTransport(ShuffleTransport):
             for tag, _ in doomed:
                 del self._inflight[tag]
                 self._stripe_rx.pop(tag, None)
-        for tag, (reqs, bufs, cbs, _) in doomed:
-            logger.warning("connection lost with %d in-flight request(s)", len(reqs))
-            err = TransportError("peer connection lost")
+        for tag, (reqs, bufs, cbs, conn) in doomed:
+            peer = getattr(conn, "peer", "?")
+            logger.warning(
+                "connection to peer %s lost with %d in-flight request(s)", peer, len(reqs)
+            )
+            err = TransportError(f"peer connection lost ({peer}, fetch tag {tag})")
             for req, buf, cb in zip(reqs, bufs, cbs):
                 if req.completed():
                     continue
@@ -1338,6 +1456,14 @@ class PeerTransport(ShuffleTransport):
 
     def _handle_frame(self, frame: Tuple[AmId, bytes, bytes, bool]) -> None:
         am_id, header, body, scattered = frame
+        if am_id == AmId.REPLICA_ACK:
+            try:
+                sid, src, _rnd = unpack_replica_ack(header)
+            except struct.error:
+                return
+            if src == self.executor_id:
+                self._replica_acked(sid)
+            return
         if am_id != AmId.FETCH_BLOCK_REQ_ACK:
             return
         if len(header) < _TAG.size + _COUNT.size:
@@ -1471,6 +1597,93 @@ class PeerTransport(ShuffleTransport):
                 pass
         if callback is not None:
             callback(OperationResult(OperationStatus.SUCCESS))
+
+    # -- asynchronous neighbor replication --------------------------------
+
+    def replication_neighbors(self) -> List[ExecutorId]:
+        """The ``replication_factor`` ring successors of this executor among
+        the known cluster members (self + every added peer), sorted-id ring —
+        the redistribution-plan placement of arXiv:2112.01075 degenerated to
+        nearest ICI neighbors."""
+        from sparkucx_tpu.shuffle.resolver import ring_neighbors
+
+        with self._conn_lock:
+            peers = list(self._conn_addrs)
+        return ring_neighbors(
+            self.executor_id, [self.executor_id] + peers, self.conf.replication_factor
+        )
+
+    def _on_store_seal(self, shuffle_id: int) -> None:
+        """Store seal hook: launch the background replica push (never blocks
+        the sealing caller; the map-side superstep proceeds immediately)."""
+        if self.conf.replication_factor <= 0:
+            return
+        with self._tag_lock:
+            self._replica_pushing.add(shuffle_id)
+        threading.Thread(
+            target=self._replicate_push,
+            args=(shuffle_id,),
+            daemon=True,
+            name=f"replicator-{self.executor_id}-{shuffle_id}",
+        ).start()
+
+    def _replicate_push(self, shuffle_id: int) -> None:
+        try:
+            faults.check("replica.push", shuffle_id=shuffle_id, executor=self.executor_id)
+            neighbors = self.replication_neighbors()
+            rounds = self.store.replica_source(shuffle_id) if neighbors else []
+            with self._tag_lock:
+                self._replica_pending[shuffle_id] = (
+                    self._replica_pending.get(shuffle_id, 0) + len(neighbors) * len(rounds)
+                )
+            for eid in neighbors:
+                for rnd, entries, body in rounds:
+                    frame = pack_frame(
+                        AmId.REPLICA_PUT,
+                        pack_replica_put(shuffle_id, self.executor_id, rnd, entries),
+                        body,
+                    )
+                    try:
+                        self._connection(eid).send(frame)
+                        with self._tag_lock:
+                            self.replica_stats["pushed_rounds"] += 1
+                            self.replica_stats["pushed_bytes"] += len(body)
+                    except (TransportError, OSError) as e:
+                        logger.warning(
+                            "replication of shuffle %d round %d to executor %s failed: %s",
+                            shuffle_id, rnd, eid, e,
+                        )
+                        self._replica_acked(shuffle_id, failed=True)
+        except Exception:
+            logger.exception("replicator for shuffle %d died", shuffle_id)
+        finally:
+            with self._tag_lock:
+                self._replica_pushing.discard(shuffle_id)
+            self._activity.set()
+
+    def _replica_acked(self, shuffle_id: int, failed: bool = False) -> None:
+        with self._tag_lock:
+            left = self._replica_pending.get(shuffle_id, 0) - 1
+            self._replica_pending[shuffle_id] = max(0, left)
+            self.replica_stats["failed" if failed else "acks"] += 1
+
+    def replication_wait(self, shuffle_id: int, timeout: float = 10.0) -> bool:
+        """Pump progress until every replica push for ``shuffle_id`` is acked
+        (or failed-and-accounted).  True = replication settled.  Tests and
+        graceful shutdown use this; the data path never has to."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._tag_lock:
+                settled = (
+                    shuffle_id not in self._replica_pushing
+                    and self._replica_pending.get(shuffle_id, 0) == 0
+                )
+            if settled:
+                return True
+            if time.monotonic() > deadline:
+                return False
+            self.progress()
+            self.wait_for_activity(0.005)
 
     def fetch_block(
         self,

@@ -53,6 +53,17 @@ def test_superstep_mode(capsys):
     assert out.count("GB/s") == 2
 
 
+def test_failover_mode(capsys):
+    # executor-loss sub-metric: steady vs primary-killed-at-50% loopback fetch
+    benchmark.run_failover(
+        benchmark._parse_args(["failover", "-n", "4", "-s", "128k", "-i", "1"])
+    )
+    out = capsys.readouterr().out
+    assert "failover: steady" in out
+    assert "recovery" in out
+    assert "failovers" in out
+
+
 def test_cli_flags_match_reference():
     # -a/-f/-n/-s/-i/-o/-r/-t (UcxPerfBenchmark.scala:41-59)
     args = benchmark._parse_args(

@@ -616,3 +616,162 @@ class TestPooledRxRelease:
         with pytest.raises(ValueError):
             pool.get_many([64, 0])
         pool.close()
+
+
+# ---------------------------------------------------------------------------
+# wire timeouts (spark.shuffle.tpu.wire.timeoutMs) — stalled peers die at the
+# deadline instead of blocking a lane forever; idle connections are exempt
+# ---------------------------------------------------------------------------
+
+
+class TestWireTimeouts:
+    def test_server_times_out_hung_midframe_client(self):
+        """A client that stalls mid-frame-header is cut loose at the timeout
+        (strict mid-frame read); an idle client that sent nothing is not."""
+        srv = BlockServer(TpuShuffleConf(wire_timeout_ms=200))
+        try:
+            idle = socket.create_connection(srv.address, timeout=10)
+            hung = socket.create_connection(srv.address, timeout=10)
+            hung.sendall(b"\x01\x00\x00")  # 3 of 20 header bytes, then silence
+            hung.settimeout(5)
+            assert hung.recv(1) == b""  # server closed the hung conn
+            hung.close()
+            # the idle conn (zero bytes sent) must still be alive and serving
+            time.sleep(0.3)  # well past wire_timeout_ms
+            idle.sendall(
+                pack_frame(AmId.FETCH_BLOCK_REQ, pack_batch_fetch_req(5, [ShuffleBlockId(0, 0, 0)]))
+            )
+            hdr = recv_exact(idle, FRAME_HEADER_SIZE)
+            assert hdr is not None  # got a reply: conn survived idling
+            idle.close()
+        finally:
+            srv.close()
+
+    def test_client_times_out_midbody_with_addressed_error(self):
+        """A server that stalls mid-ack-body fails the fetch at the client's
+        timeout, and the error names the peer address and fetch tag."""
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        addr = lst.getsockname()
+
+        def stalling_server():
+            conn, _ = lst.accept()
+            hdr = recv_exact(conn, FRAME_HEADER_SIZE)
+            _, hlen, blen = unpack_frame_header(hdr)
+            req_hdr = recv_exact(conn, hlen + blen)
+            tag = _TAG.unpack_from(req_hdr)[0]
+            # ack claims a 1000 B body but only 100 B ever arrive
+            ack_hdr = _TAG.pack(tag) + _COUNT.pack(1) + _SIZE.pack(1000)
+            conn.sendall(
+                struct.pack("<IQQ", int(AmId.FETCH_BLOCK_REQ_ACK), len(ack_hdr), 1000)
+                + ack_hdr
+                + b"\x55" * 100
+            )
+            time.sleep(3)  # hold the socket open, never send the rest
+            conn.close()
+
+        t = threading.Thread(target=stalling_server, daemon=True)
+        t.start()
+        a = PeerTransport(TpuShuffleConf(wire_timeout_ms=200), executor_id=1)
+        try:
+            a.add_executor(9, f"{addr[0]}:{addr[1]}".encode())
+            buf = _buf(1000)
+            t0 = time.monotonic()
+            [req] = a.fetch_blocks_by_block_ids(9, [ShuffleBlockId(0, 0, 0)], [buf], [None])
+            _drive(a, [req], timeout=10)
+            res = req.wait(1)
+            assert res.status == OperationStatus.FAILURE
+            assert "127.0.0.1" in str(res.error)  # peer named, not a bare reset
+            assert time.monotonic() - t0 < 2.5  # timeout fired, no 3 s stall
+        finally:
+            a.close()
+            lst.close()
+            t.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# chaos on the striped wire (fault harness): reset mid-fetch, stalled lane
+# ---------------------------------------------------------------------------
+
+
+class TestChaosLanes:
+    @pytest.fixture(autouse=True)
+    def _clean_faults(self):
+        from sparkucx_tpu.testing import faults
+
+        faults.reset()
+        yield
+        faults.reset()
+
+    def test_midfetch_reset_recovers_without_data_loss(self):
+        """Severing the serving connection mid-fetch (connection reset) kills
+        a lane of the stripe group; the reader's retry reforms the group (or
+        falls back to a fresh connection) and every byte still arrives."""
+        from sparkucx_tpu.testing import faults
+
+        payloads = [bytes([i]) * (1 << 16) for i in range(6)]
+        a, b = _pair(streams=4, chunk_bytes=8192)
+        try:
+            for i, p in enumerate(payloads):
+                b.register(ShuffleBlockId(0, i, 0), BytesBlock(p))
+            faults.arm(
+                "peer.server.frame",
+                faults.sever("reset mid-fetch"),
+                times=1,
+                match={"am_id": int(AmId.FETCH_BLOCK_REQ)},
+            )
+            reader = TpuShuffleReader(
+                a, 1, 0, 0, 1, len(payloads),
+                block_sizes=lambda m, r: len(payloads[m]),
+                max_blocks_per_request=2,
+                sender_of=lambda m: 2,
+                fetch_retries=3,
+                fetch_backoff_ms=5,
+            )
+            got = [bytes(blk.data) for blk in reader.fetch_blocks()]
+            assert got == payloads  # no data loss through the reset
+            assert faults.fired.get("peer.server.frame") == 1  # it DID fire
+            assert reader.metrics.blocks_retried >= 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_stalled_lane_times_out_then_retry_succeeds(self):
+        """A lane that stalls forever (peer alive but wedged) trips the fetch
+        deadline; the reader abandons the window and the retry refetches every
+        byte.  Pins timeout-driven failover, not just reset-driven."""
+        from sparkucx_tpu.testing import faults
+
+        payloads = [b"stall-me" * 512, b"ok" * 300]
+        a, b = _pair(streams=1, wire_timeout_ms=10_000)
+        try:
+            for i, p in enumerate(payloads):
+                b.register(ShuffleBlockId(0, i, 0), BytesBlock(p))
+            # wedge the server for the first fetch request only: the client
+            # sees silence (not EOF), so only the deadline can save the window
+            # the serve thread is wedged 1 s; retries starve on the same conn
+            # until it wakes, so the retry budget (4 x 400 ms) must outlast it
+            faults.arm(
+                "peer.server.frame",
+                faults.stall(1.0),
+                times=1,
+                match={"am_id": int(AmId.FETCH_BLOCK_REQ)},
+            )
+            reader = TpuShuffleReader(
+                a, 1, 0, 0, 1, len(payloads),
+                block_sizes=lambda m, r: len(payloads[m]),
+                max_blocks_per_request=len(payloads),
+                sender_of=lambda m: 2,
+                fetch_retries=3,
+                fetch_deadline_ms=400,
+                fetch_backoff_ms=5,
+            )
+            t0 = time.monotonic()
+            got = [bytes(blk.data) for blk in reader.fetch_blocks()]
+            assert got == payloads
+            assert reader.metrics.fetch_timeouts >= 1  # deadline actually fired
+            assert time.monotonic() - t0 < 8  # bounded, not wedged
+        finally:
+            a.close()
+            b.close()
