@@ -499,6 +499,31 @@ def main():
         except Exception as e:
             RESULT["pipeline_error"] = f"{type(e).__name__}: {e}"[:200]
         try:
+            # Elastic recovery: full-mesh exchange GB/s vs one pass with an
+            # executor killed mid-superstep — the cluster shrinks to the
+            # surviving pow2 bucket, restages the dead executor's rounds from
+            # ring-successor replicas, and re-runs in degraded waves (output
+            # asserted bit-identical inside the measurement).  The headline is
+            # recovery_ms and the degraded/steady throughput ratio.
+            if budget_left() < 90:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            import jax
+
+            n_el = min(4, jax.device_count())
+            if n_el < 2:
+                raise RuntimeError("skipped: elastic recovery needs >= 2 devices")
+            from sparkucx_tpu.perf.benchmark import measure_elastic
+
+            el = measure_elastic(n_el, 8 << 10, REPEATS)
+            RESULT["elastic"] = {
+                "steady_gbps": round(el["steady_gbps"], 3),
+                "degraded_gbps": round(el["degraded_gbps"], 3),
+                "recovery_ms": round(el["recovery_ms"], 1),
+                "mesh": f"{n_el}->{el['degraded_mesh']}",
+            }
+        except Exception as e:
+            RESULT["elastic_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
             # Map-output staging: host byte path (memcpy into host staging +
             # seal's H2D) vs the device staging path (write_partition_device +
             # block-scatter kernel, seal returns the HBM payload directly).

@@ -49,6 +49,12 @@ from __future__ import annotations
 #:   it slices on-device via jnp), guarded by ``isinstance(p, jax.Array)``;
 #:   same documented mixed-mode D2H cost, same scope.
 #:
+#: - tpu.py ``_recover_and_rerun``: the degraded-mode recovery path (elastic
+#:   mesh, reached from ``_run_exchange`` only after an executor died).  It
+#:   deliberately materializes restaged replica rounds and degraded-wave
+#:   results host-side: recovery is an abort-and-rerun cold path measured in
+#:   hundreds of ms, not a pipeline lane — blocking there is the design.
+#:
 #: - testing/faults.py ``kill_executor``: the chaos harness's whole job is to
 #:   kill an executor the way SIGKILL would — yanking the live connection
 #:   cache (``._conns``/``._zombies``) out from under the transport is the
@@ -76,6 +82,7 @@ ALLOWLIST = {
     ("transport/spmd.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit'"),
     ("transport/tpu.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit' (via '_assemble')"),
     ("transport/tpu.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit_quota'"),
+    ("transport/tpu.py", "host-sync", "(via '_recover_and_rerun')"),
     ("store/hbm_store.py", "cache-hygiene", "'out_rows'"),
 }
 

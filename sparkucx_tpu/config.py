@@ -130,6 +130,33 @@ class TpuShuffleConf:
     #: sleep is jittered uniformly in [base/2, base] and doubles per attempt
     #: (bounded exponential backoff, decorrelated across reducers).
     fetch_backoff_ms: int = 50
+    #: Per-chunk CRC32C on striped-wire chunk frames and REPLICA_PUT frames.
+    #: The 4-byte checksum rides as a header extension, detected by header
+    #: length on the receiving side, so mixed-config peers interoperate.  A
+    #: mismatch raises a typed BlockCorruptError that enters the reducer's
+    #: retry/failover path — corruption becomes a detected, recovered fault
+    #: instead of silent bad bytes.  Default off: frames stay byte-identical
+    #: to the golden captures the CI wire gate pins.
+    wire_checksum: bool = False
+    #: Elastic mesh recovery (transport/tpu.py): when an executor dies
+    #: mid-exchange, abort the in-flight round, shrink the mesh to the
+    #: surviving pow2 bucket, restage the dead executor's rounds from its
+    #: ring-successor's replica tier, and re-run the round deterministically
+    #: (bit-identical at replication_factor >= 1).  Default off: loss raises
+    #: a typed ExecutorLostError naming the dead executor (no hang) and
+    #: nothing about membership is tracked or sent on the wire.
+    elastic: bool = False
+    #: How long (ms) a peer wire error must stand before the membership layer
+    #: marks the executor suspect.  0 marks suspect immediately on the first
+    #: addressed wire error (the loopback-test-friendly default behavior when
+    #: elasticity is on).
+    membership_suspect_after_ms: int = 0
+    #: Byte bound on the replicator's pending-push backlog per executor: when
+    #: a stalled ring successor lets un-acked snapshot pushes accumulate past
+    #: this budget, the OLDEST un-pushed snapshot is dropped (drop-oldest-
+    #: unsealed policy; counted in replica_stats["dropped_rounds"]) so memory
+    #: stays bounded.  0 = unbounded (the historical behavior).
+    replication_max_backlog_bytes: int = 0
 
     # staged store (HBM; NVKV analogue).  512 = one exchange row (128 int32
     # lanes, the native XLA:TPU tile width) and exactly NVKV's sector alignment
@@ -299,8 +326,12 @@ class TpuShuffleConf:
             ("wire.sockBufBytes", "wire_sock_buf_bytes", parse_size),
             ("wire.timeoutMs", "wire_timeout_ms", int),
             ("replication.factor", "replication_factor", int),
+            ("replication.maxBacklogBytes", "replication_max_backlog_bytes", parse_size),
             ("fetch.deadlineMs", "fetch_deadline_ms", int),
             ("fetch.backoffMs", "fetch_backoff_ms", int),
+            ("wire.checksum", "wire_checksum", lambda v: str(v).lower() == "true"),
+            ("elastic.enabled", "elastic", lambda v: str(v).lower() == "true"),
+            ("membership.suspectAfterMs", "membership_suspect_after_ms", int),
             ("blockAlignment", "block_alignment", parse_size),
             ("stagingCapacity", "staging_capacity_per_executor", parse_size),
             ("storePort", "store_port", int),
@@ -372,6 +403,10 @@ class TpuShuffleConf:
             raise ValueError("fetch_deadline_ms must be >= 0 (0 = no deadline)")
         if self.fetch_backoff_ms < 0:
             raise ValueError("fetch_backoff_ms must be >= 0")
+        if self.membership_suspect_after_ms < 0:
+            raise ValueError("membership_suspect_after_ms must be >= 0")
+        if self.replication_max_backlog_bytes < 0:
+            raise ValueError("replication_max_backlog_bytes must be >= 0 (0 = unbounded)")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

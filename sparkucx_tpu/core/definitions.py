@@ -17,6 +17,10 @@ WireHello              6  striped-wire lane handshake: (group, lane, nlanes,
 ReplicaPut             7  neighbor replication: one sealed round's host snapshot
                           {shuffle, srcExecutor, round, (map,reduce,len)*N} + body
 ReplicaAck             8  replication ack: echoes (shuffle, srcExecutor, round)
+MemberSuspect          9  membership: (epoch, executor, observer) — the observer
+                          saw a wire error / timeout naming this executor
+MemberRejoin          10  membership: (epoch, executor, observer) — the executor
+                          came back; the full mesh returns next shuffle epoch
 ====================  ==  =======================================================
 
 Ids 5-6 extend the reference schema for the striped zero-copy wire path: a
@@ -54,6 +58,8 @@ class AmId(enum.IntEnum):
     WIRE_HELLO = 6
     REPLICA_PUT = 7
     REPLICA_ACK = 8
+    MEMBER_SUSPECT = 9
+    MEMBER_REJOIN = 10
 
 
 _FRAME = struct.Struct("<IQQ")
@@ -126,6 +132,8 @@ def unpack_wire_hello(data) -> Tuple[int, int, int, int]:
 #: order.  ReplicaAck reuses the prefix with num_blocks = 0 and no body.
 _REPLICA_HDR = struct.Struct("<iiiI")
 _REPLICA_ENT = struct.Struct("<iiq")
+REPLICA_HEADER_SIZE = _REPLICA_HDR.size
+REPLICA_ENTRY_SIZE = _REPLICA_ENT.size
 
 
 def pack_replica_put(
@@ -155,6 +163,22 @@ def pack_replica_ack(shuffle_id: int, src_executor: int, round_idx: int) -> byte
 def unpack_replica_ack(data) -> Tuple[int, int, int]:
     sid, src, rnd, _ = _REPLICA_HDR.unpack_from(data)
     return sid, src, rnd
+
+
+#: Membership frame header (MemberSuspect / MemberRejoin): the observer's
+#: membership epoch AFTER applying the event, the subject executor, and the
+#: observing executor.  Bodyless — membership is metadata, never payload.
+#: Receivers apply the event to their local membership view; epoch is
+#: advisory (views converge by union of suspects, not by epoch ordering).
+_MEMBER_HDR = struct.Struct("<Qii")
+
+
+def pack_member_event(epoch: int, executor_id: int, observer_id: int) -> bytes:
+    return _MEMBER_HDR.pack(epoch, executor_id, observer_id)
+
+
+def unpack_member_event(data) -> Tuple[int, int, int]:
+    return _MEMBER_HDR.unpack_from(data)
 
 
 @dataclass(frozen=True)

@@ -52,6 +52,41 @@ class BlockNotFoundError(TransportError):
         super().__init__(msg)
 
 
+class BlockCorruptError(TransportError):
+    """A block's wire payload failed its integrity check (wire.checksum).
+
+    Typed + addressed like BlockNotFoundError so the reducer's failover path
+    can treat "bytes arrived but are wrong" exactly like "peer died": retry
+    against the next candidate executor instead of propagating garbage.
+    """
+
+    def __init__(self, shuffle_id: int, map_id: int, reduce_id: int, detail: str = "") -> None:
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.reduce_id = reduce_id
+        msg = f"block (shuffle={shuffle_id}, map={map_id}, reduce={reduce_id}) failed checksum"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class ExecutorLostError(TransportError):
+    """An executor died while an exchange depended on it and no recovery path
+    exists (elasticity off, replication factor 0, or an unsupported exchange
+    configuration).  Typed + addressed — names the lost executor and the
+    membership epoch — so drivers can tell "re-run after repair" apart from
+    programming errors, and so the no-hang guarantee is testable.
+    """
+
+    def __init__(self, executor_id: int, epoch: int = 0, detail: str = "") -> None:
+        self.executor_id = executor_id
+        self.epoch = epoch
+        msg = f"executor {executor_id} lost (membership epoch {epoch})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 @dataclass
 class OperationStats:
     """Per-operation timing/size stats (ShuffleTransport.scala:64-69).

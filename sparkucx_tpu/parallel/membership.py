@@ -1,0 +1,131 @@
+"""Cluster membership: the liveness/epoch layer under elastic meshes.
+
+The collective plane (transport/tpu.py, transport/spmd.py) compiles for a
+fixed executor count; the wire plane (transport/peer.py) already survives
+executor loss via neighbor replication + reducer failover (PR 7).  This module
+is the piece that connects them: a tiny membership table that turns addressed
+wire errors, ``wire.timeoutMs`` trips, and the chaos harness's
+``kill_executor`` into *epoch bumps* the exchange can observe — abort the
+in-flight round, shrink to the surviving pow2 bucket, restage from replicas,
+re-run (see ``TpuShuffleCluster._run_exchange``).
+
+Design notes:
+
+* **Observation-driven, not heartbeat-driven.**  Failures are detected where
+  the reference detects them — at the wire (``UcxShuffleTransport`` evicts a
+  connection on send failure) — and propagated as ``MemberSuspect`` frames on
+  the peer plane.  There is no background failure detector thread; a silent
+  executor that nobody talks to is, by definition, not blocking anyone.
+* **Epochs are local, convergence is by union.**  Every mark_dead/mark_alive
+  bumps the local epoch.  Views converge because suspects are broadcast and
+  re-applying a known fact is a no-op (no epoch bump, no re-broadcast storm).
+* **Suspicion can be debounced** (``membership.suspectAfterMs``): the first
+  wire error records a pending suspicion; only an error that persists past the
+  window marks the executor dead.  0 (default) trusts the first addressed
+  error — wire errors here are already post-retry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+class ClusterMembership:
+    """Liveness + epoch table over a fixed executor id space.
+
+    The id space (``executors``) never changes — elasticity shrinks the set of
+    *alive* ids, never renumbers.  Thread-safe; every mutation that changes
+    the alive set bumps ``epoch``, which is what the exchange snapshots before
+    a round and re-checks after (a changed epoch means the round's plan is
+    stale).
+    """
+
+    def __init__(self, executors: Sequence[int], suspect_after_ms: int = 0) -> None:
+        self._executors = sorted(int(e) for e in executors)
+        self._alive = set(self._executors)  #: guarded by self._lock
+        self._dead: Dict[int, str] = {}  #: guarded by self._lock
+        #: executor -> monotonic ns of first un-expired suspicion (debounce)
+        self._suspects: Dict[int, int] = {}  #: guarded by self._lock
+        self._suspect_after_ns = max(0, int(suspect_after_ms)) * 1_000_000
+        self._epoch = 0  #: guarded by self._lock
+        self._lock = threading.Lock()
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return bool(self._dead)
+
+    def is_alive(self, executor_id: int) -> bool:
+        with self._lock:
+            return executor_id in self._alive
+
+    def alive(self) -> List[int]:
+        with self._lock:
+            return sorted(self._alive)
+
+    def dead(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._dead)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "alive": sorted(self._alive),
+                "dead": dict(self._dead),
+            }
+
+    # -- transitions -------------------------------------------------------
+
+    def suspect(self, executor_id: int, reason: str) -> bool:
+        """Record a failure observation.  Returns True when the observation
+        newly killed the executor (first error with no debounce window, or an
+        error that persisted past ``suspectAfterMs``); False when absorbed
+        (unknown id, already dead, or still inside the debounce window)."""
+        if executor_id not in self._executors:
+            return False
+        if self._suspect_after_ns:
+            now = time.monotonic_ns()
+            with self._lock:
+                if executor_id not in self._alive:
+                    return False
+                first = self._suspects.setdefault(executor_id, now)
+                if now - first < self._suspect_after_ns:
+                    return False
+        return self.mark_dead(executor_id, reason)
+
+    def mark_dead(self, executor_id: int, reason: str) -> bool:
+        """Declare an executor dead.  Returns True if this changed the alive
+        set (and bumped the epoch); False for unknown/already-dead ids."""
+        with self._lock:
+            if executor_id not in self._alive:
+                return False
+            self._alive.discard(executor_id)
+            self._dead[executor_id] = reason
+            self._suspects.pop(executor_id, None)
+            self._epoch += 1
+            return True
+
+    def mark_alive(self, executor_id: int) -> bool:
+        """Rejoin: restore an executor to the alive set.  Returns True if it
+        was dead (epoch bumped — the full mesh returns at the next shuffle
+        epoch); False for unknown/already-alive ids."""
+        if executor_id not in self._executors:
+            return False
+        with self._lock:
+            if executor_id in self._alive:
+                return False
+            self._alive.add(executor_id)
+            self._dead.pop(executor_id, None)
+            self._suspects.pop(executor_id, None)
+            self._epoch += 1
+            return True

@@ -107,6 +107,18 @@ def executor_mesh(
     return Mesh(np.array(devs[:num_executors]), (axis_name,))
 
 
+def surviving_submesh(mesh: Mesh, phys: Sequence[int], axis_name: Optional[str] = None) -> Mesh:
+    """The shrunk mesh for degraded-mode recovery (elastic.enabled): the
+    devices of the surviving executor slots ``phys`` (already the pow2 bucket
+    chosen by ``shuffle.resolver.degraded_plan``), in the full mesh's ICI
+    order.  Preserving the parent's device order keeps surviving neighbors
+    ICI-adjacent — the shrunk ring is a sub-ring of the full ring, so no
+    re-ordering (and no new topology probe) is needed."""
+    flat = list(mesh.devices.reshape(-1))
+    devs = [flat[p] for p in phys]
+    return Mesh(np.array(devs), (axis_name or mesh.axis_names[0],))
+
+
 def executor_for_device(mesh: Mesh, device) -> int:
     flat = list(mesh.devices.reshape(-1))
     return flat.index(device)

@@ -38,6 +38,14 @@ Modes:
   is killed at t=50% (testing/faults.kill_executor) and the reader fails
   over to the replica holder.  Prints both GB/s, the recovery time (kill ->
   first replica-served block), failovers, and p99 frame stall.
+* ``elastic`` — degraded-mode exchange recovery under chaos: an
+  ``--executors``-wide loopback cluster with ``elastic.enabled`` and
+  ``replication.factor = 1`` runs multi-round shuffles of -s-byte blocks.
+  Steady-state full-mesh exchange GB/s first, then one pass where an
+  executor is killed MID-SUPERSTEP — the cluster shrinks to the surviving
+  pow2 bucket, restages the dead executor's rounds from ring-successor
+  replicas, and re-runs in degraded waves (output asserted byte-identical).
+  Prints both GB/s, the recovery time, and the shrunk mesh shape.
 * ``superstep`` — the TPU-only mode with no reference counterpart: time the
   collective exchange on the local mesh (what bench.py wraps).
 * ``pipeline`` — multi-round (spilled) shuffle throughput with host staging in
@@ -99,7 +107,7 @@ def _parse_args(argv):
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "wire", "ici",
-            "failover",
+            "failover", "elastic",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -495,6 +503,119 @@ def measure_failover(
             t.close()
 
 
+def measure_elastic(
+    num_executors: int = 4,
+    block_bytes: int = 8 << 10,
+    iterations: int = 3,
+    report=None,
+) -> dict:
+    """Measurement core of the ``elastic`` mode — collective-exchange
+    throughput through an executor death with degraded-mode recovery.
+
+    A ``num_executors``-wide loopback cluster with ``elastic.enabled`` and
+    ``replication.factor = 1`` runs 3n x 2n shuffles whose staging budget
+    forces multiple collective rounds.  Phase one measures steady-state
+    full-mesh exchange GB/s over ``iterations`` fresh shuffles.  Phase two
+    stages one more shuffle and kills an executor mid-superstep (the
+    ``exchange.submit`` chaos hook): the cluster shrinks to the surviving
+    pow2 bucket, restages the dead executor's rounds from its ring
+    successor's replicas, and re-runs in degraded waves — output asserted
+    byte-identical to the staged payloads.  Returns steady vs shrink-recover
+    GB/s plus the recovery telemetry from ``TpuShuffleCluster.elastic_stats``.
+    ``report(phase, it, seconds, bytes)`` per pass.  Shared by the CLI and
+    bench.py."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    from sparkucx_tpu.testing import faults
+    from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+    n = num_executors
+    M, R = 3 * n, 2 * n
+    align = 512
+    padded = -(-block_bytes // align) * align
+    total = M * R * block_bytes
+
+    def mk_cluster():
+        conf = TpuShuffleConf(
+            num_executors=n,
+            elastic=True,
+            replication_factor=1,
+            block_alignment=align,
+            # ~2 maps per staging round: the shuffle spans several collective
+            # rounds, so the kill lands mid-superstep with rounds left both
+            # to restage from replicas and to re-run on the shrunk mesh
+            staging_capacity_per_executor=2 * R * padded,
+        )
+        return TpuShuffleCluster(conf, num_executors=n)
+
+    def run_once(cluster, shuffle_id, kill=None, verify=False):
+        meta = cluster.create_shuffle(shuffle_id, M, R)
+        rng = np.random.default_rng(shuffle_id)
+        oracle = {}
+        for m in range(M):
+            t = cluster.transport(meta.map_owner[m])
+            w = t.store.map_writer(shuffle_id, m)
+            for r in range(R):
+                payload = rng.integers(
+                    0, 256, size=block_bytes, dtype=np.uint8
+                ).tobytes()
+                if verify:
+                    oracle[(m, r)] = payload
+                w.write_partition(r, payload)
+            t.commit_block(w.commit().pack())
+        if kill is not None:
+            def die(**_ctx):
+                faults.kill_executor(cluster.transport(kill))
+
+            faults.arm("exchange.submit", die, times=1, match={"round": 1})
+        try:
+            t0 = time.perf_counter()
+            cluster.run_exchange(shuffle_id)
+            dt = time.perf_counter() - t0
+        finally:
+            faults.reset()
+        for (m, r), want in oracle.items():
+            consumer = meta.owner_of_reduce(r)
+            view, length = cluster.locate_received_block(consumer, shuffle_id, m, r)
+            assert bytes(view[:length]) == want, "recovered block diverged"
+        return dt
+
+    steady = 0.0
+    cluster = mk_cluster()
+    try:
+        run_once(cluster, 0)  # warmup: compile the full-mesh exchange
+        for it in range(iterations):
+            dt = run_once(cluster, it + 1)
+            steady = max(steady, total / dt / 1e9)
+            if report is not None:
+                report("steady", it, dt, total)
+    finally:
+        for t in cluster.transports:
+            t.close()
+    cluster = mk_cluster()
+    try:
+        # kill the highest executor id: the survivors are the contiguous pow2
+        # prefix, the common shrink shape (any id recovers identically)
+        dt = run_once(cluster, 0, kill=n - 1, verify=True)
+        if report is not None:
+            report("shrink", 0, dt, total)
+        stats = dict(cluster.elastic_stats)
+    finally:
+        for t in cluster.transports:
+            t.close()
+    m_deg, phys = stats["degraded_mesh"] or (0, ())
+    return {
+        "steady_gbps": steady,
+        "degraded_gbps": total / dt / 1e9,
+        "recovery_ms": stats["last_recovery_ms"],
+        "recoveries": stats["recoveries"],
+        "epoch": stats["last_epoch"],
+        "degraded_mesh": m_deg,
+        "survivors": tuple(phys),
+    }
+
+
 def measure_pipeline(
     executors: int, round_bytes: int, rounds: int, iterations: int,
     depths=(1, 2, 3), report=None,
@@ -667,6 +788,30 @@ def run_failover(args) -> None:
         f"{r['failovers']} failovers / {r['blocks_retried']} retried / "
         f"{r['fetch_timeouts']} timeouts, "
         f"p99 frame stall {r['rx_stall_p99_ms']:.2f} ms",
+        flush=True,
+    )
+
+
+def run_elastic(args) -> None:
+    size = parse_size(args.block_size)
+    n = args.executors if args.executors > 1 else 4
+
+    def report(phase, it, dt, tot):
+        print(
+            f"{phase} iter {it}: {3*n}x{2*n} x {size} B in "
+            f"{dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    r = measure_elastic(n, size, args.iterations, report=report)
+    ratio = r["degraded_gbps"] / max(r["steady_gbps"], 1e-9)
+    print(
+        f"elastic: steady {r['steady_gbps']:.2f} GB/s, "
+        f"killed mid-superstep {r['degraded_gbps']:.2f} GB/s ({ratio:.2f}x), "
+        f"recovery {r['recovery_ms']:.1f} ms "
+        f"(epoch {r['epoch']}, mesh {n} -> {r['degraded_mesh']} "
+        f"on {list(r['survivors'])}), "
+        f"{r['recoveries']} recoveries, bit-identical asserted",
         flush=True,
     )
 
@@ -1699,6 +1844,8 @@ def main(argv=None) -> None:
         run_wire(args)
     elif args.mode == "failover":
         run_failover(args)
+    elif args.mode == "elastic":
+        run_elastic(args)
     elif args.mode == "pipeline":
         run_pipeline(args)
     elif args.mode == "gather":

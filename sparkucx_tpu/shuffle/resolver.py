@@ -21,7 +21,7 @@ Responsibilities:
 from __future__ import annotations
 
 import threading
-from typing import List, Sequence, Set
+from typing import List, Sequence, Set, Tuple
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import Block, ShuffleBlockId
@@ -46,6 +46,29 @@ def ring_neighbors(executor_id, executors: Sequence, factor: int) -> List:
     for k in range(1, min(factor, len(ring) - 1) + 1):
         out.append(ring[(idx + k) % len(ring)])
     return out
+
+
+def degraded_plan(num_executors: int, alive: Sequence) -> Tuple[int, List, int]:
+    """Deterministic placement of an ``num_executors``-wide exchange onto the
+    surviving executors: ``(m, phys, waves)`` where ``m`` is the pow2 floor of
+    the survivor count, ``phys`` the first ``m`` survivors in sorted order
+    (the shrunk mesh, one chip each), and ``waves = ceil(n / m)`` the number
+    of sub-exchange passes.  Logical executor ``l`` is processed in wave
+    ``l // m`` on physical slot ``l % m`` — contiguous waves, so each wave's
+    receiver regions are contiguous slices of every sender's staging.
+
+    Shared by the exchange re-planner (transport/tpu.py) and anything that
+    must agree on where a lost executor's work landed, so — like
+    ``ring_neighbors`` — every party derives the same placement from
+    membership alone (the redistribution-scheduling determinism of
+    arXiv:2112.01075 applied to replica->staging placement)."""
+    survivors = sorted(set(alive))
+    if not survivors:
+        raise TransportError("no surviving executors to plan a degraded exchange on")
+    m = 1 << (len(survivors).bit_length() - 1)  # pow2 floor
+    phys = survivors[:m]
+    waves = -(-num_executors // m)
+    return m, phys, waves
 
 
 class _StoreBackedBlock(Block):

@@ -945,6 +945,24 @@ class HbmBlockStore:
                         return arr, hit[0], hit[1]
         return None
 
+    def replica_block(
+        self, shuffle_id: int, src_executor: int, map_id: int, reduce_id: int
+    ) -> Optional[bytes]:
+        """The replicated bytes of one block FROM A NAMED SOURCE executor —
+        the restage path's accessor (elastic recovery rebuilds a dead
+        executor's staging from its ring-successor's replica tier, and must
+        not accidentally serve a same-keyed block replicated from a different
+        source).  None when no replica of (src, block) landed here."""
+        with self._lock:
+            rounds = self._replicas.get((shuffle_id, src_executor))
+            if not rounds:
+                return None
+            for index, arr in rounds.values():
+                hit = index.get((map_id, reduce_id))
+                if hit is not None:
+                    return arr[hit[0] : hit[0] + hit[1]].tobytes()
+        return None
+
     def replica_stats(self) -> Dict[str, int]:
         """Replica-tier accounting across all shuffles."""
         with self._lock:

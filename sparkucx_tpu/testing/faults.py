@@ -20,10 +20,18 @@ peer.client.frame     transform hook over each received client frame header
                       (ctx: ``peer``, ``lane``) — garbling it kills the lane
 peer.server.frame     server dispatch, after each decoded frame
                       (ctx: ``peer``, ``am_id``)
+peer.server.chunk     transform hook over each striped chunk's payload, after
+                      its crc trailer is computed (ctx: ``tag``, ``block``) —
+                      garbling it models in-flight corruption the client-side
+                      ``wire.checksum`` verify must catch
+
 replica.push          replicator thread, before pushing a sealed shuffle
                       (ctx: ``shuffle_id``, ``executor``)
 replica.apply         server side, before installing a received replica round
                       (ctx: ``shuffle_id``, ``src_executor``, ``round_idx``)
+exchange.submit       collective plane (transport/tpu.py), before each round's
+                      submit (ctx: ``shuffle_id``, ``round``) — the hook that
+                      lets chaos tests kill an executor mid-superstep
 ====================  ==========================================================
 
 :func:`kill_executor` force-kills a loopback-cluster executor: its server
@@ -208,7 +216,15 @@ def kill_executor(transport) -> None:
     outbound client connection with no goodbye — peers see EOF/ECONNRESET
     exactly as if the executor process died.  The transport object itself is
     left unusable (fetches through it fail), matching a dead process.
+
+    Transports that model in-process executors (``TpuShuffleTransport``)
+    expose a ``chaos_kill`` hook instead of sockets: it closes the executor's
+    store and reports the death to cluster membership, so the collective
+    plane observes the loss the same way the wire plane observes a RST.
     """
+    chaos_kill = getattr(transport, "chaos_kill", None)
+    if chaos_kill is not None:
+        chaos_kill()
     server = getattr(transport, "server", None)
     if server is not None:
         server.close()

@@ -37,23 +37,29 @@ import numpy as np
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import Block, BlockId, MemoryBlock, ShuffleBlockId
 from sparkucx_tpu.core.definitions import (
+    CHUNK_HEADER_SIZE,
     FRAME_HEADER_SIZE,
     MAX_FRAME_BYTES,
+    REPLICA_ENTRY_SIZE,
+    REPLICA_HEADER_SIZE,
     AmId,
     MapperInfo,
     pack_chunk_hdr,
     pack_frame,
     pack_frame_prefix,
+    pack_member_event,
     pack_replica_ack,
     pack_replica_put,
     pack_wire_hello,
     unpack_chunk_hdr,
     unpack_frame_header,
+    unpack_member_event,
     unpack_replica_ack,
     unpack_replica_put,
     unpack_wire_hello,
 )
 from sparkucx_tpu.core.operation import (
+    BlockCorruptError,
     OperationCallback,
     OperationResult,
     OperationStats,
@@ -64,6 +70,7 @@ from sparkucx_tpu.core.operation import (
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.store.hbm_store import HbmBlockStore
 from sparkucx_tpu.testing import faults
+from sparkucx_tpu.utils.checksum import crc32c
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.stats import StatsAggregator
 
@@ -73,6 +80,10 @@ _TAG = struct.Struct("<Q")
 _COUNT = struct.Struct("<I")
 _TRIPLE = struct.Struct("<iii")
 _SIZE = struct.Struct("<q")
+#: CRC32C trailer appended to chunk / ReplicaPut headers when
+#: ``spark.shuffle.tpu.wire.checksum`` is on.  Receivers detect it by header
+#: length — the knob never changes frame layout when off (golden frames).
+_CRC = struct.Struct("<I")
 _MAX_FRAME = MAX_FRAME_BYTES  # shared frame ceiling (core/definitions.py)
 
 
@@ -307,10 +318,14 @@ class BlockServer:
         registry_lookup: Optional[Callable[[BlockId], Optional[Block]]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        member_sink: Optional[Callable[[int, int, int, int], None]] = None,
     ) -> None:
         self.conf = conf or TpuShuffleConf()
         self.store = store
         self.registry_lookup = registry_lookup
+        #: membership-frame sink: called as (am_id, epoch, subject, observer)
+        #: for every MemberSuspect/MemberRejoin frame a peer sends us
+        self.member_sink = member_sink
         self._srv = socket.socket()
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -479,6 +494,7 @@ class BlockServer:
         sizes: List[int] = []
         seq = 0
         chunk = group.chunk_bytes
+        checksum = self.conf.wire_checksum
         for i, e in enumerate(entries):
             if e is None:
                 sizes.append(-1)
@@ -491,10 +507,19 @@ class BlockServer:
             pos = 0
             while pos < ln:
                 n = min(chunk, ln - pos)
-                prefix = pack_frame_prefix(
-                    AmId.FETCH_BLOCK_CHUNK, pack_chunk_hdr(tag, i, seq, pos), n
+                hdr = pack_chunk_hdr(tag, i, seq, pos)
+                if checksum:
+                    # 4 B CRC32C trailer; the client detects it by header
+                    # length (CHUNK_HEADER_SIZE + 4), so frames stay
+                    # byte-identical with the knob off
+                    hdr += _CRC.pack(crc32c(view[pos : pos + n]))
+                prefix = pack_frame_prefix(AmId.FETCH_BLOCK_CHUNK, hdr, n)
+                # chaos hook AFTER the crc: an armed garble models payload
+                # corrupted in flight, which the client-side crc must catch
+                payload = faults.transform(
+                    "peer.server.chunk", view[pos : pos + n], tag=tag, block=i
                 )
-                group.enqueue(seq % group.nlanes, [prefix, view[pos : pos + n]])
+                group.enqueue(seq % group.nlanes, [prefix, memoryview(payload)])
                 seq += 1
                 pos += n
         blob = b"".join(_SIZE.pack(s) for s in sizes)
@@ -561,6 +586,21 @@ class BlockServer:
                         except TransportError:
                             pass  # shuffle not created on this server yet
                 elif am_id == AmId.REPLICA_PUT:
+                    if (len(header) - REPLICA_HEADER_SIZE) % REPLICA_ENTRY_SIZE == 4:
+                        # wire.checksum trailer: verify before installing; a
+                        # corrupt replica gets NO ack, so the pusher's
+                        # replication_wait names this successor as stalled
+                        # instead of the store holding silently bad bytes
+                        (want,) = _CRC.unpack(bytes(header[-4:]))
+                        header = header[:-4]
+                        if crc32c(body) != want:
+                            sid, src, rnd, _ = unpack_replica_put(header)
+                            logger.warning(
+                                "replica round (shuffle=%d, src=%d, round=%d) from "
+                                "peer %s failed crc32c — discarded, not acked",
+                                sid, src, rnd, peer,
+                            )
+                            continue
                     sid, src, rnd, entries = unpack_replica_put(header)
                     faults.check(
                         "replica.apply", shuffle_id=sid, src_executor=src, round_idx=rnd
@@ -571,6 +611,10 @@ class BlockServer:
                         conn.sendall(
                             pack_frame(AmId.REPLICA_ACK, pack_replica_ack(sid, src, rnd))
                         )
+                elif am_id in (AmId.MEMBER_SUSPECT, AmId.MEMBER_REJOIN):
+                    epoch, subject, observer = unpack_member_event(header)
+                    if self.member_sink is not None:
+                        self.member_sink(int(am_id), epoch, subject, observer)
                 elif am_id == AmId.INIT_EXECUTOR_REQ:
                     (eid,) = _TAG.unpack_from(header)
                     self.handshaken[eid] = body
@@ -679,6 +723,10 @@ class _PeerConnection:
         self.rx_syscalls = 0
         self.rx_stall_ns = 0
         self.stall_samples: Deque[int] = deque(maxlen=4096)
+        #: the exception that killed the recv loop (None for a clean EOF) —
+        #: _fail_conn_inflight surfaces a typed error (BlockCorruptError)
+        #: instead of the generic connection-lost one when it is set
+        self.last_error: Optional[Exception] = None
         self.alive = True
         self.recv_thread = threading.Thread(target=self._recv_loop, daemon=True)
         self.recv_thread.start()
@@ -776,21 +824,40 @@ class _PeerConnection:
         The chunk is self-addressing — (tag, block, offset within block) —
         so this lane needs no coordination with its siblings.  If this chunk
         is the batch's last missing piece, park the manifest header here so
-        progress() completes the batch on whichever lane finished last."""
+        progress() completes the batch on whichever lane finished last.
+
+        A header carrying the 4 B CRC32C trailer (wire.checksum on the
+        serving side) is verified after the payload lands; a mismatch raises
+        ``BlockCorruptError``, which kills this lane — the batch then fails
+        typed and the reducer-side failover (``_retry_fetch``) re-sources the
+        block from a replica holder."""
         tag, block, seq, offset = unpack_chunk_hdr(header)
+        want = None
+        if len(header) == CHUNK_HEADER_SIZE + 4:
+            (want,) = _CRC.unpack_from(header, CHUNK_HEADER_SIZE)
         mv = self.chunk_sink(tag, block, offset, blen) if blen else None
         ok = False
         try:
+            data = b""
             if mv is not None:
                 self._recv_into(
                     mv, what=f" (fetch tag {tag}, block {block}, chunk offset {offset})"
                 )
+                data = mv
             elif blen:  # unknown tag / oversized target: drain off the wire
-                if self._recv_exact(blen) is None:
+                data = self._recv_exact(blen)
+                if data is None:
                     raise OSError(
                         f"peer {self.peer} (lane {self.lane}) closed mid-chunk "
                         f"(fetch tag {tag}, block {block})"
                     )
+            if want is not None and blen and crc32c(data) != want:
+                raise BlockCorruptError(
+                    -1, -1, block,
+                    f"striped chunk (fetch tag {tag}, block {block}, offset "
+                    f"{offset}) from peer {self.peer} lane {self.lane} failed "
+                    "its crc32c check",
+                )
             ok = True
         finally:
             # the done callback must run even when the socket dies mid-chunk:
@@ -847,8 +914,8 @@ class _PeerConnection:
                 else:
                     body = b""  # payload already scattered into result buffers
                 self._park(am_id, header, body, scattered)
-        except (OSError, ValueError, struct.error):
-            pass
+        except (OSError, ValueError, struct.error, TransportError) as e:
+            self.last_error = e
         self.alive = False
         if self.activity is not None:
             self.activity.set()  # wake parked waiters so they observe the death
@@ -902,6 +969,17 @@ class _StripeGroup:
     def inbox(self) -> bool:
         # truthiness only (zombie retirement): any lane still holding frames
         return any(lane.inbox for lane in self.lanes)
+
+    @property
+    def last_error(self) -> Optional[Exception]:
+        # a typed lane death (e.g. BlockCorruptError) wins over plain EOFs
+        for lane in self.lanes:
+            if isinstance(lane.last_error, TransportError):
+                return lane.last_error
+        for lane in self.lanes:
+            if lane.last_error is not None:
+                return lane.last_error
+        return None
 
     def send(self, frame: bytes) -> None:
         self.lanes[0].send(frame)
@@ -991,15 +1069,31 @@ class PeerTransport(ShuffleTransport):
         # -- neighbor replication (client side of REPLICA_PUT/REPLICA_ACK) --
         #: outstanding REPLICA_ACKs per shuffle this executor pushed
         self._replica_pending: Dict[int, int] = {}  #: guarded by self._tag_lock
-        #: shuffles whose replicator thread is still enumerating/sending
+        #: shuffles whose replica push is still queued or in flight
         self._replica_pushing: set = set()  #: guarded by self._tag_lock
-        #: replication telemetry: rounds/bytes pushed, acks seen, failed sends
+        #: outstanding acks per shuffle broken down by successor executor —
+        #: lets replication_wait name WHICH neighbor stalled, not just that one did
+        self._replica_unacked: Dict[int, Dict[ExecutorId, int]] = {}  #: guarded by self._tag_lock
+        #: sealed shuffles awaiting the replicator worker, oldest first
+        self._replica_queue: deque = deque()  #: guarded by self._tag_lock
+        self._replica_worker: Optional[threading.Thread] = None  #: guarded by self._tag_lock
+        self._replica_run = True  #: guarded by self._tag_lock (close() clears)
+        self._replica_wake = threading.Event()
+        #: replication telemetry: rounds/bytes pushed, acks seen, failed sends,
+        #: rounds dropped by the backlog cap, and the live backlog gauge (bytes
+        #: of replica payload admitted to the wire but not yet sent)
         self.replica_stats: Dict[str, int] = {
             "pushed_rounds": 0,
             "pushed_bytes": 0,
             "acks": 0,
             "failed": 0,
+            "dropped_rounds": 0,
+            "replica_backlog_bytes": 0,
         }  #: guarded by self._tag_lock
+        #: Optional ClusterMembership installed by elastic owners (the SPMD
+        #: driver / loopback harness); peer-observed wire failures and rejoin
+        #: announcements feed it.  None = membership-unaware (the default).
+        self.membership = None
         self.stats_agg = StatsAggregator() if self.conf.collect_stats else None
         #: Wakeup doorbell (conf.use_wakeup): recv threads set it when an ack
         #: parks, so fetch loops can sleep in wait_for_activity() instead of
@@ -1136,11 +1230,15 @@ class PeerTransport(ShuffleTransport):
         host = host if host != "0.0.0.0" else "127.0.0.1"
         self.server = BlockServer(
             self.conf, store=self.store, registry_lookup=self.registered_block,
-            host=host, port=port,
+            host=host, port=port, member_sink=self._on_member_event,
         )
         return self.server.address_bytes()
 
     def close(self) -> None:
+        with self._tag_lock:
+            self._replica_run = False
+            self._replica_queue.clear()
+        self._replica_wake.set()
         if self.stats_agg is not None:
             for s in self.wire_lane_stats():
                 self.stats_agg.record_counters(
@@ -1183,6 +1281,61 @@ class PeerTransport(ShuffleTransport):
             conns = [self._conns.pop(k) for k in doomed]
         for conn in conns:
             conn.close()
+
+    # -- gossip-free membership observations -------------------------------
+    #
+    # No heartbeats: liveness is observation-driven.  A wire failure sends a
+    # MEMBER_SUSPECT to every peer; an executor coming back announces itself
+    # with MEMBER_REJOIN.  Both land in the local ClusterMembership when one
+    # is installed (self.membership), and are silently dropped otherwise —
+    # membership-unaware deployments see zero behavior change.
+
+    def note_peer_failed(self, executor_id: ExecutorId, reason: str) -> None:
+        """Report a wire failure against ``executor_id``: suspect it locally
+        (debounced by ``membership.suspectAfterMs``) and, only when the
+        suspicion NEWLY killed the executor, tell the other peers — re-observed
+        failures of an already-dead peer must not re-broadcast every progress
+        pump.  Called from the send path and progress(), NEVER from ``_evict``
+        — broadcasting opens connections, and a broadcast failure must not
+        recurse into eviction."""
+        if self.membership is None:
+            return
+        if self.membership.suspect(executor_id, reason):
+            self._broadcast_member_event(AmId.MEMBER_SUSPECT, executor_id)
+
+    def announce_rejoin(self) -> None:
+        """This executor is back: mark self alive and tell every peer, so the
+        full mesh returns at the next shuffle's epoch check."""
+        if self.membership is None:
+            return
+        self.membership.mark_alive(self.executor_id)
+        self._broadcast_member_event(AmId.MEMBER_REJOIN, self.executor_id)
+
+    def _broadcast_member_event(self, am_id: AmId, subject: ExecutorId) -> None:
+        epoch = self.membership.epoch if self.membership is not None else 0
+        frame = pack_frame(am_id, pack_member_event(epoch, subject, self.executor_id))
+        with self._conn_lock:
+            eids = [e for e in self._conn_addrs if e != subject]
+        for eid in eids:
+            try:
+                self._connection(eid).send(frame)
+            except (TransportError, OSError):
+                pass  # best-effort: an unreachable peer learns from its own wire
+
+    def _on_member_event(
+        self, am_id: int, epoch: int, subject: ExecutorId, observer: ExecutorId
+    ) -> None:
+        """BlockServer sink for MEMBER_SUSPECT/MEMBER_REJOIN frames (runs on a
+        server conn thread).  Rumors about ourselves are ignored — a live
+        executor is the authority on its own liveness."""
+        if self.membership is None or subject == self.executor_id:
+            return
+        if am_id == AmId.MEMBER_SUSPECT:
+            self.membership.suspect(
+                subject, f"peer {observer} reported a wire failure (epoch {epoch})"
+            )
+        elif am_id == AmId.MEMBER_REJOIN:
+            self.membership.mark_alive(subject)
 
     def _slot(self) -> int:
         # Round-robin threads onto worker slots via a thread-local (raw thread
@@ -1367,6 +1520,7 @@ class PeerTransport(ShuffleTransport):
                 e,
             )
             self._evict(executor_id)
+            self.note_peer_failed(executor_id, f"fetch send failed: {e}")
             with self._tag_lock:
                 self._inflight.pop(tag, None)
                 self._stripe_rx.pop(tag, None)
@@ -1419,7 +1573,14 @@ class PeerTransport(ShuffleTransport):
             logger.warning(
                 "connection to peer %s lost with %d in-flight request(s)", peer, len(reqs)
             )
-            err = TransportError(f"peer connection lost ({peer}, fetch tag {tag})")
+            # Surface the recv loop's typed killer when it carries more signal
+            # than "connection lost" — a crc mismatch (BlockCorruptError) must
+            # reach the reducer as corruption, not as a generic peer death.
+            base = getattr(conn, "last_error", None)
+            if isinstance(base, BlockCorruptError):
+                err: TransportError = base
+            else:
+                err = TransportError(f"peer connection lost ({peer}, fetch tag {tag})")
             for req, buf, cb in zip(reqs, bufs, cbs):
                 if req.completed():
                     continue
@@ -1435,17 +1596,27 @@ class PeerTransport(ShuffleTransport):
         connections and fails their in-flight batches (the reference only logs
         and leaks them, UcxWorkerWrapper.scala:351-353 — we do better)."""
         with self._conn_lock:
-            conns = list(self._conns.values())
+            by_conn = [(eid, conn) for (eid, _slot), conn in self._conns.items()]
             zombies = list(self._zombies)
-        for conn in conns + zombies:
+        conns = [conn for _eid, conn in by_conn]
+        for eid, conn in by_conn + [(None, z) for z in zombies]:
             while True:
                 frame = conn.drain_one()
                 if frame is None:
                     break
-                self._handle_frame(frame)
+                self._handle_frame(frame, from_executor=eid)
         dead = [c for c in conns + zombies if not c.alive]
         if dead:
             self._fail_conn_inflight(dead)
+            # attribute the deaths while we still know which executor each
+            # cached conn belongs to (zombies lost that mapping; the original
+            # eviction already reported them)
+            for eid, conn in by_conn:
+                if not conn.alive:
+                    why = getattr(conn, "last_error", None)
+                    self.note_peer_failed(
+                        eid, f"peer connection died: {why if why is not None else 'EOF'}"
+                    )
         if zombies:
             # retire zombies once nothing references them: no inflight tag
             # rides them and their inbox is drained
@@ -1454,7 +1625,11 @@ class PeerTransport(ShuffleTransport):
             with self._conn_lock:
                 self._zombies = [z for z in self._zombies if z in riding or z.inbox]
 
-    def _handle_frame(self, frame: Tuple[AmId, bytes, bytes, bool]) -> None:
+    def _handle_frame(
+        self,
+        frame: Tuple[AmId, bytes, bytes, bool],
+        from_executor: Optional[ExecutorId] = None,
+    ) -> None:
         am_id, header, body, scattered = frame
         if am_id == AmId.REPLICA_ACK:
             try:
@@ -1462,7 +1637,9 @@ class PeerTransport(ShuffleTransport):
             except struct.error:
                 return
             if src == self.executor_id:
-                self._replica_acked(sid)
+                # from_executor (when the draining path knows the conn's peer)
+                # attributes the ack to its successor for replication_wait
+                self._replica_acked(sid, executor_id=from_executor)
             return
         if am_id != AmId.FETCH_BLOCK_REQ_ACK:
             return
@@ -1614,35 +1791,91 @@ class PeerTransport(ShuffleTransport):
         )
 
     def _on_store_seal(self, shuffle_id: int) -> None:
-        """Store seal hook: launch the background replica push (never blocks
-        the sealing caller; the map-side superstep proceeds immediately)."""
+        """Store seal hook: enqueue the shuffle for the single replicator
+        worker (never blocks the sealing caller; the map-side superstep
+        proceeds immediately).
+
+        The queue is bounded by ``replication.maxBacklogBytes``: when the live
+        backlog gauge is over the cap, the OLDEST still-queued shuffle is
+        dropped (its rounds counted in ``dropped_rounds``) rather than letting
+        a slow successor grow the backlog without bound.  Dropping replicas is
+        safe — replication is best-effort durability, and a shuffle whose
+        replicas were dropped simply becomes unrecoverable if its primary
+        later dies (the degraded-recovery path reports exactly that)."""
         if self.conf.replication_factor <= 0:
             return
         with self._tag_lock:
+            cap = self.conf.replication_max_backlog_bytes
+            if (
+                cap
+                and self.replica_stats["replica_backlog_bytes"] > cap
+                and self._replica_queue
+            ):
+                dropped = self._replica_queue.popleft()
+                self._replica_pushing.discard(dropped)
+                try:
+                    self.replica_stats["dropped_rounds"] += self.store.num_rounds(dropped)
+                except TransportError:
+                    self.replica_stats["dropped_rounds"] += 1
+                logger.warning(
+                    "replica backlog over %d B: dropped queued shuffle %d",
+                    cap, dropped,
+                )
             self._replica_pushing.add(shuffle_id)
-        threading.Thread(
-            target=self._replicate_push,
-            args=(shuffle_id,),
-            daemon=True,
-            name=f"replicator-{self.executor_id}-{shuffle_id}",
-        ).start()
+            self._replica_queue.append(shuffle_id)
+            worker = self._replica_worker
+            if worker is None or not worker.is_alive():
+                worker = threading.Thread(
+                    target=self._replica_loop,
+                    daemon=True,
+                    name=f"replicator-{self.executor_id}",
+                )
+                self._replica_worker = worker
+                worker.start()
+        self._replica_wake.set()
+
+    def _replica_loop(self) -> None:
+        """Single replicator worker: drains the seal queue one shuffle at a
+        time, so replica pushes never fan out into thread-per-seal."""
+        while True:
+            with self._tag_lock:
+                if not self._replica_run:
+                    return
+                shuffle_id = self._replica_queue.popleft() if self._replica_queue else None
+            if shuffle_id is None:
+                if not self._replica_wake.wait(timeout=0.2):
+                    with self._tag_lock:
+                        # idle and nothing queued: retire; the next seal respawns
+                        if not self._replica_queue:
+                            self._replica_worker = None
+                            return
+                self._replica_wake.clear()
+                continue
+            self._replicate_push(shuffle_id)
 
     def _replicate_push(self, shuffle_id: int) -> None:
         try:
             faults.check("replica.push", shuffle_id=shuffle_id, executor=self.executor_id)
             neighbors = self.replication_neighbors()
             rounds = self.store.replica_source(shuffle_id) if neighbors else []
+            round_bytes = sum(len(body) for _, _, body in rounds)
             with self._tag_lock:
                 self._replica_pending[shuffle_id] = (
                     self._replica_pending.get(shuffle_id, 0) + len(neighbors) * len(rounds)
                 )
+                unacked = self._replica_unacked.setdefault(shuffle_id, {})
+                for eid in neighbors:
+                    unacked[eid] = unacked.get(eid, 0) + len(rounds)
+                self.replica_stats["replica_backlog_bytes"] += round_bytes * len(neighbors)
+            checksum = self.conf.wire_checksum
             for eid in neighbors:
                 for rnd, entries, body in rounds:
-                    frame = pack_frame(
-                        AmId.REPLICA_PUT,
-                        pack_replica_put(shuffle_id, self.executor_id, rnd, entries),
-                        body,
-                    )
+                    header = pack_replica_put(shuffle_id, self.executor_id, rnd, entries)
+                    if checksum:
+                        # self-describing: receivers detect the crc tail by
+                        # header length (knob off = golden replica frames)
+                        header += _CRC.pack(crc32c(body))
+                    frame = pack_frame(AmId.REPLICA_PUT, header, body)
                     try:
                         self._connection(eid).send(frame)
                         with self._tag_lock:
@@ -1653,7 +1886,12 @@ class PeerTransport(ShuffleTransport):
                             "replication of shuffle %d round %d to executor %s failed: %s",
                             shuffle_id, rnd, eid, e,
                         )
-                        self._replica_acked(shuffle_id, failed=True)
+                        self._replica_acked(shuffle_id, failed=True, executor_id=eid)
+                    finally:
+                        with self._tag_lock:
+                            self.replica_stats["replica_backlog_bytes"] = max(
+                                0, self.replica_stats["replica_backlog_bytes"] - len(body)
+                            )
         except Exception:
             logger.exception("replicator for shuffle %d died", shuffle_id)
         finally:
@@ -1661,16 +1899,37 @@ class PeerTransport(ShuffleTransport):
                 self._replica_pushing.discard(shuffle_id)
             self._activity.set()
 
-    def _replica_acked(self, shuffle_id: int, failed: bool = False) -> None:
+    def _replica_acked(
+        self,
+        shuffle_id: int,
+        failed: bool = False,
+        executor_id: Optional[ExecutorId] = None,
+    ) -> None:
         with self._tag_lock:
             left = self._replica_pending.get(shuffle_id, 0) - 1
             self._replica_pending[shuffle_id] = max(0, left)
             self.replica_stats["failed" if failed else "acks"] += 1
+            unacked = self._replica_unacked.get(shuffle_id)
+            if unacked:
+                if executor_id is None:
+                    # ack arrived on a path that lost its origin (zombie conn):
+                    # settle any outstanding successor so totals still converge
+                    executor_id = next(
+                        (e for e, c in unacked.items() if c > 0), None
+                    )
+                if executor_id is not None and unacked.get(executor_id, 0) > 0:
+                    unacked[executor_id] -= 1
 
-    def replication_wait(self, shuffle_id: int, timeout: float = 10.0) -> bool:
+    def replication_wait(
+        self, shuffle_id: int, timeout: float = 10.0, strict: bool = False
+    ) -> bool:
         """Pump progress until every replica push for ``shuffle_id`` is acked
         (or failed-and-accounted).  True = replication settled.  Tests and
-        graceful shutdown use this; the data path never has to."""
+        graceful shutdown use this; the data path never has to.
+
+        ``strict`` turns a timeout into a ``TransportError`` naming the
+        successor executor(s) whose acks never came — the operator-facing
+        answer to "which neighbor is stalling my replication?"."""
         deadline = time.monotonic() + timeout
         while True:
             with self._tag_lock:
@@ -1681,6 +1940,18 @@ class PeerTransport(ShuffleTransport):
             if settled:
                 return True
             if time.monotonic() > deadline:
+                if strict:
+                    with self._tag_lock:
+                        stalled = sorted(
+                            e
+                            for e, c in self._replica_unacked.get(shuffle_id, {}).items()
+                            if c > 0
+                        )
+                    raise TransportError(
+                        f"replication of shuffle {shuffle_id} did not settle in "
+                        f"{timeout:.1f}s: successor executor(s) {stalled} have "
+                        f"unacknowledged replica rounds"
+                    )
                 return False
             self.progress()
             self.wait_for_activity(0.005)

@@ -135,6 +135,7 @@ class RoundPipeline:
         result_rows: Optional[Callable[[Any], Tuple[int, int]]] = None,
         credits: Optional[CreditGate] = None,
         round_bytes: Optional[Callable[[int], int]] = None,
+        interrupt: Optional[Callable[[], Optional[BaseException]]] = None,
     ) -> None:
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
@@ -156,10 +157,20 @@ class RoundPipeline:
         # bounds rounds, credits bound bytes, whichever is tighter wins.
         self._credits = credits
         self._round_bytes = round_bytes
+        # Optional abort probe, polled before every submit (both engines): a
+        # non-None return aborts the run by raising it there, so the pipeline
+        # stops launching rounds whose plan went stale (elastic recovery uses
+        # this to stop on a membership-epoch change).  Rounds already
+        # submitted still drain — their credits/resources settle normally.
+        self._interrupt = interrupt
 
     # -- instrumented stage wrappers --------------------------------------
 
     def _submit(self, rnd: int) -> Any:
+        if self._interrupt is not None:
+            exc = self._interrupt()
+            if exc is not None:
+                raise exc
         if self._credits is not None:
             self._credits.acquire(self._round_bytes(rnd))
         op = OperationStats()

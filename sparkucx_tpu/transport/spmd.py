@@ -31,7 +31,7 @@ import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.definitions import MapperInfo
-from sparkucx_tpu.core.operation import TransportError
+from sparkucx_tpu.core.operation import ExecutorLostError, TransportError
 from sparkucx_tpu.core.transport import ExecutorId
 from sparkucx_tpu.ops.exchange import (
     ExchangeSpec,
@@ -108,6 +108,19 @@ class SpmdShuffleExecutor:
             self.conf, device=self.device, executor_id=self.executor_id
         )
         self.peer = PeerTransport(self.conf, executor_id=self.executor_id, store=self.store)
+        # Liveness view fed by the wire plane (peer send failures + gossiped
+        # MEMBER_SUSPECT/MEMBER_REJOIN frames).  The SPMD exchange cannot
+        # shrink unilaterally — every process executes the same compiled
+        # collective — so a degraded view fails the superstep FAST with a
+        # typed error instead of hanging in a collective the dead process
+        # will never join.  Elastic shrink/regrow is the single-controller
+        # cluster's recovery path (transport/tpu.py).
+        from sparkucx_tpu.parallel.membership import ClusterMembership
+
+        self.membership = ClusterMembership(
+            range(self.num_executors), self.conf.membership_suspect_after_ms
+        )
+        self.peer.membership = self.membership
         self._mapper_infos: Dict[int, Dict[int, MapperInfo]] = {}
         self._recv: Dict[int, Tuple[List[np.ndarray], List[np.ndarray]]] = {}
         self._meta: Dict[int, Tuple[int, int, List[Tuple[int, int]]]] = {}
@@ -185,6 +198,17 @@ class SpmdShuffleExecutor:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        snap = self.membership.snapshot()
+        if snap["dead"]:
+            # fail before entering the collective: a lockstep exchange with a
+            # dead process hangs every live process until the backend timeout
+            first_dead = min(snap["dead"])
+            raise ExecutorLostError(
+                first_dead,
+                snap["epoch"],
+                "SPMD exchange requires every process; degraded recovery is "
+                f"the single-controller cluster's path — dead: {snap['dead']}",
+            )
         self._await_commits(shuffle_id)
         rounds = self.store.seal(shuffle_id)
         if self.conf.slot_quota_rows > 0:

@@ -33,6 +33,7 @@ over ``jax.distributed`` + the control plane in parallel/bootstrap.py.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -44,6 +45,8 @@ from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import Block, BlockId, MemoryBlock, ShuffleBlockId
 from sparkucx_tpu.core.definitions import MapperInfo
 from sparkucx_tpu.core.operation import (
+    BlockNotFoundError,
+    ExecutorLostError,
     OperationCallback,
     OperationResult,
     OperationStats,
@@ -52,6 +55,8 @@ from sparkucx_tpu.core.operation import (
     TransportError,
 )
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
+from sparkucx_tpu.parallel.membership import ClusterMembership
+from sparkucx_tpu.parallel.mesh import surviving_submesh
 from sparkucx_tpu.ops.exchange import (
     ExchangeSpec,
     bucket_send_rows,
@@ -67,7 +72,9 @@ from sparkucx_tpu.ops.skew import (
     reassemble_round,
     slice_subround,
 )
+from sparkucx_tpu.shuffle.resolver import degraded_plan, ring_neighbors
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
+from sparkucx_tpu.testing import faults
 from sparkucx_tpu.transport.pipeline import RoundPipeline
 from sparkucx_tpu.utils.stats import StatsAggregator
 from sparkucx_tpu.utils.trace import instant, span
@@ -85,6 +92,10 @@ class _ShuffleMeta:
     map_owner: List[ExecutorId]                      # map task -> executor
     peer_ranges: List[Tuple[int, int]]               # reducer ownership
     mapper_infos: Dict[int, MapperInfo] = field(default_factory=dict)
+    #: per-peer staging region size in bytes, stashed at create_shuffle so
+    #: block-offset math (_locate_rows) and the elastic restage path never
+    #: have to reach into an executor's store — which may be dead.
+    region_bytes: int = 0
     # post-exchange receive state, one entry per staging round (multi-round
     # spill; a single round in the common case), each per executor.  Entries
     # are plain arrays (host_recv_mode='array'), np.memmap views ('memmap'),
@@ -106,6 +117,18 @@ class _ShuffleMeta:
             if s <= reduce_id < e:
                 return p
         raise ValueError(f"reduce_id {reduce_id} unowned")
+
+
+class _MeshChanged(Exception):
+    """Internal abort signal: cluster membership changed under an in-flight
+    exchange.  Never escapes ``run_exchange`` — it either converts into a
+    degraded re-plan (elastic.enabled + replicas available) or into a typed
+    ``ExecutorLostError``."""
+
+    def __init__(self, epoch0: int, snapshot: dict) -> None:
+        self.epoch0 = epoch0
+        self.snapshot = snapshot
+        super().__init__(f"membership epoch {epoch0} -> {snapshot['epoch']}")
 
 
 class TpuShuffleCluster:
@@ -134,6 +157,21 @@ class TpuShuffleCluster:
         #: 'memmap'), charged against conf.spill_disk_cap_bytes like the
         #: store's staging spill; the drain worker charges, teardown refunds
         self._recv_spill_bytes = 0  #: guarded by self._lock
+        #: Liveness/epoch layer.  Always constructed (it is just bookkeeping);
+        #: with elastic.enabled=false nothing ever reports a death through it,
+        #: the epoch stays 0, and every code path below is byte-identical to
+        #: the pre-elastic behavior.
+        self.membership = ClusterMembership(
+            range(self.num_executors), self.conf.membership_suspect_after_ms
+        )
+        #: degraded-mode recovery telemetry (perf/benchmark.py `elastic` mode
+        #: and the chaos tests read this)
+        self.elastic_stats = {
+            "recoveries": 0,
+            "last_recovery_ms": 0.0,
+            "last_epoch": 0,
+            "degraded_mesh": None,
+        }  #: guarded by self._lock
 
     # -- membership / lookup ----------------------------------------------
 
@@ -176,6 +214,7 @@ class TpuShuffleCluster:
             t.store.create_shuffle(
                 shuffle_id, num_mappers, num_reducers, peer_ranges=ranges, capacity=capacity
             )
+        meta.region_bytes = self.transports[0].store.region_bytes(shuffle_id)
         return meta
 
     def remove_shuffle(self, shuffle_id: int) -> None:
@@ -339,6 +378,20 @@ class TpuShuffleCluster:
             # path — including its donation of sealed payloads — byte-for-byte.
             self._run_exchange_quota(meta, sealed, mode)
             return
+        # Elastic prep: snapshot the membership epoch the plan was built
+        # against, and (when replication is on) copy each executor's sealed
+        # rounds to its ring successors so a mid-superstep death is
+        # recoverable.  Both are no-ops with the knobs at their defaults.
+        epoch0 = self.membership.epoch
+        if self.conf.elastic and self.conf.replication_factor >= 1:
+            with span("exchange.replicate", shuffle_id=shuffle_id):
+                self._replicate_sealed(shuffle_id)
+
+        def _mesh_changed() -> Optional[_MeshChanged]:
+            if self.membership.epoch != epoch0:
+                return _MeshChanged(epoch0, self.membership.snapshot())
+            return None
+
         fn = self._exchange_fn(send_rows)
         bucketed = bucket_send_rows(send_rows, self.num_executors)
 
@@ -388,6 +441,10 @@ class TpuShuffleCluster:
             """H2D + collective dispatch + async D2H kick-off for one round.
             Everything here is JAX async dispatch: round rnd's collective is
             still in flight when round rnd+1 assembles."""
+            faults.check("exchange.submit", shuffle_id=shuffle_id, round=rnd)
+            exc = _mesh_changed()
+            if exc is not None:
+                raise exc
             data, size_mat = _assemble(rnd)
             with span("exchange.collective", shuffle_id=shuffle_id, round=rnd, rows=bucketed):
                 recv, recv_sizes = fn(data, size_mat)
@@ -452,8 +509,17 @@ class TpuShuffleCluster:
                 int(r[1].sum()),
                 n * bucketed - int(r[1].sum()),
             ),
+            interrupt=_mesh_changed,
         )
-        results = pipe.run(num_rounds)
+        try:
+            results = pipe.run(num_rounds)
+        except _MeshChanged:
+            # An executor died under this exchange: abort the stale full-mesh
+            # plan and re-run degraded on the surviving pow2 bucket (or raise
+            # a typed ExecutorLostError when recovery is impossible).
+            with span("exchange.recover", shuffle_id=shuffle_id):
+                self._recover_and_rerun(meta, sealed, mode)
+            return
 
         meta.recv_shards, meta.recv_sizes = [], []
         for shards, sizes_host, dev_shards in results:
@@ -498,6 +564,7 @@ class TpuShuffleCluster:
             for rnd in range(num_rounds)
         ]
         plan = plan_exchange(round_maxes, staging_slot, self.conf.slot_quota_rows)
+        epoch0 = self.membership.epoch
         q = plan.slot_rows
         bucketed = q * n
         fn = self._exchange_fn(bucketed)  # pow2 slot: bucketing fixed point
@@ -513,6 +580,17 @@ class TpuShuffleCluster:
             — the quota twin of _submit, slicing chunk windows out of every
             peer slot instead of relocating whole slots."""
             rnd, chunk, _ = subs[sub_idx]
+            faults.check("exchange.submit", shuffle_id=shuffle_id, round=rnd)
+            if self.membership.epoch != epoch0:
+                snap = self.membership.snapshot()
+                dead = sorted(snap["dead"])
+                raise ExecutorLostError(
+                    dead[0] if dead else -1,
+                    snap["epoch"],
+                    "executor lost mid-exchange; degraded recovery does not "
+                    "cover the quota-capped engine (slot_quota_rows > 0) — "
+                    f"dead: {dead}",
+                )
             payloads, size_rows = [], []
             for s in sealed:
                 if rnd < len(s):
@@ -649,6 +727,282 @@ class TpuShuffleCluster:
             meta.recv_shards = None  # explicit no-host-copy marker
         meta.exchanged = True
 
+    # -- elastic membership / degraded-mode recovery -----------------------
+
+    def _replicate_sealed(self, shuffle_id: int) -> None:
+        """Copy every executor's sealed rounds to its ring successors
+        (single-controller twin of PeerTransport._replicate_push): a direct
+        store-to-store ``put_replica`` with the same entry table and landing
+        zone as the wire path, so ``_recover_and_rerun`` restages from the
+        same placement either way."""
+        n = self.num_executors
+        factor = self.conf.replication_factor
+        for t in self.transports:
+            if not self.membership.is_alive(t.executor_id):
+                continue
+            rounds = t.store.replica_source(shuffle_id)
+            for succ in ring_neighbors(t.executor_id, range(n), factor):
+                if not self.membership.is_alive(succ):
+                    continue
+                for rnd, entries, body in rounds:
+                    self.transports[succ].store.put_replica(
+                        shuffle_id, t.executor_id, rnd, entries, body
+                    )
+
+    def _recover_and_rerun(self, meta, sealed, mode: str) -> None:
+        """Degraded-mode recovery: quarantine the aborted exchange's partial
+        state, restage every dead executor's rounds from ring-successor
+        replicas, shrink to the surviving pow2 bucket, and re-run the whole
+        shuffle as ``waves x waves`` sub-exchanges on the shrunk mesh.
+
+        Determinism: each sub-exchange (i, j) moves wave i's senders' regions
+        for wave j's consumers, and a consumer's final shard concatenates its
+        sub-shards in ascending wave order — exactly the sender-major packed
+        layout the full-mesh exchange produces, so the recovered bytes are
+        bit-identical to an undisturbed run (pinned in tests/test_elastic.py).
+        """
+        shuffle_id = meta.shuffle_id
+        op = OperationStats()
+        t0 = time.monotonic()
+        snap = self.membership.snapshot()
+        dead, alive, epoch = snap["dead"], snap["alive"], snap["epoch"]
+        first_dead = sorted(dead)[0] if dead else -1
+
+        # Quarantine: drop any partially-drained receive state and refund its
+        # disk budget — the aborted plan's outputs must never leak into the
+        # recovered shuffle.
+        meta.recv_shards = None
+        meta.recv_sizes = None
+        meta.recv_device = None
+        with self._lock:
+            doomed, meta.recv_spill_paths = meta.recv_spill_paths, []
+        if doomed:
+            import os
+
+            for path, size in doomed:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                with self._lock:
+                    self._recv_spill_bytes -= size
+
+        def unsupported(why: str) -> ExecutorLostError:
+            return ExecutorLostError(
+                first_dead, epoch, f"{why}; dead executors: {dict(dead)}"
+            )
+
+        if not self.conf.elastic:
+            raise unsupported(
+                "elastic recovery disabled (spark.shuffle.tpu.elastic.enabled=false)"
+            )
+        if dead and self.conf.replication_factor < 1:
+            raise unsupported("no replicas to restage from (replication.factor=0)")
+        if self.conf.num_slices > 1:
+            raise unsupported(
+                "degraded recovery does not cover multi-slice meshes (num_slices > 1)"
+            )
+        if mode == "device" or self.conf.keep_device_recv:
+            raise unsupported(
+                "degraded recovery does not cover device-resident receive "
+                "(host_recv_mode='device' / keep_device_recv)"
+            )
+
+        n = self.num_executors
+        num_rounds = max(len(s) for s in sealed)
+        m, phys, waves = degraded_plan(n, alive)
+        alive_set = set(alive)
+        slot_rows = meta.region_bytes // self.row_bytes
+        send_rows = n * slot_rows
+        lane = self.row_bytes // 4
+
+        # Restage each dead executor's rounds bit-identically from replicas:
+        # zeros staging (padding rows are zero by construction), replica block
+        # bodies at their MapperInfo absolute offsets, per-region used-row
+        # counts rebuilt from the padded lengths (allocation was contiguous,
+        # so the padded sum IS the region's used prefix).
+        restaged: Dict[int, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        for d in sorted(dead):
+            dead_rounds = len(sealed[d])
+            sealed[d] = None  # its memory died with it — recover honestly
+            cands = ring_neighbors(d, range(n), self.conf.replication_factor)
+            live_cands = [c for c in cands if c in alive_set]
+            rounds_out: List[Tuple[np.ndarray, np.ndarray]] = []
+            for rnd in range(dead_rounds):
+                payload = np.zeros((send_rows, lane), dtype=np.int32)
+                flat = payload.reshape(-1).view(np.uint8)
+                sizes = np.zeros(n, dtype=np.int64)
+                for map_id, info in meta.mapper_infos.items():
+                    if meta.map_owner[map_id] != d:
+                        continue
+                    for r, (off, ln) in enumerate(info.partitions):
+                        if not ln or info.round_of(r) != rnd:
+                            continue
+                        body = None
+                        for c in live_cands:
+                            body = self.transports[c].store.replica_block(
+                                shuffle_id, d, map_id, r
+                            )
+                            if body is not None:
+                                break
+                        if body is None:
+                            raise BlockNotFoundError(
+                                shuffle_id, map_id, r,
+                                f"primary executor {d} is dead and no replica "
+                                f"found on candidates {cands} (alive: "
+                                f"{live_cands}) — shuffle {shuffle_id} is "
+                                "unrecoverable",
+                            )
+                        flat[off : off + ln] = np.frombuffer(bytes(body), dtype=np.uint8)
+                        sizes[off // meta.region_bytes] += -(-ln // self.row_bytes)
+                rounds_out.append((payload, sizes.astype(np.int32)))
+            restaged[d] = rounds_out
+
+        def round_payload(l, rnd):
+            src = sealed[l] if sealed[l] is not None else restaged.get(l, [])
+            if rnd < len(src):
+                return src[rnd]
+            return None, np.zeros(n, dtype=np.int32)
+
+        fn, submesh = self._degraded_exchange_fn(m, phys, m * slot_rows, epoch)
+        bucketed = bucket_send_rows(m * slot_rows, m)
+        ax = self.conf.mesh_axis_name
+        sub_sharding = NamedSharding(submesh, P(ax, None))
+        sub_devices = list(submesh.devices.reshape(-1))
+
+        meta.recv_shards, meta.recv_sizes = [], []
+        for rnd in range(num_rounds):
+            payloads, size_rows = [], []
+            for l in range(n):
+                p, s = round_payload(l, rnd)
+                payloads.append(p)
+                size_rows.append(s)
+            full_sizes = np.stack(size_rows).astype(np.int64)  # [sender, dest]
+            consumer_parts: List[List[np.ndarray]] = [[] for _ in range(n)]
+            for i in range(waves):
+                for j in range(waves):
+                    host = np.zeros((m * bucketed, lane), dtype=np.int32)
+                    sub_sizes = np.zeros((m, m), dtype=np.int32)
+                    lo = j * m * slot_rows
+                    hi = min((j + 1) * m, n) * slot_rows
+                    for p in range(m):
+                        l = i * m + p
+                        if l >= n:
+                            continue
+                        for q in range(m):
+                            c = j * m + q
+                            if c < n:
+                                sub_sizes[p, q] = full_sizes[l, c]
+                        if payloads[l] is None:
+                            continue
+                        src = np.asarray(payloads[l])
+                        block = np.zeros((m * slot_rows, lane), dtype=np.int32)
+                        block[: hi - lo] = src[lo:hi]
+                        host[p * bucketed : (p + 1) * bucketed] = rebucket_slots(
+                            block, m, bucketed
+                        )
+                    if not int(sub_sizes.sum()):
+                        continue  # empty sub-exchange: contributes zero rows
+                    data = jax.device_put(host, sub_sharding)
+                    size_mat = jax.device_put(sub_sizes, sub_sharding)
+                    with span(
+                        "exchange.collective.degraded",
+                        shuffle_id=shuffle_id, round=rnd, wave=(i, j), rows=bucketed,
+                    ):
+                        recv, recv_sizes = fn(data, size_mat)
+                    shard_by_device = {s.device: s.data for s in recv.addressable_shards}
+                    sizes_host = np.asarray(recv_sizes)  # [consumer, sender]
+                    for q in range(m):
+                        c = j * m + q
+                        if c >= n:
+                            continue
+                        used = int(sizes_host[q].sum())
+                        if used:
+                            consumer_parts[c].append(
+                                np.asarray(shard_by_device[sub_devices[q]])[:used]
+                                .reshape(-1)
+                                .view(np.uint8)
+                            )
+            assembled = [
+                np.concatenate(parts) if parts else np.empty(0, dtype=np.uint8)
+                for parts in consumer_parts
+            ]
+            if mode == "memmap":
+                with span("exchange.d2h_memmap", shuffle_id=shuffle_id, round=rnd):
+                    shards = self._memmap_round(meta, rnd, iter(assembled))
+            else:
+                shards = assembled
+            recv_mat = full_sizes.T.astype(np.int32).copy()
+            meta.recv_shards.append(shards)
+            meta.recv_sizes.append(recv_mat)
+            active = int(np.count_nonzero(recv_mat))
+            self.stats.record_rows("exchange.lanes", active, recv_mat.size - active)
+        meta.exchanged = True
+        recovery_ms = (time.monotonic() - t0) * 1e3
+        with self._lock:
+            self.elastic_stats["recoveries"] += 1
+            self.elastic_stats["last_recovery_ms"] = recovery_ms
+            self.elastic_stats["last_epoch"] = epoch
+            self.elastic_stats["degraded_mesh"] = (m, tuple(phys))
+        op.mark_done()
+        self.stats.record("exchange.recovery", op)
+        instant(
+            "exchange.recovered",
+            shuffle_id=shuffle_id, epoch=epoch, mesh=m, waves=waves,
+            recovery_ms=round(recovery_ms, 3),
+        )
+
+    def _degraded_exchange_fn(self, m: int, phys, sub_rows: int, epoch: int):
+        """Compile (or reuse) the shrunk-mesh exchange for a degraded epoch.
+        The cache key carries the membership epoch and surviving device set on
+        top of the usual pow2 bucket, so a later failure pattern with the same
+        geometry still recompiles against its own mesh."""
+        send_rows = bucket_send_rows(sub_rows, m)
+        from sparkucx_tpu.ops.ici_exchange import resolve_exchange_impl
+
+        submesh = surviving_submesh(self.mesh, phys, self.conf.mesh_axis_name)
+        impl = resolve_exchange_impl(
+            self.conf.exchange_impl, submesh.devices.reshape(-1)[0].platform, m
+        )
+        key = ("degraded", epoch, m, tuple(phys), send_rows, self.row_bytes, impl)
+        with self._lock:
+            fn = self._exchange_cache.get(key)
+            if fn is None:
+                spec = ExchangeSpec(
+                    num_executors=m,
+                    send_rows=send_rows,
+                    recv_rows=send_rows,
+                    lane=self.row_bytes // 4,
+                    axis_name=self.conf.mesh_axis_name,
+                    impl="auto",
+                )
+                if impl == "pallas":
+                    from sparkucx_tpu.ops.ici_exchange import (
+                        DEFAULT_CHUNKS_PER_DEST,
+                        build_ici_exchange,
+                    )
+
+                    fn = build_ici_exchange(
+                        submesh, spec, chunks_per_dest=DEFAULT_CHUNKS_PER_DEST
+                    )
+                else:
+                    fn = build_exchange(submesh, spec)
+                self._exchange_cache[key] = fn
+        return fn, submesh
+
+    def note_executor_lost(self, executor_id: ExecutorId, reason: str) -> bool:
+        """Report a death observed outside the chaos harness (wire errors,
+        timeouts); returns True when this observation newly killed the
+        executor (epoch bumped)."""
+        return self.membership.mark_dead(executor_id, reason)
+
+    def rejoin_executor(self, executor_id: ExecutorId) -> bool:
+        """Regrow: mark a previously-dead executor alive again.  The full mesh
+        is restored for the NEXT shuffle epoch — in-flight degraded state is
+        untouched, and because full-mesh compile-cache keys carry no epoch,
+        regrowing recompiles nothing."""
+        return self.membership.mark_alive(executor_id)
+
     def _memmap_round(self, meta, rnd: int, host_views):
         """Spill one round's received shards to a disk-backed mapping and
         return uint8 ``np.memmap`` views (host_recv_mode='memmap').
@@ -760,8 +1114,7 @@ class TpuShuffleCluster:
             return 0, 0, 0
         rnd = info.round_of(reduce_id)
         sender = meta.map_owner[map_id]
-        sender_store = self.transports[sender].store
-        region_bytes = sender_store.region_bytes(meta.shuffle_id)
+        region_bytes = meta.region_bytes
         region_rel = abs_offset - consumer * region_bytes
         if not (0 <= region_rel < region_bytes):
             raise TransportError(
@@ -890,6 +1243,14 @@ class TpuShuffleTransport(ShuffleTransport):
                     req.cancel()
             self._outstanding.clear()
         self.store.close()
+
+    def chaos_kill(self) -> None:
+        """Chaos-harness death hook (testing.faults.kill_executor): close the
+        store — its staging, spills, and replicas become unreachable, like a
+        dead process's memory — and report the loss to cluster membership, the
+        collective-plane analogue of a peer observing ECONNRESET."""
+        self.store.close()
+        self.cluster.membership.mark_dead(self.executor_id, "chaos kill_executor")
 
     def add_executor(self, executor_id: ExecutorId, address: bytes) -> None:
         # Single-controller mode: membership is the cluster's mesh; nothing to do.

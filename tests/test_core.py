@@ -189,12 +189,14 @@ class TestWireFrames:
     def test_am_ids_match_reference(self):
         # 0-4: Definitions.scala:22-29 verbatim.  5-6: striped-wire extensions
         # (FetchBlockChunk / WireHello, docs/SHIM_PROTOCOL.md), 7-8:
-        # replication extensions (ReplicaPut / ReplicaAck) — peer plane only,
-        # never emitted at wire.streams=1 / replication.factor=0, so reference
-        # parity holds for every frame a stock deployment sees.
-        assert [int(a) for a in AmId] == [0, 1, 2, 3, 4, 5, 6, 7, 8]
+        # replication extensions (ReplicaPut / ReplicaAck), 9-10: membership
+        # gossip (MemberSuspect / MemberRejoin) — peer plane only, never
+        # emitted at wire.streams=1 / replication.factor=0 / elastic off, so
+        # reference parity holds for every frame a stock deployment sees.
+        assert [int(a) for a in AmId] == [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
         assert AmId.FETCH_BLOCK_CHUNK == 5 and AmId.WIRE_HELLO == 6
         assert AmId.REPLICA_PUT == 7 and AmId.REPLICA_ACK == 8
+        assert AmId.MEMBER_SUSPECT == 9 and AmId.MEMBER_REJOIN == 10
 
 
 class TestConf:

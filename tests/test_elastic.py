@@ -1,0 +1,377 @@
+"""Elastic mesh: shrink/regrow with degraded-mode exchange recovery.
+
+Pins the PR's elasticity contracts end to end:
+
+* ``ClusterMembership`` — observation-driven liveness, local epochs,
+  debounced suspicion, idempotent transitions,
+* ``degraded_plan`` — pow2 shrink + wave decomposition invariants,
+* the headline chaos scenario: kill an executor MID-SUPERSTEP at
+  ``replication.factor=1`` and the shuffle completes on the surviving pow2
+  bucket with every block BIT-IDENTICAL to the no-fault run (stock and
+  pallas exchange impls, array and memmap receive modes),
+* the no-hang guarantee: factor=0 / elastic-off / double failure all raise
+  typed, addressed errors instead of stalling,
+* regrow: a rejoined executor restores the full mesh for the next shuffle,
+* membership gossip over the peer wire (MEMBER_SUSPECT / MEMBER_REJOIN),
+* the SPMD executor's fail-fast guard (degraded view -> typed error before
+  the lockstep collective).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.operation import (
+    BlockNotFoundError,
+    ExecutorLostError,
+)
+from sparkucx_tpu.parallel.membership import ClusterMembership
+from sparkucx_tpu.shuffle.resolver import degraded_plan
+from sparkucx_tpu.testing import faults
+from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# membership units
+# ---------------------------------------------------------------------------
+
+
+class TestClusterMembership:
+    def test_initial_state(self):
+        m = ClusterMembership(range(4))
+        assert m.epoch == 0 and not m.degraded
+        assert m.alive() == [0, 1, 2, 3] and m.dead() == {}
+
+    def test_mark_dead_bumps_epoch_once(self):
+        m = ClusterMembership(range(4))
+        assert m.mark_dead(2, "chaos")
+        assert m.epoch == 1 and m.degraded
+        assert not m.mark_dead(2, "again")  # idempotent: no re-bump
+        assert m.epoch == 1
+        assert m.dead() == {2: "chaos"}
+        assert m.alive() == [0, 1, 3]
+
+    def test_unknown_ids_absorbed(self):
+        m = ClusterMembership(range(2))
+        assert not m.mark_dead(9, "who?")
+        assert not m.mark_alive(9)
+        assert not m.suspect(9, "noise")
+        assert m.epoch == 0
+
+    def test_rejoin_bumps_epoch(self):
+        m = ClusterMembership(range(3))
+        m.mark_dead(1, "down")
+        assert m.mark_alive(1)
+        assert m.epoch == 2 and not m.degraded
+        assert not m.mark_alive(1)  # already alive
+        assert m.epoch == 2
+
+    def test_suspect_without_debounce_kills_first_error(self):
+        m = ClusterMembership(range(3), suspect_after_ms=0)
+        assert m.suspect(2, "RST")
+        assert m.dead() == {2: "RST"}
+
+    def test_suspect_debounce_window(self):
+        m = ClusterMembership(range(3), suspect_after_ms=10_000)
+        assert not m.suspect(2, "first error")  # inside the window
+        assert m.is_alive(2) and m.epoch == 0
+        assert not m.suspect(2, "second error, still inside")
+        assert m.is_alive(2)
+
+    def test_suspect_debounce_expiry_kills(self):
+        m = ClusterMembership(range(3), suspect_after_ms=20)
+        assert not m.suspect(2, "first")
+        time.sleep(0.05)
+        assert m.suspect(2, "persisted")
+        assert not m.is_alive(2)
+
+    def test_snapshot_is_consistent(self):
+        m = ClusterMembership(range(4))
+        m.mark_dead(3, "gone")
+        snap = m.snapshot()
+        assert snap == {"epoch": 1, "alive": [0, 1, 2], "dead": {3: "gone"}}
+
+
+# ---------------------------------------------------------------------------
+# degraded_plan units
+# ---------------------------------------------------------------------------
+
+
+class TestDegradedPlan:
+    def test_pow2_shrink(self):
+        m, phys, waves = degraded_plan(4, [0, 1, 3])
+        assert m == 2 and phys == [0, 1] and waves == 2
+
+    def test_exact_pow2_survivors(self):
+        m, phys, waves = degraded_plan(8, [0, 2, 4, 6])
+        assert m == 4 and phys == [0, 2, 4, 6] and waves == 2
+
+    def test_single_survivor(self):
+        m, phys, waves = degraded_plan(4, [2])
+        assert m == 1 and phys == [2] and waves == 4
+
+    def test_wave_count_covers_all_slots(self):
+        for n in (2, 4, 8):
+            for k in range(1, n + 1):
+                m, phys, waves = degraded_plan(n, list(range(k)))
+                assert m * waves >= n  # every wave slot is covered
+                assert len(phys) == m
+                assert m & (m - 1) == 0  # pow2
+
+    def test_no_survivors_raises(self):
+        from sparkucx_tpu.core.operation import TransportError
+
+        with pytest.raises(TransportError):
+            degraded_plan(4, [])
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill mid-superstep, recover on the shrunk mesh
+# ---------------------------------------------------------------------------
+
+
+def _run_shuffle(cluster, meta, shuffle_id, M, R, seed=7, kill=None, kill_round=1):
+    """Stage deterministic blocks, optionally arm a mid-superstep kill, run
+    the exchange, and return {(map, reduce): bytes} read from the reducers."""
+    rng = np.random.default_rng(seed)
+    oracle = {}
+    for m in range(M):
+        t = cluster.transport(meta.map_owner[m])
+        w = t.store.map_writer(shuffle_id, m)
+        for r in range(R):
+            payload = rng.integers(0, 256, size=2000, dtype=np.uint8).tobytes()
+            oracle[(m, r)] = payload
+            w.write_partition(r, payload)
+        t.commit_block(w.commit().pack())
+    try:
+        if kill is not None:
+            kills = kill if isinstance(kill, (list, tuple)) else [kill]
+
+            def die(**ctx):
+                for k in kills:
+                    faults.kill_executor(cluster.transport(k))
+
+            faults.arm(
+                "exchange.submit", die, times=1, match={"round": kill_round}
+            )
+        cluster.run_exchange(shuffle_id)
+    finally:
+        faults.reset()
+    blocks = {}
+    for (m, r) in oracle:
+        consumer = meta.owner_of_reduce(r)
+        view, length = cluster.locate_received_block(consumer, shuffle_id, m, r)
+        blocks[(m, r)] = bytes(view[:length])
+    assert blocks == oracle, "received blocks diverge from staged payloads"
+    return blocks
+
+
+def _mk_cluster(n=4, **conf_kw):
+    conf_kw.setdefault("staging_capacity_per_executor", n * 4096)
+    conf_kw.setdefault("block_alignment", 128)
+    conf_kw.setdefault("elastic", True)
+    conf_kw.setdefault("replication_factor", 1)
+    conf = TpuShuffleConf(num_executors=n, **conf_kw)
+    return TpuShuffleCluster(conf, num_executors=n)
+
+
+class TestElasticRecovery:
+    @pytest.mark.parametrize("impl", ["stock", "pallas"])
+    def test_kill_mid_superstep_bit_identical(self, impl):
+        """The acceptance scenario: baseline run vs killed-and-recovered run
+        must produce byte-identical blocks, for both exchange impls."""
+        n, M, R = 4, 12, 8
+        base_cluster = _mk_cluster(n, exchange_impl=impl)
+        meta = base_cluster.create_shuffle(0, M, R)
+        baseline = _run_shuffle(base_cluster, meta, 0, M, R)
+        assert base_cluster.elastic_stats["recoveries"] == 0
+
+        cluster = _mk_cluster(n, exchange_impl=impl)
+        meta = cluster.create_shuffle(0, M, R)
+        recovered = _run_shuffle(cluster, meta, 0, M, R, kill=2)
+        assert recovered == baseline
+        stats = cluster.elastic_stats
+        assert stats["recoveries"] == 1
+        assert stats["last_epoch"] == 1
+        m, phys = stats["degraded_mesh"]
+        assert m == 2 and 2 not in phys
+        assert stats["last_recovery_ms"] > 0
+
+    def test_kill_with_memmap_recv_mode(self):
+        n, M, R = 4, 12, 8
+        base = _run_shuffle(
+            (c := _mk_cluster(n, host_recv_mode="memmap")),
+            c.create_shuffle(0, M, R), 0, M, R,
+        )
+        cluster = _mk_cluster(n, host_recv_mode="memmap")
+        meta = cluster.create_shuffle(0, M, R)
+        assert _run_shuffle(cluster, meta, 0, M, R, kill=3) == base
+        assert cluster.elastic_stats["recoveries"] == 1
+
+    def test_factor_zero_raises_typed_no_hang(self):
+        cluster = _mk_cluster(4, replication_factor=0)
+        meta = cluster.create_shuffle(0, 12, 8)
+        with pytest.raises(ExecutorLostError) as ei:
+            _run_shuffle(cluster, meta, 0, 12, 8, kill=2)
+        assert ei.value.executor_id == 2
+        assert "replication.factor=0" in str(ei.value)
+        assert "2" in str(ei.value)  # names the lost executor
+
+    def test_elastic_off_raises_typed(self):
+        cluster = _mk_cluster(4, elastic=False)
+        meta = cluster.create_shuffle(0, 12, 8)
+        with pytest.raises(ExecutorLostError) as ei:
+            _run_shuffle(cluster, meta, 0, 12, 8, kill=2)
+        assert "elastic" in str(ei.value)
+
+    def test_double_failure_primary_and_replica(self):
+        """Killing an executor AND its ring successor (the only replica
+        holder at factor=1) is unrecoverable: a typed BlockNotFoundError
+        names the shuffle and every candidate tried — never a hang."""
+        cluster = _mk_cluster(4)
+        meta = cluster.create_shuffle(0, 12, 8)
+        with pytest.raises(BlockNotFoundError) as ei:
+            _run_shuffle(cluster, meta, 0, 12, 8, kill=[1, 2])
+        msg = str(ei.value)
+        assert "candidates [2]" in msg
+        assert "unrecoverable" in msg
+        assert ei.value.shuffle_id == 0
+
+    def test_regrow_restores_full_mesh(self):
+        """Kill -> shrunk completion -> rejoin -> the NEXT shuffle runs on
+        the full mesh again (no recovery, full-epoch exchange)."""
+        n, M, R = 4, 12, 8
+        cluster = _mk_cluster(n)
+        meta = cluster.create_shuffle(0, M, R)
+        _run_shuffle(cluster, meta, 0, M, R, kill=2)
+        assert cluster.elastic_stats["recoveries"] == 1
+        assert cluster.membership.alive() == [0, 1, 3]
+
+        # the executor comes back: fresh store on the same id
+        assert cluster.rejoin_executor(2)
+        assert cluster.membership.alive() == [0, 1, 2, 3]
+        epoch_after_rejoin = cluster.membership.epoch
+
+        meta2 = cluster.create_shuffle(1, M, R)
+        blocks = _run_shuffle(cluster, meta2, 1, M, R, seed=11)
+        assert len(blocks) == M * R
+        # full-mesh run: no new recovery, epoch unchanged
+        assert cluster.elastic_stats["recoveries"] == 1
+        assert cluster.membership.epoch == epoch_after_rejoin
+
+    def test_quota_engine_fails_fast_on_loss(self):
+        """The quota-capped engine has no degraded path: losing an executor
+        mid-run must raise the typed error, not hang in a stale plan."""
+        cluster = _mk_cluster(4, slot_quota_rows=4)
+        meta = cluster.create_shuffle(0, 12, 8)
+        with pytest.raises(ExecutorLostError) as ei:
+            _run_shuffle(cluster, meta, 0, 12, 8, kill=2)
+        assert "quota" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# membership gossip over the peer wire
+# ---------------------------------------------------------------------------
+
+
+class TestMembershipGossip:
+    def _wire_cluster(self, n=3, **conf_kw):
+        from sparkucx_tpu.transport.peer import PeerTransport
+
+        conf_kw.setdefault("staging_capacity_per_executor", 1 << 20)
+        conf = TpuShuffleConf(**conf_kw)
+        ts = [PeerTransport(conf, executor_id=i) for i in range(n)]
+        addrs = [t.init() for t in ts]
+        for t in ts:
+            t.membership = ClusterMembership(range(n))
+            for j, a in enumerate(addrs):
+                if j != t.executor_id:
+                    t.add_executor(j, a)
+        return ts, addrs
+
+    def test_wire_failure_gossips_suspicion(self):
+        from sparkucx_tpu.core.block import MemoryBlock
+
+        ts, _ = self._wire_cluster(3)
+        try:
+            faults.kill_executor(ts[2])
+            buf = MemoryBlock(np.zeros(64, dtype=np.uint8), size=64)
+            req = ts[0].fetch_block(2, 1, 0, 0, buf)
+            deadline = time.monotonic() + 5
+            while not req.completed() and time.monotonic() < deadline:
+                ts[0].progress()
+                time.sleep(0.002)
+            assert req.completed()
+            # the observer marked it dead...
+            assert not ts[0].membership.is_alive(2)
+            # ...and gossiped MEMBER_SUSPECT to the third executor
+            deadline = time.monotonic() + 3
+            while ts[1].membership.is_alive(2) and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not ts[1].membership.is_alive(2)
+            assert "wire failure" in ts[1].membership.dead()[2]
+        finally:
+            for t in ts:
+                t.close()
+
+    def test_rejoin_announcement_restores(self):
+        ts, _ = self._wire_cluster(3)
+        try:
+            for t in ts:
+                t.membership.mark_dead(2, "was down")
+            ts[2].announce_rejoin()
+            assert ts[2].membership.is_alive(2)
+            deadline = time.monotonic() + 3
+            while (
+                not (ts[0].membership.is_alive(2) and ts[1].membership.is_alive(2))
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert ts[0].membership.is_alive(2)
+            assert ts[1].membership.is_alive(2)
+        finally:
+            for t in ts:
+                t.close()
+
+    def test_rumors_about_self_ignored(self):
+        """A live executor is the authority on its own liveness: a gossiped
+        suspicion naming the receiver must not kill it locally."""
+        from sparkucx_tpu.core.definitions import AmId
+
+        ts, _ = self._wire_cluster(2)
+        try:
+            ts[1]._on_member_event(int(AmId.MEMBER_SUSPECT), 1, 1, 0)
+            assert ts[1].membership.is_alive(1)
+        finally:
+            for t in ts:
+                t.close()
+
+
+# ---------------------------------------------------------------------------
+# SPMD fail-fast guard
+# ---------------------------------------------------------------------------
+
+
+class TestSpmdDegradedGuard:
+    def test_degraded_view_fails_before_collective(self):
+        from sparkucx_tpu.transport.spmd import SpmdShuffleExecutor
+
+        ex = SpmdShuffleExecutor(TpuShuffleConf())
+        try:
+            ex.create_shuffle(0, 1, 1)
+            ex.membership.mark_dead(0, "chaos")
+            with pytest.raises(ExecutorLostError) as ei:
+                ex.run_exchange(0)
+            assert "SPMD" in str(ei.value)
+            assert ei.value.executor_id == 0
+        finally:
+            ex.close()

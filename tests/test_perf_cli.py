@@ -64,6 +64,19 @@ def test_failover_mode(capsys):
     assert "failovers" in out
 
 
+def test_elastic_mode(capsys):
+    # degraded-recovery sub-metric: full-mesh exchange vs killed-mid-superstep
+    # shrink/restage/re-run (bit-identical asserted inside the measurement)
+    benchmark.run_elastic(
+        benchmark._parse_args(["elastic", "--executors", "4", "-s", "4k", "-i", "1"])
+    )
+    out = capsys.readouterr().out
+    assert "elastic: steady" in out
+    assert "killed mid-superstep" in out
+    assert "recovery" in out
+    assert "mesh 4 -> 2" in out
+
+
 def test_cli_flags_match_reference():
     # -a/-f/-n/-s/-i/-o/-r/-t (UcxPerfBenchmark.scala:41-59)
     args = benchmark._parse_args(
