@@ -25,7 +25,10 @@ step (ops/sort.py), ``wire`` — the striped loopback peer wire (streams=1 vs 4,
 perf/benchmark.py measure_wire; TPU-free, measured after the TCP baseline),
 ``failover`` — executor-loss robustness (perf/benchmark.py measure_failover;
 TPU-free): steady loopback fetch GB/s vs GB/s with the primary executor killed
-at t=50%, plus recovery time and p99 frame stall.
+at t=50%, plus recovery time and p99 frame stall, ``compress`` — wire payload
+compression (perf/benchmark.py measure_compress; TPU-free): per-codec fetch
+GB/s and compression ratio on a dictionary-heavy matrix vs incompressible
+noise, plus an end-to-end compressed shuffle-read leg.
 
 A small end-to-end shuffle (stage -> commit -> exchange -> fetch vs oracle) runs
 untimed first as an integrity gate.
@@ -331,6 +334,31 @@ def main():
         }
     except Exception as e:
         RESULT["failover_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # 1d. Compression sub-metric — also TPU-free (loopback peer wire with the
+    # tier-(a) chunk codecs).  Reports ratio x effective GB/s, never ratio
+    # alone: a codec only counts if DECODED bytes per wall-second go up.
+    # Small sizes here (the recorded headline run lives in docs/PERF.md);
+    # every iteration is bit-compared against the source outside the clock.
+    try:
+        from sparkucx_tpu.perf.benchmark import measure_compress
+
+        comp = measure_compress(
+            num_blocks=4, block_bytes=4 << 20, iterations=3, e2e=True
+        )
+        RESULT["compress"] = {
+            name: {
+                codec: {
+                    k: round(cell[k], 3)
+                    for k in ("gbps", "ratio", "speedup_vs_off", "e2e_gbps")
+                    if k in cell
+                }
+                for codec, cell in cells.items()
+            }
+            for name, cells in comp.items()
+        }
+    except Exception as e:
+        RESULT["compress_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # 2. Bounded chip probe — never touch the backend in-process before this.
     platform, probe_err = probe_tpu(budget_left)

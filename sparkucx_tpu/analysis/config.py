@@ -121,6 +121,8 @@ DONATING_BUILDERS = {
     "build_ici_exchange": (0,),  # scheduled-ring exchange: same donation rule
     # fused send side fn(starts, counts, outs, packed, staging, sizes): staging
     "build_fused_ici_exchange": (4,),
+    "build_quantized_exchange": (0,),  # tier-b twin of build_ici_exchange
+    "build_quantized_fused_exchange": (4,),  # tier-b twin: staging donated
     "_exchange_fn": (0,),  # TpuShuffleCluster cache front-end for build_exchange
 }
 

@@ -138,6 +138,33 @@ class TpuShuffleConf:
     #: instead of silent bad bytes.  Default off: frames stay byte-identical
     #: to the golden captures the CI wire gate pins.
     wire_checksum: bool = False
+    #: Lossless wire compression codec for striped-wire chunk frames and
+    #: REPLICA_PUT bodies: 'off' (default) | 'dict' | 'rle' | 'delta'
+    #: (utils/pagecodec.py page formats).  The codec id and decoded length
+    #: ride as a chunk-header extension (core/definitions.py), each lane's
+    #: recv thread decodes independently into the chunk's final buffer
+    #: offset, and unprofitable pages fall back to raw per chunk — lossless
+    #: always, bit-identical shuffle results.  Composes with wire_checksum
+    #: (crc covers the encoded bytes) and the CreditGate (credits account
+    #: DECODED bytes — the reader admits windows by expected block sizes,
+    #: which are decoded sizes; wire savings show up as faster drains, not
+    #: looser admission).  Default off: frames stay byte-identical to the
+    #: golden captures the CI wire gate pins.
+    wire_compress_codec: str = "off"
+    #: Pages smaller than this ship raw without attempting encode — below a
+    #: few KiB the codec header + python-call overhead beats any shrink.
+    compress_min_chunk_bytes: int = 4096
+    #: Lossy block quantization of aggregate-tolerant ICI exchange payloads
+    #: (ops/relational.py groupby partials; ops/ici_exchange.py quantized
+    #: builders): 'off' (default) | 'int8' (linear scale per block) |
+    #: 'blockfloat' (power-of-two shared exponent per block).  OPT-IN LOSSY:
+    #: float aggregate lanes travel as int8 (4x fewer exchange bytes) with a
+    #: per-block scale, bounding relative error at ~amax/254 per block; keys
+    #: and counts are never quantized.  'off' is exactly the stock path.
+    quantize_mode: str = "off"
+    #: Quantization block width (values per scale block along the row), a
+    #: multiple of 4 (int8x4-in-int32 packing granularity).
+    quantize_block_size: int = 128
     #: Elastic mesh recovery (transport/tpu.py): when an executor dies
     #: mid-exchange, abort the in-flight round, shrink the mesh to the
     #: surviving pow2 bucket, restage the dead executor's rounds from its
@@ -330,6 +357,10 @@ class TpuShuffleConf:
             ("fetch.deadlineMs", "fetch_deadline_ms", int),
             ("fetch.backoffMs", "fetch_backoff_ms", int),
             ("wire.checksum", "wire_checksum", lambda v: str(v).lower() == "true"),
+            ("compress.codec", "wire_compress_codec", str),
+            ("compress.minChunkBytes", "compress_min_chunk_bytes", parse_size),
+            ("quantize.mode", "quantize_mode", str),
+            ("quantize.blockSize", "quantize_block_size", int),
             ("elastic.enabled", "elastic", lambda v: str(v).lower() == "true"),
             ("membership.suspectAfterMs", "membership_suspect_after_ms", int),
             ("blockAlignment", "block_alignment", parse_size),
@@ -407,6 +438,14 @@ class TpuShuffleConf:
             raise ValueError("membership_suspect_after_ms must be >= 0")
         if self.replication_max_backlog_bytes < 0:
             raise ValueError("replication_max_backlog_bytes must be >= 0 (0 = unbounded)")
+        if self.wire_compress_codec not in ("off", "dict", "rle", "delta"):
+            raise ValueError(f"unknown wire_compress_codec {self.wire_compress_codec!r}")
+        if self.compress_min_chunk_bytes < 0:
+            raise ValueError("compress_min_chunk_bytes must be >= 0")
+        if self.quantize_mode not in ("off", "int8", "blockfloat"):
+            raise ValueError(f"unknown quantize_mode {self.quantize_mode!r}")
+        if self.quantize_block_size <= 0 or self.quantize_block_size % 4:
+            raise ValueError("quantize_block_size must be a positive multiple of 4")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

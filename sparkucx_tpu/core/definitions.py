@@ -118,6 +118,40 @@ def unpack_chunk_hdr(data) -> Tuple[int, int, int, int]:
     return _CHUNK_HDR.unpack_from(data)
 
 
+#: FetchBlockChunk / ReplicaPut header extensions, detected by header length
+#: on the receiving side so mixed-config peers interoperate (same mechanism as
+#: the crc32c trailer, config.py ``wire_checksum``).  Chunk header layouts:
+#:
+#: ====================  =====================================================
+#: 24 (base)             plain chunk, payload = raw slice
+#: 28 (base+crc)         + u32 crc32c trailer over the WIRE payload
+#: 32 (base+codec)       + (u32 codec_id, u32 raw_len): payload is the page
+#:                       encoded under codec_id (utils/pagecodec.py) and
+#:                       expands to raw_len bytes at (block, offset)
+#: 36 (base+codec+crc)   codec ext first, crc trailer LAST — the crc covers
+#:                       the ENCODED payload, so corruption is detected
+#:                       before the decoder ever parses the page
+#: ====================  =====================================================
+#:
+#: ReplicaPut reuses the same two extensions after its entry table, same
+#: order (codec ext, then crc), detected by the residue of
+#: ``len(header) - REPLICA_HEADER_SIZE`` modulo ``REPLICA_ENTRY_SIZE``
+#: (entries are 16 B; residues 0/4/8/12 = plain/crc/codec/codec+crc).
+#: When a server's codec is on, EVERY chunk carries the codec ext —
+#: unprofitable pages ship ``codec_id = 0`` (raw) with ``raw_len`` equal to
+#: the payload length, keeping the header length uniform per reply.
+_CHUNK_CODEC = struct.Struct("<II")
+CHUNK_CODEC_EXT_SIZE = _CHUNK_CODEC.size
+
+
+def pack_chunk_codec_ext(codec_id: int, raw_len: int) -> bytes:
+    return _CHUNK_CODEC.pack(codec_id, raw_len)
+
+
+def unpack_chunk_codec_ext(data, offset: int = 0) -> Tuple[int, int]:
+    return _CHUNK_CODEC.unpack_from(data, offset)
+
+
 def pack_wire_hello(group: int, lane: int, nlanes: int, chunk_bytes: int) -> bytes:
     return _HELLO.pack(group, lane, nlanes, chunk_bytes)
 

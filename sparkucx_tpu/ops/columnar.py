@@ -18,6 +18,14 @@ Like ops/exchange.py it has two bit-identical lowerings (``ragged`` for TPU,
 ``dense`` for backends without a ragged-all-to-all kernel), selected the same
 way.  Layout here is *tight* (rows contiguous after the sort), not slot —
 there are no pre-carved regions to respect.
+
+Payload reduction (ops/compress.py) composes with this module on both rails:
+rows that spill to the striped TCP wire travel through the per-chunk lossless
+codec transparently (``compress.codec`` — the transport encodes/decodes at
+the chunk layer, so shuffled bytes are bit-identical either way), and the
+partial-aggregate exchange built on these shuffles (ops/relational.py) can
+opt into lossy block quantization of its float value lanes
+(``quantize.mode``); keys travel bitcast and are never quantized.
 """
 
 from __future__ import annotations

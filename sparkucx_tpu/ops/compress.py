@@ -1,0 +1,223 @@
+"""Payload-reduction layer for the data plane: lossless page codecs + lossy
+block quantization (ROADMAP item 2 — send fewer bytes, keep the GB/s).
+
+Two independent tiers, both default OFF with the off-paths byte-identical:
+
+**Tier (a) — lossless wire compression** (:class:`CompressSpec`).  The striped
+TCP wire's chunk frames are self-addressing, so each chunk is a *page* that
+encodes and decodes independently (utils/pagecodec.py formats).  The server
+encodes per chunk (falling back to raw when a page doesn't shrink), the codec
+id + decoded length ride a chunk-header extension (core/definitions.py), and
+each lane's recv thread decodes straight into the chunk's final buffer offset
+— transport/peer.py owns the wiring, this module owns the policy (which
+codec, the min-page gate).  Lossless always: shuffle results are
+bit-identical, pinned by tests/test_compress.py.
+
+**Tier (b) — lossy opt-in block quantization** (:class:`QuantizeSpec`).
+Aggregate-tolerant float exchange payloads (groupby/join partials,
+ops/relational.py) travel as int8 with one float32 scale per ``block_size``
+values — 4x fewer ICI bytes per float lane, the EQuARX argument (PAPERS.md,
+arXiv:2506.17615) applied to the shuffle's partial-aggregate exchange.  The
+quantize step fuses into the exchange send side and dequantize into the
+receive path (ops/ici_exchange.py quantized builders), so staging→wire stays
+one launch.  Error is bounded per block: ``int8`` uses a linear scale
+(|err| <= amax/254), ``blockfloat`` a power-of-two shared exponent
+(|err| <= amax/127, but scales are exact binary — no scale rounding).  Keys
+and counts are NEVER quantized; ``mode='off'`` is exactly the stock path.
+
+Quantized row layout (all int32, so the payload rides the existing int32
+exchange machinery unchanged): for a float row of width ``w`` and block size
+``B`` (multiple of 4), ``wq = ceil(w/B)*B`` padded values pack 4 int8 per
+int32 word — ``wq//4`` words — followed by ``nb = wq//B`` per-block float32
+scales bitcast to int32 (the same bit-preserving transit trick the groupby
+count lane uses).  Total ``quantized_width(w) = wq//4 + nb`` lanes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from sparkucx_tpu.utils.pagecodec import (
+    CODEC_RAW,
+    WIRE_CODECS,
+    encode_page,
+)
+
+QUANTIZE_MODES = ("off", "int8", "blockfloat")
+
+
+# ----------------------------------------------------------------------------
+# Tier (a): lossless wire compression policy
+# ----------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CompressSpec:
+    """Static description of the wire compression policy (tier a).
+
+    ``codec``: 'off' | 'dict' | 'rle' | 'delta' (conf ``compress.codec``).
+    ``min_chunk_bytes``: pages smaller than this ship raw without attempting
+    an encode — below a few KiB the header + call overhead beats any shrink.
+    """
+
+    codec: str = "off"
+    min_chunk_bytes: int = 4096
+
+    @classmethod
+    def from_conf(cls, conf) -> "CompressSpec":
+        spec = cls(
+            codec=conf.wire_compress_codec,
+            min_chunk_bytes=conf.compress_min_chunk_bytes,
+        )
+        spec.validate()
+        return spec
+
+    def validate(self) -> None:
+        if self.codec != "off" and self.codec not in WIRE_CODECS:
+            raise ValueError(f"unknown compress codec {self.codec!r}")
+        if self.min_chunk_bytes < 0:
+            raise ValueError("min_chunk_bytes must be >= 0")
+
+    @property
+    def enabled(self) -> bool:
+        return self.codec != "off"
+
+    @property
+    def codec_id(self) -> int:
+        return WIRE_CODECS[self.codec] if self.enabled else CODEC_RAW
+
+
+def encode_chunk(spec: CompressSpec, data) -> Tuple[int, Optional[bytes]]:
+    """Encode one wire page under ``spec``.
+
+    Returns ``(codec_id, encoded)``; ``encoded is None`` means "ship the raw
+    slice" (codec off, page under the min-size gate, or encoding didn't
+    shrink it) and the returned codec id is :data:`CODEC_RAW`."""
+    if not spec.enabled or len(data) < spec.min_chunk_bytes:
+        return CODEC_RAW, None
+    encoded = encode_page(spec.codec_id, data)
+    if encoded is None:
+        return CODEC_RAW, None
+    return spec.codec_id, encoded
+
+
+# ----------------------------------------------------------------------------
+# Tier (b): lossy block quantization (jax, fuses into the exchange jit)
+# ----------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class QuantizeSpec:
+    """Static description of the lossy quantization policy (tier b).
+
+    ``mode``: 'off' | 'int8' | 'blockfloat' (conf ``quantize.mode``).
+    ``block_size``: values per scale block along the row; multiple of 4
+    (int8x4-in-int32 packing granularity)."""
+
+    mode: str = "off"
+    block_size: int = 128
+
+    @classmethod
+    def from_conf(cls, conf) -> "QuantizeSpec":
+        spec = cls(mode=conf.quantize_mode, block_size=conf.quantize_block_size)
+        spec.validate()
+        return spec
+
+    def validate(self) -> None:
+        if self.mode not in QUANTIZE_MODES:
+            raise ValueError(f"unknown quantize mode {self.mode!r}")
+        if self.block_size <= 0 or self.block_size % 4:
+            raise ValueError("quantize block_size must be a positive multiple of 4")
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    def padded_width(self, w: int) -> int:
+        """Float width padded up to a whole number of blocks."""
+        return -(-w // self.block_size) * self.block_size
+
+    def num_blocks(self, w: int) -> int:
+        return self.padded_width(w) // self.block_size
+
+    def quantized_width(self, w: int) -> int:
+        """int32 lanes of the quantized payload: packed int8 words + scales."""
+        return self.padded_width(w) // 4 + self.num_blocks(w)
+
+    def error_bound(self, amax: float) -> float:
+        """Per-element absolute error bound for a block whose max |value| is
+        ``amax`` — the dequant-tolerance gate tests assert against this."""
+        if self.mode == "int8":
+            return amax / 254.0  # scale = amax/127, round error <= scale/2
+        if self.mode == "blockfloat":
+            return amax / 127.0  # scale <= 2*amax/127 (pow2 ceil), err <= scale/2
+        return 0.0
+
+
+def _block_scales(spec: QuantizeSpec, amax):
+    # jax imports are function-local throughout tier (b) so the host-only
+    # transport (transport/peer.py) can import the tier-(a) policy above
+    # without pulling jax into every peer process
+    import jax.numpy as jnp
+
+    if spec.mode == "int8":
+        return jnp.where(amax > 0, amax / 127.0, 1.0)
+    # blockfloat: power-of-two shared exponent — scales carry no mantissa
+    # error and the int8 payload divides exactly by a binary shift
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    return jnp.where(amax > 0, jnp.exp2(jnp.ceil(jnp.log2(s))), 1.0)
+
+
+def quantize_rows(spec: QuantizeSpec, x):
+    """Quantize float32 rows ``(rows, w)`` -> int32 ``(rows, quantized_width(w))``.
+
+    Row-independent (each row carries its own block scales), so quantized
+    rows survive any permutation/compaction the exchange applies before
+    :func:`dequantize_rows` runs on the receive side."""
+    import jax
+    import jax.numpy as jnp
+
+    spec.validate()
+    if not spec.enabled:
+        raise ValueError("quantize_rows called with mode='off'")
+    rows, w = x.shape
+    wq = spec.padded_width(w)
+    nb = spec.num_blocks(w)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (0, wq - w)))
+    blocks = xp.reshape(rows, nb, spec.block_size)
+    amax = jnp.max(jnp.abs(blocks), axis=2)
+    scale = _block_scales(spec, amax)
+    q = jnp.clip(jnp.round(blocks / scale[:, :, None]), -127, 127).astype(jnp.int32)
+    qb = q.reshape(rows, wq // 4, 4) & 0xFF
+    packed = qb[..., 0] | (qb[..., 1] << 8) | (qb[..., 2] << 16) | (qb[..., 3] << 24)
+    scales_i32 = jax.lax.bitcast_convert_type(scale.astype(jnp.float32), jnp.int32)
+    return jnp.concatenate([packed, scales_i32], axis=1)
+
+
+def dequantize_rows(spec: QuantizeSpec, payload, w: int):
+    """Inverse of :func:`quantize_rows`: int32 ``(rows, quantized_width(w))``
+    -> float32 ``(rows, w)``.  Zero-filled payload rows (unreceived slots)
+    dequantize to zero rows — scale words of 0 bitcast to 0.0 and multiply a
+    zero int8 payload, so compacted tails stay zeros like the stock path."""
+    import jax
+    import jax.numpy as jnp
+
+    spec.validate()
+    if not spec.enabled:
+        raise ValueError("dequantize_rows called with mode='off'")
+    rows, qw = payload.shape
+    wq = spec.padded_width(w)
+    nb = spec.num_blocks(w)
+    if qw != wq // 4 + nb:
+        raise ValueError(
+            f"payload width {qw} != quantized_width({w}) = {wq // 4 + nb}"
+        )
+    packed = payload[:, : wq // 4]
+    scale = jax.lax.bitcast_convert_type(payload[:, wq // 4 :], jnp.float32)
+    shifts = jnp.array([0, 8, 16, 24], jnp.int32)
+    b = (packed[..., None] >> shifts) & 0xFF
+    b = jnp.where(b >= 128, b - 256, b)  # sign-extend int8
+    q = b.reshape(rows, nb, spec.block_size).astype(jnp.float32)
+    x = q * scale[:, :, None]
+    return x.reshape(rows, wq)[:, :w]

@@ -41,6 +41,7 @@ from sparkucx_tpu.ops.columnar import (
     shard_rows_host,
     unpack_shard_prefixes,
 )
+from sparkucx_tpu.ops.compress import QuantizeSpec, dequantize_rows, quantize_rows
 from sparkucx_tpu.ops.exchange import exclusive_cumsum
 
 #: Padding sort key (sorts last) — ops/sort.py's sentinel, same discipline:
@@ -178,10 +179,27 @@ class AggregateSpec:
     #: Results are bit-identical for integer dtypes (int32 adds associate);
     #: 'count_distinct' is rejected (distinct counts do not compose by sum).
     partial: bool = False
+    #: OPT-IN LOSSY tier-b payload reduction (ops/compress.py, conf
+    #: ``quantize.mode``): 'off' | 'int8' | 'blockfloat'.  Block-quantizes the
+    #: PARTIAL-aggregate float value columns around the exchange — quantize
+    #: after the map-side reduce, ship int8x4-packed words (bitcast through
+    #: the float lane, the count lane's transit trick), dequantize before the
+    #: final merge.  Requires ``partial=True`` and a floating ``dtype``; keys
+    #: and counts are NEVER quantized, so group identity and COUNT stay
+    #: exact.  Per-partial-row error is bounded by
+    #: ``QuantizeSpec.error_bound`` per block of ``quantize_block_size``.
+    quantize_mode: str = "off"
+    quantize_block_size: int = 128
 
     @property
     def width(self) -> int:
         return len(self.aggs)
+
+    @property
+    def qspec(self) -> QuantizeSpec:
+        return QuantizeSpec(
+            mode=self.quantize_mode, block_size=self.quantize_block_size
+        )
 
     @classmethod
     def from_conf(cls, conf, **kwargs) -> "AggregateSpec":
@@ -197,7 +215,22 @@ class AggregateSpec:
         kwargs.setdefault("partial", bool(conf.partial_aggregation))
         kwargs.setdefault("num_executors", conf.num_executors)
         kwargs.setdefault("axis_name", conf.mesh_axis_name)
-        return cls(**kwargs)
+        explicit_quantize = "quantize_mode" in kwargs
+        kwargs.setdefault("quantize_mode", conf.quantize_mode)
+        kwargs.setdefault("quantize_block_size", conf.quantize_block_size)
+        spec = cls(**kwargs)
+        if (
+            not explicit_quantize
+            and spec.quantize_mode != "off"
+            and not (
+                spec.partial and np.issubdtype(np.dtype(spec.dtype), np.floating)
+            )
+        ):
+            # the conf knob is cluster-global; plans it cannot apply to
+            # (non-partial, integer dtypes — exactness is the contract there)
+            # silently keep the stock path instead of failing validate()
+            spec = replace(spec, quantize_mode="off")
+        return spec
 
     def resolve_impl(self, platform: Optional[str] = None) -> "AggregateSpec":
         if self.impl != "auto":
@@ -219,6 +252,18 @@ class AggregateSpec:
                 "count_distinct cannot use partial aggregation (per-shard "
                 "distinct counts do not compose by sum); use partial=False"
             )
+        if self.quantize_mode != "off":
+            self.qspec.validate()
+            if not self.partial:
+                raise ValueError(
+                    "quantization rides the partial-aggregate exchange; "
+                    "set partial=True (raw-row exchanges are never quantized)"
+                )
+            if not np.issubdtype(np.dtype(self.dtype), np.floating):
+                raise ValueError(
+                    "quantization needs a floating value dtype — integer "
+                    "aggregates are exact by contract and stay unquantized"
+                )
 
 
 def _agg_identity(agg: str, dtype) -> jnp.ndarray:
@@ -329,6 +374,7 @@ def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
         valid &= mask
 
     counts = None
+    qspec = spec.qspec if (spec.partial and spec.quantize_mode != "off") else None
     if spec.partial:
         # Map-side partial aggregation (HashAggregateExec(partial) below the
         # Exchange): reduce locally first, then exchange one row per local
@@ -339,16 +385,24 @@ def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
             spec.aggs, cap, keys, values, valid, tight=(mask is None)
         )
         keys = lk
+        if qspec is not None:
+            # tier-b lossy opt-in: quantize the partial value columns on the
+            # send side; the packed int32 payload bitcasts through the float
+            # dtype lane (bit-preserving — the exchange only moves rows)
+            lv = jax.lax.bitcast_convert_type(quantize_rows(qspec, lv), spec.dtype)
         values = jnp.concatenate(
             [lv, jax.lax.bitcast_convert_type(lc, spec.dtype)[:, None]], axis=1
         )
         valid = idx < lng
 
+    payload_width = (
+        qspec.quantized_width(spec.width) if qspec is not None else spec.width
+    )
     cspec = ColumnarSpec(
         num_executors=spec.num_executors,
         capacity=cap,
         recv_capacity=spec.recv_capacity,
-        width=spec.width + (2 if spec.partial else 1),
+        width=payload_width + (2 if spec.partial else 1),
         dtype=spec.dtype,
         axis_name=spec.axis_name,
         impl=spec.impl,
@@ -357,6 +411,12 @@ def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
     if spec.partial:
         counts = jax.lax.bitcast_convert_type(rvals[:, -1], jnp.int32)
         rvals = rvals[:, :-1]
+        if qspec is not None:
+            # receive side: dequantize before the final merge (zero-filled
+            # buffer tails dequantize to zero rows; rvalid masks them anyway)
+            rvals = dequantize_rows(
+                qspec, jax.lax.bitcast_convert_type(rvals, jnp.int32), spec.width
+            ).astype(spec.dtype)
 
     # Final GROUP BY on the received (raw or partial) rows: sum/min/max/avg
     # compose with themselves, counts compose by sum.

@@ -31,6 +31,14 @@ Modes:
   Prints GB/s, receive syscalls/MB, and p99 frame stall per streams value;
   ``--streams 1`` is the byte-identical pre-striping wire, so it doubles as
   the before/after baseline.
+* ``compress`` — tier-(a)/(b) payload reduction, ratio x GB/s: loopback fetch
+  throughput at codec in {off, dict, rle, delta} on a dictionary-heavy
+  (clustered low-cardinality u32 keys) and an incompressible matrix, with
+  bit-equality asserted on EVERY lossless pass and compression ratio /
+  encoded-chunk-pool hits from the server's ``compress_stats``; an
+  end-to-end ``TpuShuffleReader`` pass per codec (credit gate budgets
+  decoded bytes); and, when >= 2 devices are up, the quantized-vs-f32 ICI
+  exchange (int8 / blockfloat) with the dequant error bound asserted.
 * ``failover`` — executor-loss robustness under traffic: a 3-executor
   loopback cluster with ``replication.factor = 1`` (seal pushes every round
   to the ring neighbor), a reducer streaming -n blocks of -s bytes from the
@@ -107,7 +115,7 @@ def _parse_args(argv):
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "wire", "ici",
-            "failover", "elastic",
+            "failover", "elastic", "compress",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -393,6 +401,294 @@ def measure_wire(
     finally:
         server.close()
     return results
+
+
+#: ``measure_compress`` payload matrices.  "dictkeys" is the dictionary-heavy
+#: shape the tier-(a) codecs target: a low-cardinality u32 key column laid out
+#: clustered (map-side combine emits key-grouped rows), so dict sees a
+#: 256-entry alphabet (4x) and word-RLE sees the runs.  "noise" is the
+#: incompressible floor: every codec must detect it, ship raw, and cost ~0.
+def _compress_matrices(block_bytes: int, rng) -> dict:
+    words = block_bytes // 4
+    alpha = rng.integers(0, 2**32, size=256, dtype=np.uint32)
+    dictkeys = np.repeat(alpha, (words + 255) // 256)[:words]
+    dictkeys = dictkeys.astype("<u4").tobytes().ljust(block_bytes, b"\0")
+    noise = rng.integers(0, 256, size=block_bytes, dtype=np.uint8).tobytes()
+    return {"dictkeys": dictkeys, "noise": noise}
+
+
+def _compress_e2e(
+    codec: str, payload: bytes, num_blocks: int, iterations: int, report=None
+) -> float:
+    """End-to-end shuffle GB/s at one codec: store-staged blocks on executor 1
+    streamed back through a credit-gated ``TpuShuffleReader`` on executor 0
+    (the CreditGate budgets DECODED bytes, so this leg exercises exactly the
+    composition the wire-level fetch loop does not).  Returns best GB/s;
+    every pass asserts bit-equality against the staged payload."""
+    from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+
+    block_bytes = len(payload)
+    conf = TpuShuffleConf(
+        wire_compress_codec=codec,
+        wire_timeout_ms=10_000,
+        staging_capacity_per_executor=num_blocks * block_bytes + (1 << 20),
+    )
+    ts = [PeerTransport(conf, executor_id=i) for i in (0, 1)]
+    addrs = [t.init() for t in ts]
+    ts[0].add_executor(1, addrs[1])
+    ts[1].add_executor(0, addrs[0])
+    total = num_blocks * block_bytes
+    try:
+        ts[1].store.create_shuffle(0, 1, num_blocks)
+        w = ts[1].store.map_writer(0, 0)
+        for r in range(num_blocks):
+            w.write_partition(r, payload)
+        w.commit()
+        ts[1].store.seal(0)
+
+        def consume() -> float:
+            reader = TpuShuffleReader(
+                ts[0],
+                executor_id=0,
+                shuffle_id=0,
+                start_partition=0,
+                end_partition=num_blocks,
+                num_mappers=1,
+                block_sizes=lambda m, r: block_bytes,
+                sender_of=lambda m: 1,
+                # several windows in flight under the credit budget: credits
+                # meter DECODED bytes, so this is the codec x CreditGate
+                # composition path, not just the raw fetch loop
+                max_blocks_per_request=2,
+                credit_bytes=64 << 20,
+            )
+            t0 = time.perf_counter()
+            blocks = []
+            for blk in reader.fetch_blocks():
+                blocks.append(blk)
+            dt = time.perf_counter() - t0
+            assert len(blocks) == num_blocks
+            for blk in blocks:  # lossless contract: checked OUTSIDE the clock
+                assert bytes(blk.data) == payload, f"e2e codec={codec} corrupted"
+                blk.release()
+            return dt
+
+        consume()  # warmup: connect + populate the server's encode pool
+        best = 0.0
+        for it in range(iterations):
+            dt = consume()
+            best = max(best, total / dt / 1e9)
+            if report is not None:
+                report(f"e2e:{codec}", it, dt, total)
+        return best
+    finally:
+        for t in ts:
+            t.close()
+
+
+def measure_compress(
+    codecs=("off", "dict", "rle", "delta"),
+    num_blocks: int = 8,
+    block_bytes: int = 8 << 20,
+    iterations: int = 5,
+    chunk_bytes: int = 4 << 20,
+    streams: int = 1,
+    e2e: bool = True,
+    report=None,
+) -> dict:
+    """Measurement core of the ``compress`` mode — loopback fetch throughput
+    with the tier-(a) wire codecs, ratio x GB/s (never ratio alone).
+
+    Per (matrix, codec): a fresh codec-configured server registers
+    ``num_blocks`` blocks of the matrix, a fresh client streams the set per
+    iteration, and EVERY iteration's buffers are compared byte-for-byte
+    against the source (the lossless contract is asserted, not assumed —
+    outside the timed region).  The first (warmup) pass also charges the
+    server's encoded-chunk pool, so timed passes measure the steady serve
+    state: sealed blocks are immutable, each chunk pays the encoder once per
+    lifetime, not once per fetch.  Results per cell: best/mean effective GB/s
+    (DECODED bytes over the wall clock), compression ratio and wire bytes
+    from the server's ``compress_stats``, and pool hit count.  ``e2e`` adds a
+    store-staged ``TpuShuffleReader`` pass per codec on the dictionary-heavy
+    matrix (credit gate budgets decoded bytes).  ``report(label, it, seconds,
+    bytes)`` per iteration.  Shared by the CLI and bench.py."""
+    rng = np.random.default_rng(0)
+    matrices = _compress_matrices(block_bytes, rng)
+    total = num_blocks * block_bytes
+    results: dict = {name: {} for name in matrices}
+    for name, payload in matrices.items():
+        for codec in codecs:
+            server = PeerTransport(
+                TpuShuffleConf(wire_compress_codec=codec), executor_id=0
+            )
+            addr = server.init()
+            bids = [ShuffleBlockId(0, 0, i) for i in range(num_blocks)]
+            for bid in bids:
+                server.register(bid, BytesBlock(payload))
+            client = PeerTransport(
+                TpuShuffleConf(
+                    wire_compress_codec=codec,
+                    wire_streams=streams,
+                    wire_chunk_bytes=chunk_bytes,
+                    max_blocks_per_request=num_blocks,
+                ),
+                executor_id=1,
+            )
+            client.add_executor(0, addr)
+            try:
+                bufs = [
+                    MemoryBlock(np.zeros(block_bytes, dtype=np.uint8), size=block_bytes)
+                    for _ in range(num_blocks)
+                ]
+
+                def fetch_once():
+                    reqs = client.fetch_blocks_by_block_ids(
+                        0, bids, bufs, [None] * num_blocks
+                    )
+                    while not all(r.completed() for r in reqs):
+                        client.progress()
+                        client.wait_for_activity(0.002)
+                    for r in reqs:
+                        res = r.wait(1)
+                        assert res.status == OperationStatus.SUCCESS, str(res.error)
+
+                fetch_once()  # warmup: connect + charge the encode pool
+                best = 0.0
+                t_all0 = time.perf_counter()
+                wall = 0.0
+                for it in range(iterations):
+                    t0 = time.perf_counter()
+                    fetch_once()
+                    dt = time.perf_counter() - t0
+                    wall += dt
+                    best = max(best, total / dt / 1e9)
+                    if report is not None:
+                        report(f"{name}:{codec}", it, dt, total)
+                    for b in bufs:  # bit-equality EVERY lossless run
+                        got = b.host_view().tobytes()
+                        assert got == payload, (
+                            f"lossless fetch diverged: matrix={name} codec={codec}"
+                        )
+                st = server.server.compress_snapshot()
+                cell = {
+                    "gbps": best,
+                    "mean_gbps": total * iterations / max(wall, 1e-9) / 1e9,
+                    "ratio": st["raw_bytes"] / max(st["wire_bytes"], 1),
+                    "wire_bytes": st["wire_bytes"],
+                    "raw_bytes": st["raw_bytes"],
+                    "encoded_chunks": st["encoded_chunks"],
+                    "raw_chunks": st["raw_chunks"],
+                    "pool_hits": st["cache_hits"],
+                }
+            finally:
+                client.close()
+                server.close()
+            if e2e and name == "dictkeys":
+                cell["e2e_gbps"] = _compress_e2e(
+                    codec, payload, num_blocks, iterations, report=report
+                )
+            results[name][codec] = cell
+    for name in results:
+        base = results[name].get("off", {}).get("gbps")
+        if base:
+            for codec, cell in results[name].items():
+                cell["speedup_vs_off"] = cell["gbps"] / base
+    return results
+
+
+def measure_quantized_ici(
+    num_executors: int = 4,
+    slot_rows: int = 1024,
+    lane: int = 128,
+    iterations: int = 5,
+    modes=("int8", "blockfloat"),
+    report=None,
+) -> dict:
+    """Tier-(b) leg of the ``compress`` mode — quantized vs f32 ICI exchange.
+
+    Builds the stock f32 exchange (float rows bitcast through the int32 lane)
+    and ``build_quantized_exchange`` per mode over the same mesh, feeds both
+    identical seeded payloads, asserts the dequantized result within the
+    spec's per-block error bound (exact for the row sizes/counts), and times
+    chained donated iterations.  Effective GB/s counts the LOGICAL f32 bytes
+    delivered, so the quantized rows' win is wire-bytes (reported as
+    ``wire_reduction``) showing up as throughput.  Requires >= 2 devices."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops.compress import QuantizeSpec
+    from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange, make_mesh
+    from sparkucx_tpu.ops.ici_exchange import build_quantized_exchange
+
+    avail = jax.device_count()
+    n = min(num_executors, avail)
+    if n < 2:
+        raise RuntimeError(f"quantized ici leg needs >=2 devices (have {avail})")
+    slot = slot_rows
+    send_rows = n * slot
+    spec = ExchangeSpec(
+        num_executors=n, send_rows=send_rows, recv_rows=send_rows, lane=lane
+    )
+    mesh = make_mesh(n)
+    sharding = NamedSharding(mesh, P("ex", None))
+    stock = build_exchange(mesh, spec)
+
+    rng = np.random.default_rng(11)
+    sizes_host = rng.integers(1, slot + 1, size=(n, n)).astype(np.int32)
+    data_f32 = rng.standard_normal((n * send_rows, lane), dtype=np.float32)
+    sizes = jax.device_put(sizes_host, sharding)
+    remote_bytes = n * (n - 1) * slot * lane * 4
+
+    def time_impl(label, fn, make_data):
+        best = 0.0
+        for it in range(iterations):
+            data = jax.device_put(make_data(), sharding)
+            t0 = time.perf_counter()
+            cur = data
+            for _ in range(4):  # chained: donation recycles the buffer
+                cur, _ = fn(cur, sizes)
+            jax.block_until_ready(cur)
+            dt = time.perf_counter() - t0
+            best = max(best, 4 * remote_bytes / dt / 1e9)
+            if report is not None:
+                report(label, n, it, dt, 4 * remote_bytes)
+        return best
+
+    # oracle: the exact f32 rows every mode must approximate
+    ref, ref_sizes = stock(
+        jax.device_put(data_f32.view(np.int32), sharding), sizes
+    )
+    ref = np.asarray(ref).view(np.float32)
+    ref_sizes = np.asarray(ref_sizes)
+    stock_gbps = time_impl(
+        "f32", stock, lambda: data_f32.view(np.int32)
+    )
+    out: dict = {"n": n, "f32_gbps": stock_gbps, "modes": {}}
+    for mode in modes:
+        q = QuantizeSpec(mode=mode, block_size=128)
+        qfn = build_quantized_exchange(mesh, spec, q)
+        got, got_sizes = qfn(jax.device_put(data_f32, sharding), sizes)
+        got = np.asarray(got)
+        assert np.array_equal(np.asarray(got_sizes), ref_sizes), (
+            f"quantized exchange sizes diverged ({mode})"
+        )
+        bound = q.error_bound(float(np.abs(data_f32).max()))
+        err = float(np.abs(got - ref).max())
+        assert err <= bound + 1e-7, (
+            f"dequant error {err} above bound {bound} ({mode})"
+        )
+        mode_gbps = time_impl(mode, qfn, lambda: data_f32)
+        out["modes"][mode] = {
+            "gbps": mode_gbps,
+            "speedup_vs_f32": mode_gbps / max(stock_gbps, 1e-9),
+            "wire_reduction": lane / q.quantized_width(lane),
+            "max_err": err,
+            "err_bound": bound,
+        }
+    return out
 
 
 def measure_failover(
@@ -765,6 +1061,58 @@ def run_wire(args) -> None:
             f"wire streams {streams}: {r['gbps']:.2f} GB/s, "
             f"{r['syscalls_per_mb']:.1f} syscalls/MB, "
             f"p99 frame stall {r['p99_frame_stall_ms']:.2f} ms{speedup}",
+            flush=True,
+        )
+
+
+def run_compress(args) -> None:
+    size = parse_size(args.block_size)
+
+    def report(label, it, dt, tot):
+        print(
+            f"{label} iter {it}: {tot} B in {dt*1e3:.1f} ms = "
+            f"{tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    results = measure_compress(
+        num_blocks=args.num_blocks,
+        block_bytes=size,
+        iterations=args.iterations,
+        chunk_bytes=parse_size(args.chunk_bytes),
+        streams=int(args.streams.split(",")[0]),
+        report=report,
+    )
+    for name, row in results.items():
+        for codec, r in row.items():
+            speed = (
+                f" ({r['speedup_vs_off']:.2f}x vs off)"
+                if codec != "off" and "speedup_vs_off" in r
+                else ""
+            )
+            e2e = f", e2e {r['e2e_gbps']:.2f} GB/s" if "e2e_gbps" in r else ""
+            print(
+                f"compress {name:9s} codec={codec:5s}: {r['gbps']:.2f} GB/s"
+                f"{speed}, ratio {r['ratio']:.2f}x "
+                f"({r['encoded_chunks']} enc / {r['raw_chunks']} raw chunks, "
+                f"{r['pool_hits']} pool hits){e2e}",
+                flush=True,
+            )
+    try:
+        q = measure_quantized_ici(
+            num_executors=args.executors if args.executors > 1 else 4,
+            iterations=args.iterations,
+        )
+    except RuntimeError as e:
+        print(f"quantized ici leg skipped: {e}", flush=True)
+        return
+    print(f"quantized ici n={q['n']}: f32 {q['f32_gbps']:.2f} GB/s", flush=True)
+    for mode, m in q["modes"].items():
+        print(
+            f"quantized ici {mode}: {m['gbps']:.2f} GB/s "
+            f"({m['speedup_vs_f32']:.2f}x vs f32), "
+            f"wire bytes {m['wire_reduction']:.2f}x fewer, "
+            f"max err {m['max_err']:.3g} <= bound {m['err_bound']:.3g}",
             flush=True,
         )
 
@@ -1842,6 +2190,8 @@ def main(argv=None) -> None:
         run_client(args)
     elif args.mode == "wire":
         run_wire(args)
+    elif args.mode == "compress":
+        run_compress(args)
     elif args.mode == "failover":
         run_failover(args)
     elif args.mode == "elastic":

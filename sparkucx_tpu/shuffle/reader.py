@@ -219,7 +219,11 @@ class TpuShuffleReader:
         #: byte budget for credit-based fetch pipelining: issue request
         #: windows ahead of consumption while their result-buffer bytes fit
         #: the budget (``spark.shuffle.tpu.wire.creditBytes``); 0 = the
-        #: historical strictly-serial window loop
+        #: historical strictly-serial window loop.  Credits account DECODED
+        #: bytes (``block_sizes`` is the logical block size, which is what
+        #: the result buffers hold) — wire compression (``compress.codec``)
+        #: shrinks what travels, never what this budget meters, so a codec
+        #: change cannot silently over-issue receive buffers.
         self.credit_bytes = max(0, credit_bytes)
         #: primary executor -> its replica executors (replication-ring
         #: successors; shuffle/resolver.ring_neighbors) — where a block is

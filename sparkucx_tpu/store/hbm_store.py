@@ -920,7 +920,17 @@ class HbmBlockStore:
                 f"replica round (shuffle={shuffle_id}, src={src_executor}, "
                 f"round={round_idx}) table claims {pos} B but body is {len(body)} B"
             )
-        arr = np.frombuffer(bytes(body), dtype=np.uint8) if len(body) else np.empty(0, dtype=np.uint8)
+        # bytes bodies wrap zero-copy (np.frombuffer over bytes never copies);
+        # a decoded bytearray from the compressed replica path (transport/
+        # peer.py) also wraps directly — the receiver hands ownership over, so
+        # the historical defensive bytes() copy only remains for exotic
+        # bytes-likes (non-contiguous memoryviews)
+        if not len(body):
+            arr = np.empty(0, dtype=np.uint8)
+        elif isinstance(body, (bytes, bytearray)):
+            arr = np.frombuffer(body, dtype=np.uint8)
+        else:
+            arr = np.frombuffer(bytes(body), dtype=np.uint8)
         with self._lock:
             rounds = self._replicas.setdefault((shuffle_id, src_executor), {})
             old = rounds.get(round_idx)

@@ -37,6 +37,7 @@ import numpy as np
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import Block, BlockId, MemoryBlock, ShuffleBlockId
 from sparkucx_tpu.core.definitions import (
+    CHUNK_CODEC_EXT_SIZE,
     CHUNK_HEADER_SIZE,
     FRAME_HEADER_SIZE,
     MAX_FRAME_BYTES,
@@ -44,6 +45,7 @@ from sparkucx_tpu.core.definitions import (
     REPLICA_HEADER_SIZE,
     AmId,
     MapperInfo,
+    pack_chunk_codec_ext,
     pack_chunk_hdr,
     pack_frame,
     pack_frame_prefix,
@@ -51,6 +53,7 @@ from sparkucx_tpu.core.definitions import (
     pack_replica_ack,
     pack_replica_put,
     pack_wire_hello,
+    unpack_chunk_codec_ext,
     unpack_chunk_hdr,
     unpack_frame_header,
     unpack_member_event,
@@ -68,9 +71,13 @@ from sparkucx_tpu.core.operation import (
     TransportError,
 )
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
+# tier-(a) wire compression policy + page formats; ops.compress keeps its jax
+# imports function-local, so this pulls no accelerator stack into the transport
+from sparkucx_tpu.ops.compress import CompressSpec, encode_chunk
 from sparkucx_tpu.store.hbm_store import HbmBlockStore
 from sparkucx_tpu.testing import faults
 from sparkucx_tpu.utils.checksum import crc32c
+from sparkucx_tpu.utils.pagecodec import CODEC_RAW, CodecError, decode_page
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.stats import StatsAggregator
 
@@ -85,6 +92,11 @@ _SIZE = struct.Struct("<q")
 #: length — the knob never changes frame layout when off (golden frames).
 _CRC = struct.Struct("<I")
 _MAX_FRAME = MAX_FRAME_BYTES  # shared frame ceiling (core/definitions.py)
+#: Byte cap on a server's encoded-chunk pool (compress.codec on).  Encoded
+#: pages are typically a fraction of their raw chunks, so this covers on the
+#: order of a GiB of hot raw blocks; past it the pool FIFO-evicts — a cap,
+#: not a correctness boundary (a miss just re-encodes).
+_ENCODED_POOL_CAP = 128 << 20
 
 
 def apply_wire_sockopts(
@@ -343,6 +355,30 @@ class BlockServer:
         # a group forms as its K lane connections each say hello.
         self._groups: Dict[int, _ServerGroup] = {}  #: guarded by self._groups_lock
         self._groups_lock = threading.Lock()
+        #: tier-(a) wire compression policy (conf compress.codec); off =
+        #: chunk frames byte-identical to the pinned golden captures
+        self._compress = CompressSpec.from_conf(self.conf)
+        #: serve-side compression telemetry: decoded (raw) vs wire bytes
+        #: streamed through chunk frames, and how many pages actually encoded
+        #: vs fell back to raw.  Aggregated per reply under _compress_lock.
+        self.compress_stats: Dict[str, int] = {
+            "raw_bytes": 0,
+            "wire_bytes": 0,
+            "encoded_chunks": 0,
+            "raw_chunks": 0,
+            "cache_hits": 0,
+        }  #: guarded by self._compress_lock
+        self._compress_lock = threading.Lock()
+        #: serve-side encoded-chunk pool: sealed blocks are immutable for the
+        #: life of their shuffle id, so each (block, offset, len) chunk pays
+        #: the encoder exactly once and every later fetch of the same chunk —
+        #: other reducers, credit-window re-issues, retry/failover replays —
+        #: serves the cached encoding (or the cached "unprofitable, ship raw"
+        #: verdict, so incompressible blocks never re-attempt the encoder).
+        #: Maps (bid, offset, len) -> (codec_id, encoded | None); FIFO-evicted
+        #: once the encoded bytes held exceed _ENCODED_POOL_CAP.
+        self._encoded_pool: Dict[tuple, tuple] = {}  #: guarded by self._compress_lock
+        self._encoded_pool_bytes = 0  #: guarded by self._compress_lock
         # numListenerThreads accept loops on one listen socket
         # (UcxShuffleConf.scala:73-78; the kernel load-balances accepts).
         self._threads = [
@@ -355,6 +391,12 @@ class BlockServer:
 
     def address_bytes(self) -> bytes:
         return f"{self.address[0]}:{self.address[1]}".encode()
+
+    def compress_snapshot(self) -> Dict[str, int]:
+        """Consistent copy of :attr:`compress_stats` (serve threads aggregate
+        per striped reply under the same lock)."""
+        with self._compress_lock:
+            return dict(self.compress_stats)
 
     def _accept_loop(self) -> None:
         while self._running:
@@ -495,6 +537,8 @@ class BlockServer:
         seq = 0
         chunk = group.chunk_bytes
         checksum = self.conf.wire_checksum
+        cspec = self._compress
+        raw_total = wire_total = encoded_chunks = raw_chunks = cache_hits = 0
         for i, e in enumerate(entries):
             if e is None:
                 sizes.append(-1)
@@ -508,20 +552,66 @@ class BlockServer:
             while pos < ln:
                 n = min(chunk, ln - pos)
                 hdr = pack_chunk_hdr(tag, i, seq, pos)
+                wire = view[pos : pos + n]
+                if cspec.enabled:
+                    # codec ext on EVERY chunk of the reply (uniform header
+                    # length); unprofitable pages ship codec_id=0 raw.  The
+                    # chunk offset stays the RAW offset — the client resolves
+                    # its scatter destination with decoded coordinates.
+                    key = (bids[i], pos, n)
+                    with self._compress_lock:
+                        hit = self._encoded_pool.get(key)
+                    if hit is not None:
+                        cid, enc = hit
+                        cache_hits += 1
+                    else:
+                        # encode OUTSIDE the lock: a concurrent reply racing
+                        # on the same chunk just produces the same bytes
+                        cid, enc = encode_chunk(cspec, wire)
+                        cost = len(enc) if enc is not None else 0
+                        with self._compress_lock:
+                            while (
+                                self._encoded_pool_bytes + cost > _ENCODED_POOL_CAP
+                                and self._encoded_pool
+                            ):
+                                oldest = next(iter(self._encoded_pool))
+                                _, old = self._encoded_pool.pop(oldest)
+                                if old is not None:
+                                    self._encoded_pool_bytes -= len(old)
+                            if key not in self._encoded_pool:
+                                self._encoded_pool[key] = (cid, enc)
+                                self._encoded_pool_bytes += cost
+                    if enc is not None:
+                        wire = enc
+                        encoded_chunks += 1
+                    else:
+                        raw_chunks += 1
+                    hdr += pack_chunk_codec_ext(cid, n)
                 if checksum:
-                    # 4 B CRC32C trailer; the client detects it by header
-                    # length (CHUNK_HEADER_SIZE + 4), so frames stay
-                    # byte-identical with the knob off
-                    hdr += _CRC.pack(crc32c(view[pos : pos + n]))
-                prefix = pack_frame_prefix(AmId.FETCH_BLOCK_CHUNK, hdr, n)
+                    # 4 B CRC32C trailer, always LAST in the header; it
+                    # covers the WIRE (encoded) payload so corruption is
+                    # caught before the decoder ever parses the page.  The
+                    # client detects both extensions by header length, so
+                    # frames stay byte-identical with the knobs off.
+                    hdr += _CRC.pack(crc32c(wire))
+                prefix = pack_frame_prefix(AmId.FETCH_BLOCK_CHUNK, hdr, len(wire))
                 # chaos hook AFTER the crc: an armed garble models payload
                 # corrupted in flight, which the client-side crc must catch
                 payload = faults.transform(
-                    "peer.server.chunk", view[pos : pos + n], tag=tag, block=i
+                    "peer.server.chunk", wire, tag=tag, block=i
                 )
                 group.enqueue(seq % group.nlanes, [prefix, memoryview(payload)])
+                raw_total += n
+                wire_total += len(wire)
                 seq += 1
                 pos += n
+        if cspec.enabled:
+            with self._compress_lock:
+                self.compress_stats["raw_bytes"] += raw_total
+                self.compress_stats["wire_bytes"] += wire_total
+                self.compress_stats["encoded_chunks"] += encoded_chunks
+                self.compress_stats["raw_chunks"] += raw_chunks
+                self.compress_stats["cache_hits"] += cache_hits
         blob = b"".join(_SIZE.pack(s) for s in sizes)
         manifest = pack_frame(
             AmId.FETCH_BLOCK_REQ_ACK, _TAG.pack(tag) + _COUNT.pack(len(sizes)) + blob, b""
@@ -586,7 +676,12 @@ class BlockServer:
                         except TransportError:
                             pass  # shuffle not created on this server yet
                 elif am_id == AmId.REPLICA_PUT:
-                    if (len(header) - REPLICA_HEADER_SIZE) % REPLICA_ENTRY_SIZE == 4:
+                    # header extensions after the entry table, detected by the
+                    # residue mod entry size: 0 plain, 4 crc, 8 codec, 12
+                    # codec+crc (core/definitions.py).  The crc trailer is
+                    # always LAST and covers the WIRE (possibly encoded) body.
+                    residue = (len(header) - REPLICA_HEADER_SIZE) % REPLICA_ENTRY_SIZE
+                    if residue in (4, 12):
                         # wire.checksum trailer: verify before installing; a
                         # corrupt replica gets NO ack, so the pusher's
                         # replication_wait names this successor as stalled
@@ -601,6 +696,28 @@ class BlockServer:
                                 sid, src, rnd, peer,
                             )
                             continue
+                    if residue in (8, 12):
+                        # compress.codec ext: the whole round body is one
+                        # encoded page; a decode failure is handled exactly
+                        # like a crc mismatch — discard, no ack
+                        codec_id, raw_len = unpack_chunk_codec_ext(
+                            header, len(header) - CHUNK_CODEC_EXT_SIZE
+                        )
+                        header = header[:-CHUNK_CODEC_EXT_SIZE]
+                        if codec_id != CODEC_RAW or raw_len != len(body):
+                            decoded = bytearray(raw_len)
+                            try:
+                                decode_page(codec_id, body, decoded)
+                            except CodecError as e:
+                                sid, src, rnd, _ = unpack_replica_put(header)
+                                logger.warning(
+                                    "replica round (shuffle=%d, src=%d, round=%d) "
+                                    "from peer %s failed page decode (%s) — "
+                                    "discarded, not acked",
+                                    sid, src, rnd, peer, e,
+                                )
+                                continue
+                            body = decoded
                     sid, src, rnd, entries = unpack_replica_put(header)
                     faults.check(
                         "replica.apply", shuffle_id=sid, src_executor=src, round_idx=rnd
@@ -723,6 +840,11 @@ class _PeerConnection:
         self.rx_syscalls = 0
         self.rx_stall_ns = 0
         self.stall_samples: Deque[int] = deque(maxlen=4096)
+        #: reusable landing buffer for ENCODED chunk payloads (compressed wire
+        #: path): wire bytes land here, then decode into the chunk's final
+        #: destination view — written only by this connection's recv thread,
+        #: so the pool needs no lock (same contract as the rx_* counters)
+        self._codec_scratch: Optional[bytearray] = None
         #: the exception that killed the recv loop (None for a clean EOF) —
         #: _fail_conn_inflight surfaces a typed error (BlockCorruptError)
         #: instead of the generic connection-lost one when it is set
@@ -818,6 +940,13 @@ class _PeerConnection:
         if self.activity is not None:
             self.activity.set()
 
+    def _codec_buf(self, n: int) -> memoryview:
+        """Recv-thread-only scratch for encoded chunk payloads (grown, never
+        shrunk): one live landing buffer per lane, reused chunk to chunk."""
+        if self._codec_scratch is None or len(self._codec_scratch) < n:
+            self._codec_scratch = bytearray(max(n, 1 << 16))
+        return memoryview(self._codec_scratch)[:n]
+
     def _recv_chunk(self, header: bytes, blen: int) -> None:
         """Receive one striped chunk straight into its destination buffer.
 
@@ -826,43 +955,79 @@ class _PeerConnection:
         is the batch's last missing piece, park the manifest header here so
         progress() completes the batch on whichever lane finished last.
 
-        A header carrying the 4 B CRC32C trailer (wire.checksum on the
-        serving side) is verified after the payload lands; a mismatch raises
-        ``BlockCorruptError``, which kills this lane — the batch then fails
-        typed and the reducer-side failover (``_retry_fetch``) re-sources the
-        block from a replica holder."""
+        Header extensions are detected by header length (24 plain, +8 codec
+        ext, +4 crc trailer last — core/definitions.py).  An encoded chunk
+        lands in this lane's scratch and decodes into the destination view;
+        the crc covers the ENCODED bytes, so corruption is caught before the
+        decoder parses anything, and a decode failure (CodecError) surfaces
+        as ``BlockCorruptError`` exactly like a crc mismatch.  Either kills
+        this lane — the batch then fails typed and the reducer-side failover
+        (``_retry_fetch``) re-sources the block from a replica holder.
+        Receive accounting is in DECODED bytes (``raw_len``), matching the
+        manifest totals the stripe tracker sums."""
         tag, block, seq, offset = unpack_chunk_hdr(header)
+        ext = len(header) - CHUNK_HEADER_SIZE
         want = None
-        if len(header) == CHUNK_HEADER_SIZE + 4:
+        codec_id: Optional[int] = None
+        raw_len = blen
+        if ext == 4:
             (want,) = _CRC.unpack_from(header, CHUNK_HEADER_SIZE)
-        mv = self.chunk_sink(tag, block, offset, blen) if blen else None
+        elif ext in (CHUNK_CODEC_EXT_SIZE, CHUNK_CODEC_EXT_SIZE + 4):
+            codec_id, raw_len = unpack_chunk_codec_ext(header, CHUNK_HEADER_SIZE)
+            if ext == CHUNK_CODEC_EXT_SIZE + 4:
+                (want,) = _CRC.unpack_from(header, CHUNK_HEADER_SIZE + CHUNK_CODEC_EXT_SIZE)
+        mv = self.chunk_sink(tag, block, offset, raw_len) if raw_len else None
         ok = False
         try:
-            data = b""
-            if mv is not None:
-                self._recv_into(
-                    mv, what=f" (fetch tag {tag}, block {block}, chunk offset {offset})"
-                )
-                data = mv
-            elif blen:  # unknown tag / oversized target: drain off the wire
-                data = self._recv_exact(blen)
-                if data is None:
-                    raise OSError(
-                        f"peer {self.peer} (lane {self.lane}) closed mid-chunk "
-                        f"(fetch tag {tag}, block {block})"
+            what = f" (fetch tag {tag}, block {block}, chunk offset {offset})"
+            if codec_id is None or (codec_id == CODEC_RAW and raw_len == blen):
+                # plain chunk (or explicit raw fallback): payload IS the slice
+                data = b""
+                if mv is not None:
+                    self._recv_into(mv, what=what)
+                    data = mv
+                elif blen:  # unknown tag / oversized target: drain off the wire
+                    data = self._recv_exact(blen)
+                    if data is None:
+                        raise OSError(
+                            f"peer {self.peer} (lane {self.lane}) closed mid-chunk "
+                            f"(fetch tag {tag}, block {block})"
+                        )
+                if want is not None and blen and crc32c(data) != want:
+                    raise BlockCorruptError(
+                        -1, -1, block,
+                        f"striped chunk (fetch tag {tag}, block {block}, offset "
+                        f"{offset}) from peer {self.peer} lane {self.lane} failed "
+                        "its crc32c check",
                     )
-            if want is not None and blen and crc32c(data) != want:
-                raise BlockCorruptError(
-                    -1, -1, block,
-                    f"striped chunk (fetch tag {tag}, block {block}, offset "
-                    f"{offset}) from peer {self.peer} lane {self.lane} failed "
-                    "its crc32c check",
-                )
+            else:
+                # encoded page: wire bytes -> lane scratch, verify, decode
+                # into the final destination (still one write into the
+                # result buffer; the scatter offsets are raw coordinates)
+                enc = self._codec_buf(blen)
+                self._recv_into(enc, what=what)
+                if want is not None and crc32c(enc) != want:
+                    raise BlockCorruptError(
+                        -1, -1, block,
+                        f"striped chunk (fetch tag {tag}, block {block}, offset "
+                        f"{offset}) from peer {self.peer} lane {self.lane} failed "
+                        "its crc32c check",
+                    )
+                if mv is not None:
+                    try:
+                        decode_page(codec_id, enc, mv)
+                    except CodecError as e:
+                        raise BlockCorruptError(
+                            -1, -1, block,
+                            f"striped chunk (fetch tag {tag}, block {block}, "
+                            f"offset {offset}) from peer {self.peer} lane "
+                            f"{self.lane} failed page decode: {e}",
+                        ) from None
             ok = True
         finally:
             # the done callback must run even when the socket dies mid-chunk:
             # it clears the tag's scattering mark so a later sweep can fail it
-            done_hdr = self.chunk_done(tag, blen if ok else 0, mv is not None)
+            done_hdr = self.chunk_done(tag, raw_len if ok else 0, mv is not None)
         if done_hdr is not None:
             self._park(AmId.FETCH_BLOCK_REQ_ACK, done_hdr, b"", True)
 
@@ -1215,6 +1380,15 @@ class PeerTransport(ShuffleTransport):
                 )
         return out
 
+    def compress_stats(self) -> Dict[str, int]:
+        """Serve-side wire-compression telemetry (tier a): decoded vs wire
+        bytes this executor streamed through chunk frames, plus the page
+        encode/raw-fallback split.  All zeros when ``compress.codec`` is off
+        or no striped reply has been served yet."""
+        if self.server is None:
+            return {"raw_bytes": 0, "wire_bytes": 0, "encoded_chunks": 0, "raw_chunks": 0}
+        return self.server.compress_snapshot()
+
     def wait_for_activity(self, timeout: float = 0.01) -> None:
         """Park until a recv thread posts an ack (or timeout) — the wakeup-mode
         progress contract (GlobalWorkerRpcThread.scala:46-58).  No-op when
@@ -1395,9 +1569,15 @@ class PeerTransport(ShuffleTransport):
 
     def _open_connection(self, addr: Tuple[str, int]) -> Union[_PeerConnection, _StripeGroup]:
         """One lane (wire.streams = 1, the byte-identical historical wire) or
-        a K-lane stripe group announced to the server via WIRE_HELLO."""
+        a K-lane stripe group announced to the server via WIRE_HELLO.
+
+        With ``compress.codec`` on, even ``wire.streams = 1`` uses the stripe
+        (chunked-reply) path as a single-lane group: the codec ext rides
+        chunk headers, so compressed replies need per-chunk framing — and the
+        monolithic single-lane reply stays byte-identical to its golden
+        capture, pinned at codec=off only."""
         streams = max(1, self.conf.wire_streams)
-        if streams == 1:
+        if streams == 1 and self.conf.wire_compress_codec == "off":
             return _PeerConnection(
                 addr,
                 ack_buffers=self._ack_buffers,
@@ -1868,14 +2048,25 @@ class PeerTransport(ShuffleTransport):
                     unacked[eid] = unacked.get(eid, 0) + len(rounds)
                 self.replica_stats["replica_backlog_bytes"] += round_bytes * len(neighbors)
             checksum = self.conf.wire_checksum
+            cspec = CompressSpec.from_conf(self.conf)
             for eid in neighbors:
                 for rnd, entries, body in rounds:
                     header = pack_replica_put(shuffle_id, self.executor_id, rnd, entries)
+                    wire_body = body
+                    if cspec.enabled:
+                        # whole-round page encode; the codec ext rides after
+                        # the entry table, before the crc trailer (residues
+                        # 8/12, core/definitions.py)
+                        cid, enc = encode_chunk(cspec, body)
+                        if enc is not None:
+                            wire_body = enc
+                        header += pack_chunk_codec_ext(cid, len(body))
                     if checksum:
                         # self-describing: receivers detect the crc tail by
-                        # header length (knob off = golden replica frames)
-                        header += _CRC.pack(crc32c(body))
-                    frame = pack_frame(AmId.REPLICA_PUT, header, body)
+                        # header length (knob off = golden replica frames);
+                        # the crc covers the WIRE (possibly encoded) body
+                        header += _CRC.pack(crc32c(wire_body))
+                    frame = pack_frame(AmId.REPLICA_PUT, header, wire_body)
                     try:
                         self._connection(eid).send(frame)
                         with self._tag_lock:

@@ -74,11 +74,20 @@ def _encode(obj: Any, out: bytearray, depth: int = 0) -> None:
         out += b"s"
         out += _U32.pack(len(raw))
         out += raw
-    elif isinstance(obj, (bytes, bytearray, memoryview)):
-        raw = bytes(obj)
+    elif isinstance(obj, (bytes, bytearray)):
+        # zero-copy append: bytearray.__iadd__ copies straight out of the
+        # source buffer — materializing an intermediate bytes() doubled the
+        # allocation on the map-side hot path (PERF.md codec microbench)
         out += b"b"
-        out += _U32.pack(len(raw))
-        out += raw
+        out += _U32.pack(len(obj))
+        out += obj
+    elif isinstance(obj, memoryview):
+        # len() counts ELEMENTS, not bytes, on shaped views — use nbytes and
+        # flatten to a byte view; only a non-contiguous view pays a copy
+        mv = obj if obj.contiguous else memoryview(obj.tobytes())
+        out += b"b"
+        out += _U32.pack(mv.nbytes)
+        out += mv.cast("B")
     elif isinstance(obj, tuple):
         out += b"t"
         out += _U32.pack(len(obj))

@@ -60,6 +60,47 @@ class TestRoundtrip:
         assert list(decode_records(b"")) == []
 
 
+class TestZeroCopyByteLikes:
+    """The zero-copy ``_encode`` branches (PERF.md codec microbench): bytes,
+    bytearray, and memoryview append straight into the output buffer without
+    an intermediate ``bytes()`` materialization.  All decode back as bytes."""
+
+    def test_bytearray_roundtrip(self):
+        src = bytearray(b"\x00\xff" * 500)
+        (got,) = decode_records(encode_record(("k", src)))
+        assert got == ("k", bytes(src)) and type(got[1]) is bytes
+
+    def test_memoryview_flat_roundtrip(self):
+        src = np.arange(256, dtype=np.uint8).tobytes()
+        (got,) = decode_records(encode_record(memoryview(src)))
+        assert got == src
+
+    def test_memoryview_shaped_counts_bytes_not_elements(self):
+        # len() on a shaped view counts ELEMENTS; the encoder must frame by
+        # nbytes or the payload is silently truncated to the first dimension
+        arr = np.arange(64, dtype=np.uint32).reshape(8, 8)
+        mv = memoryview(arr)
+        assert len(mv) != mv.nbytes  # the trap this test pins
+        (got,) = decode_records(encode_record(mv))
+        assert got == arr.tobytes()
+
+    def test_memoryview_noncontiguous_copies_once_correctly(self):
+        arr = np.arange(100, dtype=np.uint8)
+        mv = memoryview(arr)[::2]  # strided: NOT contiguous
+        assert not mv.contiguous
+        (got,) = decode_records(encode_record(mv))
+        assert got == arr[::2].tobytes()
+
+    def test_bytes_mutation_after_encode_is_isolated(self):
+        # the zero-copy append must COPY out of the source buffer (iadd
+        # semantics), not alias it — later mutation can't corrupt the frame
+        src = bytearray(b"before-mutation!")
+        frame = encode_record(src)
+        src[:] = b"AFTER-MUTATION!!"
+        (got,) = decode_records(frame)
+        assert got == b"before-mutation!"
+
+
 class TestRejection:
     def test_unknown_tag(self):
         with pytest.raises(ValueError, match="unknown record tag"):

@@ -1,0 +1,706 @@
+"""Tests for the payload-reduction layer (compress tier a + quantize tier b).
+
+Tier (a) — lossless page codecs (utils/pagecodec.py) and the wire policy
+(ops/compress.py CompressSpec/encode_chunk): every codec round-trips bit-exact
+on the shapes the data plane moves, adversarial payloads raise CodecError and
+never over-read, unprofitable pages fall back to raw, and the server's
+encoded-chunk pool serves steady-state fetches without re-encoding.
+
+Tier (b) — lossy opt-in block quantization (QuantizeSpec, the quantized
+exchange builders, and the groupby partial-aggregate wiring): dequantized
+results stay inside the documented ``error_bound``, keys/counts stay exact,
+fused == unfused, and every misuse (mode off, integer dtypes, non-partial
+plans) is rejected at validate time.
+"""
+
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import BytesBlock, MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus
+from sparkucx_tpu.ops.compress import (
+    CompressSpec,
+    QuantizeSpec,
+    dequantize_rows,
+    encode_chunk,
+    quantize_rows,
+)
+from sparkucx_tpu.utils.pagecodec import (
+    CODEC_DELTA,
+    CODEC_DICT,
+    CODEC_RAW,
+    CODEC_RLE,
+    CodecError,
+    decode_page,
+    encode_page,
+)
+
+_ALL_CODECS = (CODEC_DICT, CODEC_RLE, CODEC_DELTA)
+
+
+def _roundtrip(codec_id, page):
+    enc = encode_page(codec_id, page)
+    if enc is None:
+        return None
+    assert len(enc) < len(page), "encoder returned a non-shrinking encoding"
+    out = bytearray(len(page))
+    decode_page(codec_id, enc, out)
+    assert bytes(out) == page, "codec round-trip diverged"
+    return enc
+
+
+def _pages():
+    """The case matrix: every shape the codecs are tuned for plus the ones
+    they must decline (noise), with word tails and degenerate sizes."""
+    rng = np.random.default_rng(7)
+    nwords = 4096
+    alpha = np.unique(rng.integers(0, 2**32, size=97, dtype=np.uint64).astype("<u4"))
+    wide = np.unique(rng.integers(0, 2**31, size=600, dtype=np.uint64).astype("<u4"))
+    huge = np.unique(rng.integers(0, 2**31, size=3000, dtype=np.uint64).astype("<u4"))
+    seq_base = np.uint32(2**31)
+    near = (
+        seq_base
+        + np.cumsum(rng.integers(-100, 100, size=nwords), dtype=np.int64).astype(
+            np.uint32
+        )
+    ).astype("<u4")
+    wrap = (
+        (np.arange(nwords, dtype=np.uint64) * 3 + 2**32 - 100) % 2**32
+    ).astype("<u4")
+    zeros = bytes(4 * nwords)
+    return {
+        "dict_small": alpha[rng.integers(0, alpha.size, nwords)].tobytes(),
+        "dict_wide_hash": wide[rng.integers(0, wide.size, 4 * nwords)].tobytes(),
+        "dict_u16_search": huge[rng.integers(0, huge.size, 16 * nwords)].tobytes(),
+        "clustered": np.repeat(
+            alpha[:64], nwords // 64
+        ).astype("<u4").tobytes(),
+        "zeros": zeros,
+        "sorted": np.sort(
+            rng.integers(0, 2**28, size=nwords, dtype=np.uint64).astype("<u4")
+        ).tobytes(),
+        "near_seq": near.tobytes(),
+        "wrap_delta": wrap.tobytes(),
+        "noise": rng.integers(0, 256, size=4 * nwords, dtype=np.uint8).tobytes(),
+        "tail1": zeros + b"\x01",
+        "tail2": zeros + b"\x01\x02",
+        "tail3": zeros + b"\x01\x02\x03",
+        "one_word": b"\xde\xad\xbe\xef",
+        "tail_only": b"\x01\x02\x03",
+    }
+
+
+class TestPageCodecRoundtrip:
+    @pytest.mark.parametrize("codec_id", _ALL_CODECS)
+    def test_case_matrix_roundtrips(self, codec_id):
+        for name, page in _pages().items():
+            _roundtrip(codec_id, page)  # asserts equality whenever it encodes
+
+    def test_expected_pages_actually_compress(self):
+        pages = _pages()
+        # each codec must land its headline shape (ratio checked, not assumed)
+        assert len(_roundtrip(CODEC_DICT, pages["dict_small"])) < len(pages["dict_small"]) // 3
+        assert _roundtrip(CODEC_DICT, pages["dict_wide_hash"]) is not None
+        assert _roundtrip(CODEC_DICT, pages["dict_u16_search"]) is not None
+        assert len(_roundtrip(CODEC_RLE, pages["clustered"])) < len(pages["clustered"]) // 20
+        assert len(_roundtrip(CODEC_RLE, pages["zeros"])) < 32
+        assert _roundtrip(CODEC_DELTA, pages["sorted"]) is not None
+        assert len(_roundtrip(CODEC_DELTA, pages["near_seq"])) < len(pages["near_seq"]) // 3
+        assert _roundtrip(CODEC_DELTA, pages["wrap_delta"]) is not None
+
+    @pytest.mark.parametrize("codec_id", _ALL_CODECS)
+    def test_noise_and_degenerates_fall_back(self, codec_id):
+        pages = _pages()
+        for name in ("noise", "one_word", "tail_only"):
+            assert encode_page(codec_id, pages[name]) is None, name
+        assert encode_page(codec_id, b"") is None
+
+    @pytest.mark.parametrize("codec_id", _ALL_CODECS)
+    def test_word_tails_survive(self, codec_id):
+        for name in ("tail1", "tail2", "tail3"):
+            _roundtrip(codec_id, _pages()[name])
+
+    @pytest.mark.parametrize("codec_id", _ALL_CODECS)
+    def test_random_fuzz_roundtrips(self, codec_id, rng):
+        for _ in range(30):
+            n = int(rng.integers(0, 2000))
+            kind = rng.integers(0, 3)
+            if kind == 0:  # low-cardinality words + tail
+                vals = rng.integers(0, 9, size=(n + 3) // 4, dtype=np.uint64)
+                page = vals.astype("<u4").tobytes()[:n]
+            elif kind == 1:  # runs
+                page = (b"\x07\x00\x00\x00" * ((n + 3) // 4))[:n]
+            else:  # raw noise
+                page = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+            _roundtrip(codec_id, page)
+
+    def test_raw_codec_copies_exactly(self):
+        page = b"raw-page-payload" * 9
+        out = bytearray(len(page))
+        decode_page(CODEC_RAW, page, out)
+        assert bytes(out) == page
+        assert encode_page(CODEC_RAW, page) is None
+
+
+class TestCodecAdversarial:
+    """Corrupt/hostile payloads must raise CodecError (a ValueError) — never
+    over-read, scatter out of bounds, or leak a different exception type."""
+
+    @pytest.mark.parametrize("codec_id", _ALL_CODECS)
+    def test_mutations_never_crash_or_overread(self, codec_id, rng):
+        pages = _pages()
+        source = {
+            CODEC_DICT: pages["dict_small"],
+            CODEC_RLE: pages["clustered"],
+            CODEC_DELTA: pages["near_seq"],
+        }[codec_id]
+        enc = encode_page(codec_id, source)
+        # length mutations break the internal size accounting: ALWAYS caught
+        for bad in (enc[: len(enc) // 2], enc[:-1], enc + b"\x00", enc + enc, b""):
+            with pytest.raises(CodecError):
+                decode_page(codec_id, bad, bytearray(len(source)))
+        # garbled interiors may decode to wrong-but-in-range bytes (integrity
+        # is the crc's job, not the codec's) — but they may ONLY raise
+        # CodecError, never over-read, scatter out of range, or crash
+        for _ in range(60):
+            buf = bytearray(enc)
+            for _ in range(int(rng.integers(1, 4))):
+                buf[int(rng.integers(0, len(buf)))] ^= int(rng.integers(1, 256))
+            try:
+                decode_page(codec_id, bytes(buf), bytearray(len(source)))
+            except CodecError:
+                pass
+
+    def test_rle_length_sum_mismatch(self):
+        # 2 runs of 1 word each claiming a 3-word destination
+        enc = (
+            struct.pack("<I", 2)
+            + np.array([1, 1], "<u4").tobytes()
+            + np.array([7, 9], "<u4").tobytes()
+        )
+        with pytest.raises(CodecError, match="expand"):
+            decode_page(CODEC_RLE, enc, bytearray(12))
+
+    def test_rle_claimed_runs_exceed_payload(self):
+        with pytest.raises(CodecError, match="payload"):
+            decode_page(CODEC_RLE, struct.pack("<I", 2**30), bytearray(64))
+
+    def test_dict_index_out_of_range(self):
+        # 1 word, 1 dictionary entry, width 1 — but the index byte says 5
+        enc = struct.pack("<IIB", 1, 1, 1) + struct.pack("<I", 42) + b"\x05"
+        with pytest.raises(CodecError, match="range"):
+            decode_page(CODEC_DICT, enc, bytearray(4))
+
+    def test_dict_invalid_width(self):
+        enc = struct.pack("<IIB", 1, 1, 3) + struct.pack("<I", 42) + b"\x00"
+        with pytest.raises(CodecError, match="width"):
+            decode_page(CODEC_DICT, enc, bytearray(4))
+
+    def test_dict_empty_dictionary_with_words(self):
+        with pytest.raises(CodecError):
+            decode_page(CODEC_DICT, struct.pack("<IIB", 1, 0, 1) + b"\x00", bytearray(4))
+
+    def test_dict_word_count_disagrees_with_destination(self):
+        enc = struct.pack("<IIB", 9, 1, 1) + struct.pack("<I", 42) + b"\x00" * 9
+        with pytest.raises(CodecError, match="destination|claims"):
+            decode_page(CODEC_DICT, enc, bytearray(4))
+
+    @pytest.mark.parametrize("nbytes", [0, 4, 255])
+    def test_delta_invalid_width(self, nbytes):
+        enc = struct.pack("<IIB", 2, 0, nbytes) + b"\x00" * 8
+        with pytest.raises(CodecError, match="width"):
+            decode_page(CODEC_DELTA, enc, bytearray(8))
+
+    def test_delta_zero_words(self):
+        with pytest.raises(CodecError, match="zero"):
+            decode_page(CODEC_DELTA, struct.pack("<IIB", 0, 0, 1), bytearray(8))
+
+    def test_delta_payload_length_mismatch(self):
+        enc = struct.pack("<IIB", 4, 0, 2) + b"\x00" * 3  # needs 6 delta bytes
+        with pytest.raises(CodecError, match="payload"):
+            decode_page(CODEC_DELTA, enc, bytearray(16))
+
+    def test_raw_size_mismatch(self):
+        with pytest.raises(CodecError, match="raw"):
+            decode_page(CODEC_RAW, b"abc", bytearray(4))
+
+    def test_unknown_codec_id(self):
+        with pytest.raises(CodecError, match="unknown"):
+            decode_page(99, b"abc", bytearray(3))
+        with pytest.raises(ValueError, match="unknown"):
+            encode_page(99, b"abcd")
+
+    def test_codec_error_is_value_error(self):
+        assert issubclass(CodecError, ValueError)
+
+
+class TestEncodeChunk:
+    def test_off_spec_never_encodes(self):
+        cid, enc = encode_chunk(CompressSpec(), bytes(1 << 16))
+        assert (cid, enc) == (CODEC_RAW, None)
+
+    def test_min_chunk_gate(self):
+        spec = CompressSpec(codec="rle", min_chunk_bytes=4096)
+        assert encode_chunk(spec, bytes(4095)) == (CODEC_RAW, None)
+        cid, enc = encode_chunk(spec, bytes(4096))
+        assert cid == CODEC_RLE and enc is not None and len(enc) < 4096
+
+    def test_incompressible_falls_back_raw(self):
+        spec = CompressSpec(codec="dict", min_chunk_bytes=0)
+        noise = np.random.default_rng(3).integers(0, 256, 8192, np.uint8).tobytes()
+        assert encode_chunk(spec, noise) == (CODEC_RAW, None)
+
+    def test_from_conf_and_validation(self):
+        conf = TpuShuffleConf(wire_compress_codec="delta", compress_min_chunk_bytes=1024)
+        spec = CompressSpec.from_conf(conf)
+        assert spec.codec == "delta" and spec.min_chunk_bytes == 1024
+        assert spec.enabled and spec.codec_id == CODEC_DELTA
+        assert not CompressSpec().enabled
+        with pytest.raises(ValueError, match="codec"):
+            CompressSpec(codec="zstd").validate()
+        with pytest.raises(ValueError, match="min_chunk_bytes"):
+            CompressSpec(codec="rle", min_chunk_bytes=-1).validate()
+        with pytest.raises(ValueError, match="wire_compress_codec"):
+            TpuShuffleConf(wire_compress_codec="zstd").validate()
+
+
+# ----------------------------------------------------------------------
+# serve-side encoded-chunk pool (transport/peer.py)
+# ----------------------------------------------------------------------
+
+
+def _pair(**kw):
+    from sparkucx_tpu.transport.peer import PeerTransport
+
+    conf = TpuShuffleConf(**kw)
+    a = PeerTransport(conf, executor_id=1)
+    b = PeerTransport(conf, executor_id=2)
+    a.init()
+    a.add_executor(2, b.init())
+    return a, b
+
+
+def _fetch(a, bids, sizes, timeout=10.0):
+    import time
+
+    bufs = [MemoryBlock(np.zeros(n, np.uint8), size=n) for n in sizes]
+    reqs = a.fetch_blocks_by_block_ids(2, bids, bufs, [None] * len(bids))
+    deadline = time.monotonic() + timeout
+    while not all(r.completed() for r in reqs):
+        a.progress()
+        if time.monotonic() > deadline:
+            raise TimeoutError("fetch did not complete")
+        time.sleep(0.001)
+    for r in reqs:
+        assert r.wait(0).status == OperationStatus.SUCCESS, str(r.wait(0).error)
+    return [bytes(buf.host_view()) for buf in bufs]
+
+
+class TestEncodedPool:
+    def test_refetch_hits_the_pool(self):
+        a, b = _pair(wire_compress_codec="rle")
+        try:
+            bid = ShuffleBlockId(0, 0, 0)
+            payload = bytes(64 << 10)  # zeros: maximal rle page
+            b.register(bid, BytesBlock(payload))
+            assert _fetch(a, [bid], [len(payload)]) == [payload]
+            snap1 = b.server.compress_snapshot()
+            assert snap1["encoded_chunks"] >= 1
+            assert snap1["wire_bytes"] < snap1["raw_bytes"]
+            assert _fetch(a, [bid], [len(payload)]) == [payload]
+            snap2 = b.server.compress_snapshot()
+            # sealed blocks are immutable: the refetch served cached encodings
+            assert snap2["cache_hits"] >= snap1["cache_hits"] + 1
+            assert snap2["encoded_chunks"] > snap1["encoded_chunks"]
+        finally:
+            a.close()
+            b.close()
+
+    def test_raw_verdict_is_cached_too(self):
+        a, b = _pair(wire_compress_codec="dict")
+        try:
+            bid = ShuffleBlockId(0, 1, 0)
+            noise = np.random.default_rng(5).integers(0, 256, 64 << 10, np.uint8).tobytes()
+            b.register(bid, BytesBlock(noise))
+            assert _fetch(a, [bid], [len(noise)]) == [noise]
+            assert _fetch(a, [bid], [len(noise)]) == [noise]
+            snap = b.server.compress_snapshot()
+            assert snap["encoded_chunks"] == 0 and snap["raw_chunks"] >= 2
+            # the incompressible verdict was remembered, not re-attempted ...
+            assert snap["cache_hits"] >= 1
+            # ... and a None verdict costs the pool no bytes
+            assert b.server._encoded_pool_bytes == 0
+        finally:
+            a.close()
+            b.close()
+
+    def test_fifo_eviction_under_tiny_cap(self, monkeypatch):
+        from sparkucx_tpu.transport import peer as peer_mod
+
+        monkeypatch.setattr(peer_mod, "_ENCODED_POOL_CAP", 1)
+        a, b = _pair(wire_compress_codec="rle")
+        try:
+            bids = [ShuffleBlockId(0, i, 0) for i in range(3)]
+            payloads = [bytes([i]) * (32 << 10) for i in range(3)]
+            for bid, p in zip(bids, payloads):
+                b.register(bid, BytesBlock(p))
+            sizes = [len(p) for p in payloads]
+            assert _fetch(a, bids, sizes) == payloads
+            assert _fetch(a, bids, sizes) == payloads  # correct while thrashing
+            # the cap held: at most one encoding resident at a time
+            assert len(b.server._encoded_pool) <= 1
+            assert b.server._encoded_pool_bytes <= max(
+                len(encode_page(CODEC_RLE, p)) for p in payloads
+            )
+        finally:
+            a.close()
+            b.close()
+
+
+class TestCompressedReader:
+    @pytest.mark.parametrize("codec", ["rle", "dict"])
+    def test_credit_gate_composes_with_codec(self, codec):
+        """The reader's CreditGate budgets DECODED bytes: a credit window
+        smaller than the decoded stream (but >= one block) must still drain
+        the whole shuffle, bit-exact, over a compressed wire."""
+        from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+
+        payloads = [bytes([i]) * (32 << 10) for i in range(6)]
+        a, b = _pair(wire_compress_codec=codec)
+        try:
+            for i, p in enumerate(payloads):
+                b.register(ShuffleBlockId(0, i, 0), BytesBlock(p))
+            reader = TpuShuffleReader(
+                a, 1, 0, 0, 1, len(payloads),
+                block_sizes=lambda m, r: len(payloads[m]),
+                max_blocks_per_request=2,
+                sender_of=lambda m: 2,
+                credit_bytes=64 << 10,
+            )
+            got = []
+            for blk in reader.fetch_blocks():
+                got.append(bytes(blk.data))
+                blk.release()
+            assert got == payloads
+            assert reader.metrics.remote_bytes_read == sum(map(len, payloads))
+        finally:
+            a.close()
+            b.close()
+
+
+# ----------------------------------------------------------------------
+# tier (b): block quantization
+# ----------------------------------------------------------------------
+
+
+class TestQuantizeSpec:
+    def test_width_math(self):
+        q = QuantizeSpec(mode="int8", block_size=128)
+        assert q.padded_width(128) == 128 and q.quantized_width(128) == 33
+        assert q.padded_width(130) == 256 and q.quantized_width(130) == 66
+        assert q.num_blocks(130) == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="mode"):
+            QuantizeSpec(mode="fp4").validate()
+        with pytest.raises(ValueError, match="multiple of 4"):
+            QuantizeSpec(mode="int8", block_size=6).validate()
+        with pytest.raises(ValueError, match="multiple of 4"):
+            QuantizeSpec(mode="int8", block_size=0).validate()
+        with pytest.raises(ValueError, match="quantize_mode"):
+            TpuShuffleConf(quantize_mode="fp4").validate()
+
+    def test_from_conf(self):
+        conf = TpuShuffleConf(quantize_mode="blockfloat", quantize_block_size=32)
+        q = QuantizeSpec.from_conf(conf)
+        assert q.mode == "blockfloat" and q.block_size == 32 and q.enabled
+        assert not QuantizeSpec.from_conf(TpuShuffleConf()).enabled
+
+    def test_off_mode_rejected_at_runtime(self):
+        q = QuantizeSpec()
+        with pytest.raises(ValueError, match="off"):
+            quantize_rows(q, np.zeros((2, 8), np.float32))
+        with pytest.raises(ValueError, match="off"):
+            dequantize_rows(q, np.zeros((2, 3), np.int32), 8)
+
+
+class TestQuantizeRows:
+    @pytest.mark.parametrize("mode", ["int8", "blockfloat"])
+    @pytest.mark.parametrize("w", [8, 30])  # exact blocks and padded blocks
+    def test_error_within_bound_per_block(self, mode, w, rng):
+        q = QuantizeSpec(mode=mode, block_size=8)
+        x = rng.normal(scale=10.0, size=(64, w)).astype(np.float32)
+        out = np.asarray(dequantize_rows(q, quantize_rows(q, x), w))
+        assert out.shape == x.shape
+        wq, bs = q.padded_width(w), q.block_size
+        xp = np.pad(x, ((0, 0), (0, wq - w)))
+        amax = np.abs(xp.reshape(64, -1, bs)).max(axis=2)
+        bound = np.vectorize(q.error_bound)(amax) + 1e-7
+        err = np.abs(out - x)
+        assert (err <= np.repeat(bound, bs, axis=1)[:, :w]).all()
+
+    @pytest.mark.parametrize("mode", ["int8", "blockfloat"])
+    def test_grid_values_roundtrip_exactly(self, mode, rng):
+        # values already on the int8 x pow2-scale grid quantize losslessly:
+        # amax = 127 * 2^-3 makes both scales exactly 2^-3
+        q = QuantizeSpec(mode=mode, block_size=8)
+        levels = rng.integers(-126, 127, size=(16, 8)).astype(np.float32)
+        levels[:, 0] = 127  # pin every block's amax
+        x = levels * np.float32(0.125)
+        out = np.asarray(dequantize_rows(q, quantize_rows(q, x), 8))
+        np.testing.assert_array_equal(out, x)
+
+    def test_zero_rows_stay_zero(self):
+        q = QuantizeSpec(mode="int8", block_size=8)
+        x = np.zeros((4, 16), np.float32)
+        assert not np.asarray(dequantize_rows(q, quantize_rows(q, x), 16)).any()
+
+    def test_rows_survive_permutation(self, rng):
+        """Each row carries its own scales, so quantized rows can be permuted
+        (the exchange moves rows) before dequantizing."""
+        q = QuantizeSpec(mode="int8", block_size=8)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        qrows = np.asarray(quantize_rows(q, x))
+        perm = rng.permutation(32)
+        a = np.asarray(dequantize_rows(q, qrows[perm], 8))
+        b = np.asarray(dequantize_rows(q, qrows, 8))[perm]
+        np.testing.assert_array_equal(a, b)
+
+    def test_payload_width_checked(self):
+        q = QuantizeSpec(mode="int8", block_size=8)
+        with pytest.raises(ValueError, match="quantized_width"):
+            dequantize_rows(q, np.zeros((2, 5), np.int32), 8)
+
+
+# ----------------------------------------------------------------------
+# quantized exchange builders (4-way CPU mesh)
+# ----------------------------------------------------------------------
+
+_needs4 = pytest.mark.skipif(
+    jax.device_count() < 4, reason="needs a 4-device mesh (conftest forces 8)"
+)
+
+
+@_needs4
+class TestQuantizedExchange:
+    N, SLOT, LANE = 4, 8, 8
+
+    def _case(self, rng):
+        n, slot = self.N, self.SLOT
+        data = rng.normal(scale=5.0, size=(n * n * slot, self.LANE)).astype(np.float32)
+        sizes = rng.integers(0, slot + 1, size=(n, n)).astype(np.int32)
+        return data, sizes
+
+    def _spec_mesh(self):
+        from sparkucx_tpu.ops.exchange import ExchangeSpec, make_mesh
+
+        spec = ExchangeSpec(
+            num_executors=self.N, send_rows=self.N * self.SLOT,
+            recv_rows=self.N * self.SLOT, lane=self.LANE,
+        )
+        return spec, make_mesh(self.N)
+
+    def _run(self, fn, mesh, data, sizes):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P("ex", None))
+        recv, rs = fn(jax.device_put(data, sharding), jax.device_put(sizes, sharding))
+        return np.asarray(recv), np.asarray(rs)
+
+    @pytest.mark.parametrize("mode", ["int8", "blockfloat"])
+    def test_within_bound_vs_stock(self, mode, rng):
+        from sparkucx_tpu.ops.exchange import build_exchange
+        from sparkucx_tpu.ops.ici_exchange import build_quantized_exchange
+
+        q = QuantizeSpec(mode=mode, block_size=8)
+        spec, mesh = self._spec_mesh()
+        data, sizes = self._case(rng)
+        recv_ref, rs_ref = self._run(
+            build_exchange(mesh, spec), mesh, data.view(np.int32).copy(), sizes
+        )
+        recv_q, rs_q = self._run(
+            build_quantized_exchange(mesh, spec, q), mesh, data, sizes
+        )
+        np.testing.assert_array_equal(rs_ref, rs_q)
+        bound = q.error_bound(float(np.abs(data).max())) + 1e-7
+        assert np.abs(recv_q - recv_ref.view(np.float32)).max() <= bound
+
+    def test_fused_matches_unfused(self, rng):
+        """Scatter + quantize + ring in one jit equals staging first and
+        running the unfused quantized exchange — bit-identical (same staged
+        rows, deterministic quantizer)."""
+        from sparkucx_tpu.ops.ici_exchange import (
+            build_quantized_exchange,
+            build_quantized_fused_exchange,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        q = QuantizeSpec(mode="int8", block_size=8)
+        spec, mesh = self._spec_mesh()
+        n, slot, send_rows = self.N, self.SLOT, self.N * self.SLOT
+        sizes = rng.integers(1, slot + 1, size=(n, n)).astype(np.int32)
+        starts = np.zeros((n, n), np.int32)
+        counts = np.zeros((n, n), np.int32)
+        outs = np.zeros((n, n), np.int32)
+        packed = np.zeros((n * send_rows, self.LANE), np.float32)
+        staged_ref = np.zeros((n * send_rows, self.LANE), np.float32)
+        for i in range(n):
+            off = 0
+            for j in range(n):
+                c = int(sizes[i, j])
+                rows = rng.normal(size=(c, self.LANE)).astype(np.float32)
+                packed[i * send_rows + off : i * send_rows + off + c] = rows
+                staged_ref[i * send_rows + j * slot : i * send_rows + j * slot + c] = rows
+                starts[i, j], counts[i, j], outs[i, j] = j * slot, c, off
+                off += c
+        sharding = NamedSharding(mesh, P("ex", None))
+        put = lambda a: jax.device_put(a, sharding)
+        recv_u, rs_u = build_quantized_exchange(mesh, spec, q)(
+            put(staged_ref), put(sizes)
+        )
+        recv_f, rs_f = build_quantized_fused_exchange(
+            mesh, spec, q, n, max_block_rows=slot
+        )(
+            put(starts), put(counts), put(outs), put(packed),
+            put(np.zeros((n * send_rows, self.LANE), np.float32)), put(sizes),
+        )
+        np.testing.assert_array_equal(np.asarray(rs_u), np.asarray(rs_f))
+        assert np.asarray(recv_u).tobytes() == np.asarray(recv_f).tobytes()
+
+    def test_builder_rejections(self):
+        from sparkucx_tpu.ops.exchange import ExchangeSpec, make_mesh
+        from sparkucx_tpu.ops.hierarchy import make_hierarchical_mesh
+        from sparkucx_tpu.ops.ici_exchange import build_quantized_exchange
+
+        spec, mesh = self._spec_mesh()
+        q = QuantizeSpec(mode="int8", block_size=8)
+        with pytest.raises(ValueError, match="flat"):
+            build_quantized_exchange(
+                make_hierarchical_mesh(2, 4),
+                ExchangeSpec(num_executors=8, send_rows=64, recv_rows=64, lane=8),
+                q,
+            )
+        with pytest.raises(ValueError, match="int8"):
+            build_quantized_exchange(mesh, spec, QuantizeSpec())
+        with pytest.raises(ValueError, match="num_executors > 1"):
+            build_quantized_exchange(
+                make_mesh(1),
+                ExchangeSpec(num_executors=1, send_rows=8, recv_rows=8, lane=8),
+                q,
+            )
+
+
+# ----------------------------------------------------------------------
+# groupby partial-aggregate quantization (ops/relational.py)
+# ----------------------------------------------------------------------
+
+
+class TestAggregateQuantize:
+    def _spec(self, **kw):
+        from sparkucx_tpu.ops.relational import AggregateSpec
+
+        kw.setdefault("num_executors", 4)
+        kw.setdefault("capacity", 64)
+        kw.setdefault("recv_capacity", 256)
+        kw.setdefault("aggs", ("sum",))
+        kw.setdefault("impl", "dense")
+        return AggregateSpec(**kw)
+
+    def test_validate_requires_partial_and_float(self):
+        with pytest.raises(ValueError, match="partial"):
+            self._spec(
+                quantize_mode="int8", dtype=np.dtype(np.float32), partial=False
+            ).validate()
+        with pytest.raises(ValueError, match="floating"):
+            self._spec(quantize_mode="int8", partial=True).validate()  # int32 dtype
+        with pytest.raises(ValueError, match="mode"):
+            self._spec(
+                quantize_mode="fp4", dtype=np.dtype(np.float32), partial=True
+            ).validate()
+        # the applicable combination passes
+        self._spec(
+            quantize_mode="blockfloat", dtype=np.dtype(np.float32), partial=True
+        ).validate()
+
+    def test_from_conf_silently_skips_inapplicable_plans(self):
+        from sparkucx_tpu.ops.relational import AggregateSpec
+
+        conf = TpuShuffleConf(quantize_mode="int8", partial_aggregation=False)
+        spec = AggregateSpec.from_conf(
+            conf, capacity=64, recv_capacity=256, aggs=("sum",), impl="dense"
+        )
+        # cluster knob on, plan not partial/float: stock path, no error
+        assert spec.quantize_mode == "off"
+        spec.validate()
+        # an EXPLICIT quantize_mode kwarg is never silently dropped
+        spec2 = AggregateSpec.from_conf(
+            conf, capacity=64, recv_capacity=256, aggs=("sum",), impl="dense",
+            quantize_mode="int8",
+        )
+        assert spec2.quantize_mode == "int8"
+        with pytest.raises(ValueError):
+            spec2.validate()
+
+    def test_from_conf_applies_to_partial_float_plans(self):
+        from sparkucx_tpu.ops.relational import AggregateSpec
+
+        conf = TpuShuffleConf(quantize_mode="blockfloat", quantize_block_size=32)
+        spec = AggregateSpec.from_conf(
+            conf, capacity=64, recv_capacity=256, aggs=("sum",), impl="dense",
+            partial=True, dtype=np.dtype(np.float32),
+        )
+        assert spec.quantize_mode == "blockfloat" and spec.quantize_block_size == 32
+        spec.validate()
+
+    @_needs4
+    @pytest.mark.parametrize("mode", ["int8", "blockfloat"])
+    def test_lossy_groupby_within_tolerance(self, mode, rng):
+        """The dequant-tolerance gate: a quantized partial-aggregate groupby
+        stays within N partials x error_bound of the exact oracle, with keys
+        and counts EXACT (they are never quantized)."""
+        from sparkucx_tpu.ops.exchange import make_mesh
+        from sparkucx_tpu.ops.relational import oracle_aggregate, run_grouped_aggregate
+
+        n, total = 4, 1500
+        keys = rng.integers(0, 40, size=total).astype(np.uint32)
+        # positive values: partial sums stay below the full sum, so the
+        # oracle's max value bounds every partial block's amax
+        values = rng.uniform(0.1, 1.0, size=(total, 2)).astype(np.float32)
+        spec = self._spec(
+            capacity=512, recv_capacity=1024, aggs=("sum", "max"),
+            dtype=np.dtype(np.float32), partial=True, quantize_mode=mode,
+            quantize_block_size=4,
+        )
+        gk, gv, gc = run_grouped_aggregate(make_mesh(n), spec, keys, values)
+        ok, ov, oc = oracle_aggregate(keys, values, ("sum", "max"))
+        np.testing.assert_array_equal(gk, ok)  # group identity exact
+        np.testing.assert_array_equal(gc, oc)  # COUNT exact
+        q = spec.qspec
+        atol = n * q.error_bound(float(np.abs(ov).max())) + 1e-5
+        np.testing.assert_allclose(gv, ov, atol=atol)
+
+    @_needs4
+    def test_quantize_off_is_bit_identical_to_stock(self, rng):
+        from sparkucx_tpu.ops.exchange import make_mesh
+        from sparkucx_tpu.ops.relational import run_grouped_aggregate
+
+        n, total = 4, 800
+        keys = rng.integers(0, 30, size=total).astype(np.uint32)
+        values = rng.normal(size=(total, 2)).astype(np.float32)
+        base = self._spec(
+            capacity=512, recv_capacity=1024, aggs=("sum", "max"),
+            dtype=np.dtype(np.float32), partial=True,
+        )
+        off = self._spec(
+            capacity=512, recv_capacity=1024, aggs=("sum", "max"),
+            dtype=np.dtype(np.float32), partial=True, quantize_mode="off",
+        )
+        gk1, gv1, gc1 = run_grouped_aggregate(make_mesh(n), base, keys, values)
+        gk2, gv2, gc2 = run_grouped_aggregate(make_mesh(n), off, keys, values)
+        assert gv1.tobytes() == gv2.tobytes()
+        np.testing.assert_array_equal(gk1, gk2)
+        np.testing.assert_array_equal(gc1, gc2)

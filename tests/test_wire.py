@@ -1057,3 +1057,239 @@ class TestBoundedReplicator:
         finally:
             a.close()
             b.close()
+
+
+# ---------------------------------------------------------------------------
+# compress.codec: per-chunk page codecs on the striped wire (compression PR)
+# ---------------------------------------------------------------------------
+
+
+def _compressible_payloads():
+    """Exchange-shaped payloads (u32 words: low-cardinality keys, runs,
+    near-sequential columns) plus noise, empties, and sub-chunk blocks —
+    every fallback path of the codec ext in one batch."""
+    rng = np.random.default_rng(11)
+    alpha = rng.integers(0, 50, size=1 << 15, dtype=np.uint64).astype("<u4")
+    return [
+        alpha.tobytes(),  # dictionary/rle-friendly
+        bytes(1 << 16),  # zero runs
+        (np.uint32(7) + np.cumsum(
+            rng.integers(0, 9, size=1 << 14), dtype=np.int64
+        ).astype(np.uint32)).astype("<u4").tobytes(),  # delta-friendly
+        rng.integers(0, 256, size=(1 << 15) + 17, dtype=np.uint8).tobytes(),  # noise
+        b"",  # empty block
+        b"tiny",  # under the min-chunk gate
+    ]
+
+
+class TestWireCompression:
+    def test_codec_wire_constants_pinned(self):
+        """Codec ids and the chunk-header extension are wire format —
+        renumbering or re-packing is a protocol break."""
+        from sparkucx_tpu.core.definitions import (
+            CHUNK_CODEC_EXT_SIZE,
+            CHUNK_HEADER_SIZE,
+            pack_chunk_codec_ext,
+        )
+        from sparkucx_tpu.utils.pagecodec import (
+            CODEC_DELTA,
+            CODEC_DICT,
+            CODEC_RAW,
+            CODEC_RLE,
+        )
+
+        assert (CODEC_RAW, CODEC_DICT, CODEC_RLE, CODEC_DELTA) == (0, 1, 2, 3)
+        assert CHUNK_CODEC_EXT_SIZE == 8
+        assert pack_chunk_codec_ext(2, 4096) == struct.pack("<II", 2, 4096)
+        # header-length detection table: 24 plain, +8 codec, +4 crc (crc LAST)
+        assert CHUNK_HEADER_SIZE == 24
+        assert unpack_chunk_hdr(pack_chunk_hdr(9, 1, 2, 3) + pack_chunk_codec_ext(1, 8)) == (9, 1, 2, 3)
+
+    def test_default_is_off(self):
+        """codec=off is the default, keeping the golden frames above (single
+        lane AND striped) byte-identical to the pre-compression protocol."""
+        assert TpuShuffleConf().wire_compress_codec == "off"
+        assert TpuShuffleConf().compress_min_chunk_bytes == 4096
+
+    @pytest.mark.parametrize("codec", ["dict", "rle", "delta"])
+    @pytest.mark.parametrize("streams", [1, 4])
+    def test_compressed_fetch_matches_stock(self, codec, streams):
+        """Oracle: a compressed fetch returns byte-for-byte what the stock
+        (codec=off) wire returns, for every payload shape and lane count —
+        including the raw-fallback and sub-chunk-gate paths."""
+        payloads = _compressible_payloads()
+        oracle = _fetch_all(1, payloads)
+
+        a, b = _pair(
+            streams=streams, chunk_bytes=16 << 10, wire_compress_codec=codec
+        )
+        try:
+            bids = []
+            for i, p in enumerate(payloads):
+                bid = ShuffleBlockId(0, i, 0)
+                b.register(bid, BytesBlock(p))
+                bids.append(bid)
+            bufs = [_buf(max(len(p), 1)) for p in payloads]
+            reqs = a.fetch_blocks_by_block_ids(2, bids, bufs, [None] * len(bids))
+            _drive(a, reqs)
+            got = []
+            for p, buf, r in zip(payloads, bufs, reqs):
+                res = r.wait(0)
+                assert res.status == OperationStatus.SUCCESS, str(res.error)
+                got.append(bytes(buf.host_view()[: res.stats.recv_size].tobytes()))
+            assert got == oracle
+            snap = b.server.compress_snapshot()
+            assert snap["encoded_chunks"] >= 1  # compression actually engaged
+            assert snap["raw_chunks"] >= 1  # and the noise block fell back raw
+            assert snap["wire_bytes"] < snap["raw_bytes"]
+        finally:
+            a.close()
+            b.close()
+
+    @pytest.mark.parametrize("checksum", [False, True])
+    def test_garbled_compressed_chunk_raises_block_corrupt(self, checksum):
+        """A compressed chunk garbled in flight surfaces as the SAME typed
+        BlockCorruptError on both detection paths: the crc trailer when
+        checksum is on (it covers the ENCODED bytes, so it fires before the
+        decoder parses anything), the decoder's CodecError otherwise."""
+        from sparkucx_tpu.core.operation import BlockCorruptError
+        from sparkucx_tpu.testing import faults
+
+        a, b = _pair(
+            streams=2, chunk_bytes=1024,
+            wire_compress_codec="rle", wire_checksum=checksum,
+        )
+        try:
+            bid = ShuffleBlockId(4, 0, 0)
+            b.register(bid, BytesBlock(bytes(64 << 10)))  # zeros: always encodes
+            faults.arm("peer.server.chunk", faults.garble(), times=1)
+            buf = _buf(64 << 10)
+            reqs = a.fetch_blocks_by_block_ids(2, [bid], [buf], [None])
+            _drive(a, reqs)
+            res = reqs[0].wait(0)
+            assert res.status == OperationStatus.FAILURE
+            assert isinstance(res.error, BlockCorruptError), type(res.error)
+            if checksum:
+                assert "crc32c" in str(res.error)
+        finally:
+            faults.reset()
+            a.close()
+            b.close()
+
+    def test_corruption_failover_heals_compressed_fetch(self):
+        """End to end on the compressed wire: the decode failure kills the
+        lane, and the reader's retry refetches the block intact — corruption
+        enters the same failover path as a dead peer."""
+        from sparkucx_tpu.testing import faults
+
+        payloads = [bytes(16 << 10)]
+        a, b = _pair(streams=2, chunk_bytes=1024, wire_compress_codec="rle")
+        try:
+            b.register(ShuffleBlockId(0, 0, 0), BytesBlock(payloads[0]))
+            faults.arm("peer.server.chunk", faults.garble(), times=1)
+            reader = TpuShuffleReader(
+                a, 1, 0, 0, 1, 1,
+                block_sizes=lambda m, r: len(payloads[m]),
+                sender_of=lambda m: 2,
+                fetch_retries=2,
+                fetch_backoff_ms=5,
+            )
+            got = [bytes(blk.data) for blk in reader.fetch_blocks()]
+            assert got == payloads
+            assert reader.metrics.blocks_retried >= 1
+        finally:
+            faults.reset()
+            a.close()
+            b.close()
+
+    def test_single_lane_with_codec_uses_chunk_frames(self):
+        """compress.codec on forces the stripe (chunked) path even at
+        streams=1 — the codec ext rides chunk headers, which the single-frame
+        reply has nowhere to carry."""
+        a, b = _pair(streams=1, wire_compress_codec="rle")
+        try:
+            bid = ShuffleBlockId(0, 0, 0)
+            b.register(bid, BytesBlock(bytes(32 << 10)))
+            buf = _buf(32 << 10)
+            reqs = a.fetch_blocks_by_block_ids(2, [bid], [buf], [None])
+            _drive(a, reqs)
+            assert reqs[0].wait(0).status == OperationStatus.SUCCESS
+            assert b.server._groups, "no stripe group formed for the codec path"
+            assert b.server.compress_snapshot()["encoded_chunks"] >= 1
+        finally:
+            a.close()
+            b.close()
+
+
+class TestReplicaCompression:
+    """REPLICA_PUT whole-round page compression: same codec ext, same
+    discard-no-ack contract as a crc mismatch."""
+
+    def _pair_repl(self, **kw):
+        kw.setdefault("staging_capacity_per_executor", 1 << 20)
+        kw.setdefault("replication_factor", 1)
+        conf = TpuShuffleConf(**kw)
+        a = PeerTransport(conf, executor_id=0)
+        b = PeerTransport(conf, executor_id=1)
+        a.add_executor(1, b.init())
+        a.init()
+        b.add_executor(0, a.server.address_bytes())
+        return a, b
+
+    def test_compressed_replica_roundtrip(self):
+        """A compressible round pushed over a codec-on wire installs the
+        exact original bytes on the successor (encode on push, decode on
+        install)."""
+        a, b = self._pair_repl(wire_compress_codec="rle")
+        try:
+            payload = bytes(4096)  # zero page: always encodes
+            a.store.create_shuffle(31, 1, 1)
+            w = a.store.map_writer(31, 0)
+            w.write_partition(0, payload)
+            w.commit()
+            a.store.seal(31)
+            assert a.replication_wait(31, timeout=10.0, strict=True)
+            view = b.store.replica_view(31, 0, 0)
+            assert view is not None
+            arr, off, ln = view
+            assert ln == len(payload)
+            got = arr.reshape(-1).view(np.uint8)[off : off + ln].tobytes()
+            assert got == payload
+        finally:
+            a.close()
+            b.close()
+
+    def test_corrupt_codec_round_discarded_no_ack(self):
+        """A REPLICA_PUT whose codec ext claims an encoded body that fails to
+        decode is discarded without an ack — and the serving thread survives
+        to install the next (valid, raw-codec-ext) round.  Hand-crafted
+        frames: the receiver needs no conf agreement with the pusher."""
+        from sparkucx_tpu.core.definitions import pack_chunk_codec_ext, pack_replica_put
+        from sparkucx_tpu.utils.pagecodec import CODEC_RAW, CODEC_RLE
+
+        a, b = self._pair_repl()
+        sock = None
+        try:
+            body = b"replica-round-payload" * 16
+            sock = socket.create_connection(b.server.address, timeout=10)
+            # round 0: codec ext claims an rle page, body is garbage for it
+            bad = pack_replica_put(8, 0, 0, [(0, 0, 64)]) + pack_chunk_codec_ext(
+                CODEC_RLE, 64
+            )
+            sock.sendall(pack_frame(AmId.REPLICA_PUT, bad, body))
+            # round 1: raw codec ext with the true length — valid
+            good = pack_replica_put(8, 0, 1, [(0, 1, len(body))]) + pack_chunk_codec_ext(
+                CODEC_RAW, len(body)
+            )
+            sock.sendall(pack_frame(AmId.REPLICA_PUT, good, body))
+            hdr = recv_exact(sock, FRAME_HEADER_SIZE)
+            am_id, hlen, blen = unpack_frame_header(hdr)
+            recv_exact(sock, hlen + blen)
+            assert am_id == AmId.REPLICA_ACK  # first ack is for the VALID round
+            assert b.store.replica_view(8, 0, 0) is None
+            assert b.store.replica_view(8, 0, 1) is not None
+        finally:
+            if sock is not None:
+                sock.close()
+            a.close()
+            b.close()
