@@ -25,7 +25,11 @@ step (ops/sort.py), ``wire`` — the striped loopback peer wire (streams=1 vs 4,
 perf/benchmark.py measure_wire; TPU-free, measured after the TCP baseline),
 ``failover`` — executor-loss robustness (perf/benchmark.py measure_failover;
 TPU-free): steady loopback fetch GB/s vs GB/s with the primary executor killed
-at t=50%, plus recovery time and p99 frame stall, ``compress`` — wire payload
+at t=50%, plus recovery time and p99 frame stall, ``tenants`` — the
+multi-tenant serving plane (perf/benchmark.py measure_tenants; TPU-free): 8
+concurrent apps fetching through the shared-selector reactor, reporting
+aggregate GB/s, the min/max per-app fairness ratio, and p99 per-block fetch
+latency, ``compress`` — wire payload
 compression (perf/benchmark.py measure_compress; TPU-free): per-codec fetch
 GB/s and compression ratio on a dictionary-heavy matrix vs incompressible
 noise, plus an end-to-end compressed shuffle-read leg.
@@ -335,7 +339,28 @@ def main():
     except Exception as e:
         RESULT["failover_error"] = f"{type(e).__name__}: {e}"[:300]
 
-    # 1d. Compression sub-metric — also TPU-free (loopback peer wire with the
+    # 1d. Multi-tenant serving-plane sub-metric — also TPU-free (one
+    # tenants-enabled loopback server on the shared-selector reactor plane,
+    # N concurrent apps each fetching through its own tenant namespace):
+    # aggregate GB/s, the min/max per-app fairness ratio, and p99 per-block
+    # fetch latency under concurrent fan-in (perf/benchmark.py
+    # measure_tenants).
+    try:
+        from sparkucx_tpu.perf.benchmark import measure_tenants
+
+        tn = measure_tenants(
+            num_apps=8, num_blocks=8, block_bytes=1 << 20, iterations=2
+        )
+        RESULT["tenants"] = {
+            "apps": tn["apps"],
+            "agg_gbps": round(tn["agg_gbps"], 3),
+            "fairness": round(tn["fairness"], 3),
+            "p99_fetch_ms": round(tn["p99_fetch_ms"], 2),
+        }
+    except Exception as e:
+        RESULT["tenants_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # 1e. Compression sub-metric — also TPU-free (loopback peer wire with the
     # tier-(a) chunk codecs).  Reports ratio x effective GB/s, never ratio
     # alone: a codec only counts if DECODED bytes per wall-second go up.
     # Small sizes here (the recorded headline run lives in docs/PERF.md);

@@ -28,6 +28,18 @@ from __future__ import annotations
 #:   function of (socket, parts) — no BlockServer state — kept underscored
 #:   because the iovec windowing is an implementation detail of the wire,
 #:   not transport API.  Reviewed with the striped-wire PR.
+#: - hbm_store.py ``._charge_tenant`` / ``._staging``: same-file friends
+#:   again — MapWriter/DeviceMapWriter must run the tenant admission check
+#:   inside the store-lock critical section that allocates the region (an
+#:   over-quota write must fail typed with nothing allocated), and the tier
+#:   probe ``_tier_of`` classifies a round by its ``_ShuffleState._staging``
+#:   backing (memmap vs RAM).  Both stay underscored: admission and tier
+#:   state are store internals, not writer/eviction API.  Reviewed with the
+#:   multi-tenant service PR.
+#: - service/tenants.py ``._gate``: ``Tenant`` is a same-file data holder of
+#:   its ``TenantRegistry`` — the registry lazily creates the per-tenant
+#:   CreditGate under its own lock; exposing the slot publicly would invite
+#:   unlocked construction.  Reviewed with the multi-tenant service PR.
 #:
 #: host-sync:
 #: - "drain stage": the drain lane IS the pipeline's sanctioned host-sync
@@ -73,12 +85,14 @@ ALLOWLIST = {
     ("testing/faults.py", "private-access", "._zombies"),
     ("store/hbm_store.py", "private-access", "._lock"),
     ("store/hbm_store.py", "private-access", "._rollover"),  # also ._rollover_device
+    ("store/hbm_store.py", "private-access", "._charge_tenant"),
+    ("store/hbm_store.py", "private-access", "._staging"),
+    ("service/tenants.py", "private-access", "._gate"),
     ("core/block.py", "private-access", "._mmap"),
     ("shuffle/daemon.py", "private-access", "._sendmsg_all"),
     ("transport/peer.py", "private-access", "._sendmsg_all"),
     ("transport/tpu.py", "host-sync", "drain stage"),
     ("transport/spmd.py", "host-sync", "drain stage"),
-    ("perf/benchmark.py", "host-sync", "drain stage"),
     ("transport/spmd.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit'"),
     ("transport/tpu.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit' (via '_assemble')"),
     ("transport/tpu.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit_quota'"),

@@ -215,6 +215,34 @@ class TpuShuffleConf:
     #: ``spill_dir`` and the reader k-way-merges them back.
     reduce_memory_budget: int = 64 << 20
 
+    # multi-tenant shuffle service (service/ — ROADMAP item 4)
+    #: Multi-tenant mode: shuffles are keyed ``(app_id, shuffle_id)`` through a
+    #: TenantRegistry (service/tenants.py), fetch requests carry the tenant's
+    #: ``app_id`` as a self-describing FETCH_BLOCK_REQ header extension, HBM
+    #: quotas are enforced at region-allocation time, and the serving planes
+    #: run on the shared reactor event loop.  Default off: wire frames and
+    #: store behavior stay byte-identical to the single-tenant build (the
+    #: golden captures the CI wire gate pins).
+    tenants_enabled: bool = False
+    #: Default per-tenant HBM staging quota in bytes, charged at region
+    #: allocation time against the tenant's registered budget; an over-quota
+    #: write raises a typed TenantQuotaExceededError instead of eating a
+    #: neighbor tenant's HBM.  0 = unlimited (admission checks disabled for
+    #: tenants registered without an explicit quota).
+    tenant_hbm_quota_bytes: int = 0
+    #: Tiered-eviction epoch (ms): every epoch the EvictionManager
+    #: (service/eviction.py) demotes the least-recently-fetched sealed rounds
+    #: one tier down (HBM-resident jax.Array -> host snapshot -> np.memmap
+    #: spill), and fetches restage demoted rounds transparently.  0 = no
+    #: background demotion (manual ``run_epoch()`` only).
+    eviction_epoch_ms: int = 0
+    #: Serving-plane worker pool size for the shared selectors-based reactor
+    #: (service/reactor.py) that replaces thread-per-connection accept loops
+    #: in shuffle/daemon.py and the transport/peer.py block server.  0 keeps
+    #: the historical thread-per-connection serving plane (tenants.enabled
+    #: implies a reactor with a default-sized pool when left at 0).
+    server_workers: int = 0
+
     # TPU mesh (L2)
     mesh_axis_name: str = "ex"
     num_executors: int = 1
@@ -381,6 +409,10 @@ class TpuShuffleConf:
             ("spillDir", "spill_dir", str),
             ("spillDiskCap", "spill_disk_cap_bytes", parse_size),
             ("reduceMemoryBudget", "reduce_memory_budget", parse_size),
+            ("tenants.enabled", "tenants_enabled", lambda v: str(v).lower() == "true"),
+            ("tenants.hbmQuotaBytes", "tenant_hbm_quota_bytes", parse_size),
+            ("eviction.epochMs", "eviction_epoch_ms", int),
+            ("server.workers", "server_workers", int),
             ("pipelineDepth", "pipeline_depth", int),
             ("slotQuotaRows", "slot_quota_rows", int),
             ("deviceStaging", "device_staging", lambda v: str(v).lower() == "true"),
@@ -446,6 +478,12 @@ class TpuShuffleConf:
             raise ValueError(f"unknown quantize_mode {self.quantize_mode!r}")
         if self.quantize_block_size <= 0 or self.quantize_block_size % 4:
             raise ValueError("quantize_block_size must be a positive multiple of 4")
+        if self.tenant_hbm_quota_bytes < 0:
+            raise ValueError("tenant_hbm_quota_bytes must be >= 0 (0 = unlimited)")
+        if self.eviction_epoch_ms < 0:
+            raise ValueError("eviction_epoch_ms must be >= 0 (0 = manual epochs)")
+        if self.server_workers < 0:
+            raise ValueError("server_workers must be >= 0 (0 = thread-per-connection)")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

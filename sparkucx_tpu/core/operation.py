@@ -70,6 +70,56 @@ class BlockCorruptError(TransportError):
         super().__init__(msg)
 
 
+class UnknownTenantError(TransportError):
+    """A multi-tenant operation named an ``app_id`` the serving executor's
+    TenantRegistry does not know (never registered, or already unregistered).
+
+    Typed + addressed like BlockNotFoundError — but NOT retryable: an unknown
+    tenant stays unknown no matter which replica a reducer fails over to, so
+    the reader propagates it immediately instead of burning the retry budget.
+    """
+
+    def __init__(self, app_id: str, detail: str = "") -> None:
+        self.app_id = app_id
+        msg = f"unknown tenant app_id={app_id!r}"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class TenantQuotaExceededError(TransportError):
+    """A tenant's HBM byte quota would be exceeded by an admission-checked
+    allocation (map-output region allocation, or restaging a demoted round).
+
+    Typed + addressed — names the tenant, the shuffle, and the budget
+    arithmetic — and, like UnknownTenantError, NOT retryable over the wire:
+    every replica enforces the same registry budget, so reducers fail fast
+    instead of retrying a quota rejection through the failover path.
+    """
+
+    def __init__(
+        self,
+        app_id: str,
+        shuffle_id: int,
+        requested: int = 0,
+        quota: int = 0,
+        used: int = 0,
+        detail: str = "",
+    ) -> None:
+        self.app_id = app_id
+        self.shuffle_id = shuffle_id
+        self.requested = requested
+        self.quota = quota
+        self.used = used
+        msg = (
+            f"tenant {app_id!r} over HBM quota on shuffle {shuffle_id}"
+            f" (requested={requested}, used={used}, quota={quota})"
+        )
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 class ExecutorLostError(TransportError):
     """An executor died while an exchange depended on it and no recovery path
     exists (elasticity off, replication factor 0, or an unsupported exchange

@@ -46,6 +46,14 @@ Modes:
   is killed at t=50% (testing/faults.kill_executor) and the reader fails
   over to the replica holder.  Prints both GB/s, the recovery time (kill ->
   first replica-served block), failovers, and p99 frame stall.
+* ``tenants`` — multi-tenant serving plane under concurrent fan-in: one
+  tenants-enabled loopback server (the shared-selector reactor plane,
+  service/reactor.py) stages -n blocks of -s bytes per registered app;
+  ``--apps`` synthetic applications then stream their own set back
+  CONCURRENTLY, each through its own client transport carrying its app_id
+  as the FETCH_BLOCK_REQ extension (tenant-local shuffle ids, server-side
+  TenantRegistry translation).  Prints aggregate GB/s, per-app GB/s, the
+  min/max per-app fairness ratio, and p50/p99 per-block fetch latency.
 * ``elastic`` — degraded-mode exchange recovery under chaos: an
   ``--executors``-wide loopback cluster with ``elastic.enabled`` and
   ``replication.factor = 1`` runs multi-round shuffles of -s-byte blocks.
@@ -115,7 +123,7 @@ def _parse_args(argv):
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "wire", "ici",
-            "failover", "elastic", "compress",
+            "failover", "elastic", "compress", "tenants",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -191,6 +199,10 @@ def _parse_args(argv):
         "--chunks", type=int, default=0,
         help="FAST chunks per destination (ici mode); 0 picks the default "
         "interleave depth (ops/ici_exchange.py DEFAULT_CHUNKS_PER_DEST)",
+    )
+    p.add_argument(
+        "--apps", type=int, default=8,
+        help="concurrent synthetic applications (tenants mode)",
     )
     return p.parse_args(argv)
 
@@ -799,6 +811,144 @@ def measure_failover(
             t.close()
 
 
+def measure_tenants(
+    num_apps: int = 8,
+    num_blocks: int = 8,
+    block_bytes: int = 1 << 20,
+    iterations: int = 2,
+    server_workers: int = 8,
+    report=None,
+) -> dict:
+    """Measurement core of the ``tenants`` mode — the multi-tenant serving
+    plane under concurrent fan-in.
+
+    One tenants-enabled loopback server (the shared-selector reactor plane,
+    service/reactor.py, ``server_workers`` pool threads) registers
+    ``num_apps`` applications in a TenantRegistry and stages ``num_blocks``
+    blocks of ``block_bytes`` per app, each under the app's own shuffle-id
+    namespace (tenant-local shuffle id 0, translated server-side).  Every app
+    then streams its set back concurrently through its own client transport
+    — the ``app_id`` rides the FETCH_BLOCK_REQ extension.  The best-aggregate
+    pass reports per-app GB/s; latency percentiles pool every per-block fetch
+    gap across all apps and iterations.  Returns aggregate GB/s, per-app
+    GB/s, the fairness ratio (min/max per-app GB/s — 1.0 is perfectly fair),
+    p50/p99 per-block fetch latency, and the registry's usage snapshot.
+    ``report(phase, it, seconds, bytes)`` per concurrent pass.  Shared by the
+    CLI and bench.py."""
+    from sparkucx_tpu.service.tenants import TenantRegistry
+    from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+
+    total_per_app = num_blocks * block_bytes
+    conf = TpuShuffleConf(
+        tenants_enabled=True,
+        server_workers=server_workers,
+        wire_timeout_ms=10_000,
+        staging_capacity_per_executor=num_apps * total_per_app + (1 << 20),
+    )
+    registry = TenantRegistry()
+    server = PeerTransport(conf, executor_id=1)
+    server.store.tenants = registry  # before init(): BlockServer captures it
+    addr = server.init()
+    apps = [f"app-{i:03d}" for i in range(num_apps)]
+    clients: List[PeerTransport] = []
+    try:
+        rng = np.random.default_rng(0)
+        payload = rng.integers(0, 256, size=block_bytes, dtype=np.uint8).tobytes()
+        for app in apps:
+            registry.register(app, hbm_quota_bytes=2 * total_per_app)
+            gsid = registry.sid_for(app, 0)
+            server.store.create_shuffle(gsid, 1, num_blocks, app_id=app)
+            w = server.store.map_writer(gsid, 0)
+            for r in range(num_blocks):
+                w.write_partition(r, payload)
+            w.commit()
+            server.store.seal(gsid)
+        for i, app in enumerate(apps):
+            c = PeerTransport(conf, executor_id=100 + i)
+            c.app_id = app
+            c.init()
+            c.add_executor(1, addr)
+            clients.append(c)
+
+        def make_reader(c):
+            # tenant-LOCAL shuffle id 0: the server translates via the wire ext
+            return TpuShuffleReader(
+                c,
+                executor_id=c.executor_id,
+                shuffle_id=0,
+                start_partition=0,
+                end_partition=num_blocks,
+                num_mappers=1,
+                block_sizes=lambda m, r: block_bytes,
+                max_blocks_per_request=1,  # one window per block: per-block latency
+                sender_of=lambda m: 1,
+                fetch_retries=2,
+                fetch_deadline_ms=10_000,
+                fetch_backoff_ms=10,
+            )
+
+        def drain(c, lat, elapsed, idx):
+            t0 = prev = time.perf_counter()
+            n = 0
+            for blk in make_reader(c).fetch_blocks():
+                blk.release()
+                now = time.perf_counter()
+                lat.append(now - prev)
+                prev = now
+                n += 1
+            assert n == num_blocks
+            elapsed[idx] = time.perf_counter() - t0
+
+        for c in clients:  # warmup: connect (+ stripe handshake), page in
+            for blk in make_reader(c).fetch_blocks():
+                blk.release()
+
+        latencies: List[float] = []
+        best_agg = 0.0
+        per_app_gbps: dict = {}
+        for it in range(iterations):
+            lat = [[] for _ in clients]
+            elapsed = [0.0] * len(clients)
+            threads = [
+                threading.Thread(target=drain, args=(c, lat[i], elapsed, i))
+                for i, c in enumerate(clients)
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            agg = num_apps * total_per_app / wall / 1e9
+            if agg > best_agg:
+                best_agg = agg
+                per_app_gbps = {
+                    app: total_per_app / max(elapsed[i], 1e-12) / 1e9
+                    for i, app in enumerate(apps)
+                }
+            for per_client in lat:
+                latencies.extend(per_client)
+            if report is not None:
+                report("concurrent", it, wall, num_apps * total_per_app)
+        lats = np.sort(np.asarray(latencies))
+        p50 = float(lats[len(lats) // 2]) * 1e3
+        p99 = float(lats[min(len(lats) - 1, int(0.99 * len(lats)))]) * 1e3
+        fairness = min(per_app_gbps.values()) / max(max(per_app_gbps.values()), 1e-12)
+        return {
+            "apps": num_apps,
+            "agg_gbps": best_agg,
+            "per_app_gbps": per_app_gbps,
+            "fairness": fairness,
+            "p50_fetch_ms": p50,
+            "p99_fetch_ms": p99,
+            "tenant_stats": registry.stats(),
+        }
+    finally:
+        for c in clients:
+            c.close()
+        server.close()
+
+
 def measure_elastic(
     num_executors: int = 4,
     block_bytes: int = 8 << 10,
@@ -1138,6 +1288,35 @@ def run_failover(args) -> None:
         f"p99 frame stall {r['rx_stall_p99_ms']:.2f} ms",
         flush=True,
     )
+
+
+def run_tenants(args) -> None:
+    size = parse_size(args.block_size)
+
+    def report(phase, it, dt, tot):
+        print(
+            f"{phase} iter {it}: {args.apps} apps x {args.num_blocks} x {size} B "
+            f"in {dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    r = measure_tenants(
+        num_apps=args.apps,
+        num_blocks=args.num_blocks,
+        block_bytes=size,
+        iterations=args.iterations,
+        report=report,
+    )
+    print(
+        f"tenants: {r['apps']} apps, aggregate {r['agg_gbps']:.2f} GB/s, "
+        f"fairness {r['fairness']:.2f} (min/max per-app GB/s), "
+        f"p50 fetch {r['p50_fetch_ms']:.2f} ms, "
+        f"p99 fetch {r['p99_fetch_ms']:.2f} ms",
+        flush=True,
+    )
+    for app, gbps in sorted(r["per_app_gbps"].items()):
+        used = r["tenant_stats"].get(app, {}).get("used_bytes", 0)
+        print(f"tenants   {app}: {gbps:.3f} GB/s, hbm used {used} B", flush=True)
 
 
 def run_elastic(args) -> None:
@@ -2194,6 +2373,8 @@ def main(argv=None) -> None:
         run_compress(args)
     elif args.mode == "failover":
         run_failover(args)
+    elif args.mode == "tenants":
+        run_tenants(args)
     elif args.mode == "elastic":
         run_elastic(args)
     elif args.mode == "pipeline":

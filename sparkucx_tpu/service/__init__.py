@@ -1,0 +1,33 @@
+"""Multi-tenant shuffle service layer (ROADMAP item 4).
+
+Production Spark clusters run hundreds of concurrent applications against one
+shuffle service; the rest of this codebase assumes a single app owns the chip.
+This package layers multi-tenancy over the existing cluster without touching
+its single-tenant hot paths:
+
+* :mod:`sparkucx_tpu.service.tenants` — per-application registration
+  (``app_id``), HBM byte quotas with admission control at the store's
+  region-allocation point, ``(app_id, shuffle_id)`` -> internal shuffle-id
+  translation, and per-tenant wire credit budgets (the ``CreditGate``
+  generalized so one tenant cannot starve the lanes).
+* :mod:`sparkucx_tpu.service.eviction` — epoch/LRU demotion of sealed rounds
+  down the store's existing tiers (HBM-resident ``jax.Array`` -> host
+  snapshot -> ``np.memmap`` spill) with transparent restage-on-fetch, restage
+  ordering chosen to bound peak staging footprint (the memory-footprint-aware
+  redistribution planning of arXiv:2112.01075 applied to tier scheduling).
+* :mod:`sparkucx_tpu.service.reactor` — a shared ``selectors``-based event
+  loop + bounded worker pool that replaces thread-per-connection serving in
+  ``shuffle/daemon.py`` and the ``transport/peer.py`` block server, so one
+  process holds thousands of reducer connections.
+
+Everything is gated behind ``spark.shuffle.tpu.tenants.enabled`` (default
+off): with it off no tenant state exists, no wire extension is sent, and the
+serving planes keep their historical thread-per-connection behavior —
+byte-identical to the single-tenant build.
+"""
+
+from sparkucx_tpu.service.eviction import EvictionManager
+from sparkucx_tpu.service.reactor import Reactor
+from sparkucx_tpu.service.tenants import Tenant, TenantRegistry
+
+__all__ = ["EvictionManager", "Reactor", "Tenant", "TenantRegistry"]

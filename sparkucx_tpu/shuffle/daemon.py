@@ -46,6 +46,7 @@ from sparkucx_tpu.core.definitions import (
     pack_frame,
     pack_frame_prefix,
 )
+from sparkucx_tpu.service.reactor import Reactor
 from sparkucx_tpu.shuffle.manager import TpuShuffleManager
 from sparkucx_tpu.transport.peer import (
     BlockServer,
@@ -120,8 +121,18 @@ class ShuffleDaemon:
         self._streams: Dict[Tuple[int, int], object] = {}  #: guarded by self._lock
         self._next_writer = 0  #: guarded by self._lock
         self._lock = threading.Lock()
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
-        self._thread.start()
+        # Serving plane: thread-per-connection by default; with
+        # server.workers set (or tenants.enabled) the shared reactor holds
+        # every idle client in one selector and serves frames from a bounded
+        # pool (service/reactor.py) — same dispatch code either way.
+        self._reactor: Optional[Reactor] = None
+        self._thread: Optional[threading.Thread] = None
+        if self.conf.server_workers > 0 or self.conf.tenants_enabled:
+            self._reactor = Reactor(self.conf.server_workers, name="sparkucx-daemon")
+            self._reactor.add_listener(self._srv, self._on_accept)
+        else:
+            self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+            self._thread.start()
 
     # ------------------------------------------------------------------
 
@@ -139,25 +150,42 @@ class ShuffleDaemon:
                 return
             threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
+    def _on_accept(self, conn: socket.socket) -> None:
+        """Reactor accept path: restore blocking reads (the listener is
+        non-blocking under the selector), then park the connection."""
+        apply_wire_sockopts(conn, self.conf)
+        conn.setblocking(True)
+        self._reactor.add_connection(conn, self._serve_step)
+
     def _ack(self, conn, ok: bool, body: bytes = b"", **extra) -> None:
         conn.sendall(_frame(DaemonOp.ACK, {"ok": ok, **extra}, body))
 
-    def _serve(self, conn: socket.socket) -> None:
+    def _serve_step(self, conn: socket.socket) -> bool:
+        """Read + dispatch exactly one frame; True keeps the connection.
+        The unit of work for both serving planes — the per-connection threads
+        loop over it, the reactor re-arms the connection after each True."""
+        if not self._running:
+            return False
         try:
-            while self._running:
-                frame = _read_frame(conn)
-                if frame is None:
-                    return
-                op, meta, body = frame
-                try:
-                    self._dispatch(conn, op, meta, body)
-                except Exception as e:
-                    self._ack(conn, False, error=f"{type(e).__name__}: {e}")
+            frame = _read_frame(conn)
+            if frame is None:
+                return False
+            op, meta, body = frame
+            try:
+                self._dispatch(conn, op, meta, body)
+            except Exception as e:
+                self._ack(conn, False, error=f"{type(e).__name__}: {e}")
+            return True
         except (OSError, ValueError):
             # dead socket or an unparseable/oversized frame: drop THIS
             # connection, keep serving others (the endpoint-eviction policy,
             # UcxWorkerWrapper.scala:248-253)
-            pass
+            return False
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while self._serve_step(conn):
+                pass
         finally:
             conn.close()
 
@@ -266,6 +294,8 @@ class ShuffleDaemon:
             self._srv.close()
         except OSError:
             pass
+        if self._reactor is not None:
+            self._reactor.close()
         self.manager.stop()
 
 

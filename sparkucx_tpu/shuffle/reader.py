@@ -28,7 +28,13 @@ from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, 
 import numpy as np
 
 from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
-from sparkucx_tpu.core.operation import OperationStatus, Request, TransportError
+from sparkucx_tpu.core.operation import (
+    OperationStatus,
+    Request,
+    TenantQuotaExceededError,
+    TransportError,
+    UnknownTenantError,
+)
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.memory.pool import MemoryPool
 from sparkucx_tpu.utils.trace import instant, span
@@ -421,7 +427,18 @@ class TpuShuffleReader:
         ``buf is None`` means the original buffer was quarantined (its request
         never completed); each attempt then allocates a fresh buffer, and a
         timed-out attempt quarantines its buffer too.  Returns
-        ``(result, buffer_holding_the_bytes)``."""
+        ``(result, buffer_holding_the_bytes)``.
+
+        Tenant admission rejections (UnknownTenantError /
+        TenantQuotaExceededError) are NOT retried: every replica enforces the
+        same registry budgets, so failing over would just re-pay the backoff
+        to hit the same wall — they propagate immediately."""
+        if failed is not None and isinstance(
+            failed.error, (TenantQuotaExceededError, UnknownTenantError)
+        ):
+            if buf is not None:
+                buf.close()
+            raise failed.error
         last_error = failed.error if failed is not None else "fetch deadline exceeded"
         size = self.block_sizes(bid.map_id, bid.reduce_id)
         primary = self.sender_of(bid.map_id)
@@ -491,6 +508,11 @@ class TpuShuffleReader:
                     )
                     return result, buf
                 last_error = result.error
+                if isinstance(
+                    last_error, (TenantQuotaExceededError, UnknownTenantError)
+                ):
+                    buf.close()
+                    raise last_error
         if buf is not None:
             buf.close()
         raise TransportError(

@@ -36,7 +36,9 @@ Key design departures (TPU-first, each replacing a reference POC shortcut):
 
 from __future__ import annotations
 
+import shutil
 import threading
+import weakref
 from bisect import bisect_right
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -59,6 +61,18 @@ def default_peer_ranges(num_reducers: int, num_peers: int) -> List[Tuple[int, in
         ranges.append((start, start + n))
         start += n
     return ranges
+
+
+def _purge_spill_dir(holder: Dict[str, Optional[str]]) -> None:
+    """Remove a store's private spill tempdir wholesale.  Module-level so the
+    ``weakref.finalize`` registered at store construction holds no reference
+    to the store itself — the one spill-dir leak is a store dropped without
+    ``close()`` (GC / interpreter exit), and a bound method would keep the
+    store alive forever."""
+    path = holder.get("dir")
+    if path is not None:
+        shutil.rmtree(path, ignore_errors=True)
+        holder["dir"] = None
 
 
 @dataclass
@@ -124,6 +138,12 @@ class _ShuffleState:
         self.committed_maps: set = set()
         self.sealed_payload: Optional[object] = None  # jax.Array | np.ndarray
         self._range_starts = [r[0] for r in peer_ranges]
+        #: Owning tenant (multi-tenant service, service/tenants.py); None for
+        #: single-tenant shuffles — no charges, no translation, no wire ext.
+        self.app_id: Optional[str] = None
+        #: Bytes currently charged against the owning tenant's HBM quota
+        #: (region allocations + restaged rounds, minus disk-tier demotions).
+        self.tenant_charged = 0  #: guarded by the owning store's _lock
 
     @property
     def staging(self) -> Optional[np.ndarray]:
@@ -228,6 +248,9 @@ class MapWriter:
                         "host and device writes cannot mix"
                     )
                 st.device_mode = False
+                # Admission check first: an over-quota tenant write must fail
+                # typed with nothing allocated, rolled over, or copied.
+                self._store._charge_tenant(st, padded)
                 # Allocate in the current round; roll the staging epoch when the
                 # region can't take this partition (multi-round spill).
                 if int(st.region_used[peer]) + padded > st.region_size:
@@ -306,6 +329,7 @@ class MapWriter:
                         "host and device writes cannot mix"
                     )
                 st.device_mode = True
+                self._store._charge_tenant(st, padded)
                 if int(st.region_used[peer]) + padded > st.region_size:
                     if st.staging_closer is not None:
                         raise TransportError(
@@ -362,9 +386,22 @@ class HbmBlockStore:
         # arrive before this process registers the shuffle); applied at creation.
         self._pending_infos: Dict[int, List[MapperInfo]] = {}  #: guarded by self._lock
         self._lock = threading.RLock()
-        # disk round tier accounting (conf.spill_to_disk)
-        self._spill_dir: Optional[str] = None  #: guarded by self._lock
+        # disk round tier accounting (conf.spill_to_disk).  The tempdir path
+        # lives in a plain dict holder so the weakref.finalize below can purge
+        # it when the store is dropped WITHOUT close() (GC / interpreter
+        # exit) — the one path that used to leak sparkucx_tpu_spill_e* dirs.
+        self._spill_holder: Dict[str, Optional[str]] = {"dir": None}  #: guarded by self._lock
+        self._spill_finalizer = weakref.finalize(self, _purge_spill_dir, self._spill_holder)
         self._spill_bytes = 0  #: guarded by self._lock
+        #: Optional TenantRegistry (service/tenants.py).  When set, shuffles
+        #: created with an ``app_id`` are admission-checked: region
+        #: allocations charge the tenant's HBM quota and over-quota writes
+        #: raise TenantQuotaExceededError.  Written once at service wiring.
+        self.tenants = None
+        #: Optional EvictionManager hook (service/eviction.py): notified on
+        #: every block access so disk-tier rounds restage transparently.
+        #: Written once at service wiring.
+        self.eviction = None
         #: build_block_scatter compile cache keyed by pow2-bucketed geometry —
         #: the _gather_fn discipline (transport/tpu.py) applied to the write
         #: path, so varying-shape device rounds share a handful of compiles.
@@ -380,6 +417,16 @@ class HbmBlockStore:
         #: store lock is released — implementations may call back into the
         #: store freely.
         self.on_seal: Optional[Callable[[int], None]] = None
+
+    @property
+    def _spill_dir(self) -> Optional[str]:
+        return self._spill_holder["dir"]
+
+    @_spill_dir.setter
+    def _spill_dir(self, value: Optional[str]) -> None:
+        """Caller holds self._lock (both writers: _spill_round's lazy mkdtemp
+        and _release_spill's last-shuffle rmdir)."""
+        self._spill_holder["dir"] = value
 
     def _shm_staging(self, shuffle_id: int, nbytes: int):
         """Shared-memory staging for single-host zero-copy serving
@@ -404,7 +451,10 @@ class HbmBlockStore:
         num_reducers: int,
         peer_ranges: Optional[Sequence[Tuple[int, int]]] = None,
         capacity: Optional[int] = None,
+        app_id: Optional[str] = None,
     ) -> None:
+        if app_id is not None and self.tenants is not None:
+            self.tenants.resolve(app_id)  # typed UnknownTenantError if not registered
         with self._lock:
             if shuffle_id in self._shuffles:
                 raise TransportError(f"shuffle {shuffle_id} already exists")
@@ -425,6 +475,7 @@ class HbmBlockStore:
                 staging=staging,
                 staging_closer=closer,
             )
+            self._shuffles[shuffle_id].app_id = app_id
             pending = self._pending_infos.pop(shuffle_id, [])
         for info in pending:
             self.apply_mapper_info(info)
@@ -440,6 +491,7 @@ class HbmBlockStore:
                 st.staging_closer()
             if st is not None:
                 self._release_spill(st)
+                self._release_tenant(st, st.tenant_charged)
             for key in [k for k in self._replicas if k[0] == shuffle_id]:
                 for _index, arr in self._replicas[key].values():
                     self._replica_bytes -= int(arr.size)
@@ -455,6 +507,30 @@ class HbmBlockStore:
                     st.staging = None
                     st.staging_closer()
                 self._release_spill(st)
+                self._release_tenant(st, st.tenant_charged)
+            # The mkdtemp'd spill dir is store-private, so close() may remove
+            # it wholesale even when foreign files crept in (the rmdir in
+            # _release_spill only handles the empty-dir case).
+            _purge_spill_dir(self._spill_holder)
+            self._spill_bytes = 0
+
+    def _charge_tenant(self, st: _ShuffleState, nbytes: int) -> None:
+        """Admission check at allocation time (caller holds self._lock): claim
+        ``nbytes`` against the owning tenant's HBM quota.  Raises the typed
+        TenantQuotaExceededError BEFORE any state mutation, so a rejected
+        write leaves the store exactly as it was."""
+        if self.tenants is None or st.app_id is None or nbytes <= 0:
+            return
+        self.tenants.charge(st.app_id, st.shuffle_id, nbytes)
+        st.tenant_charged += nbytes
+
+    def _release_tenant(self, st: _ShuffleState, nbytes: int) -> None:
+        """Return quota bytes (caller holds self._lock): shuffle removal,
+        store close, or a round demoted off the HBM/host tiers."""
+        if self.tenants is None or st.app_id is None or nbytes <= 0:
+            return
+        self.tenants.release(st.app_id, nbytes)
+        st.tenant_charged = max(0, st.tenant_charged - nbytes)
 
     def _state(self, shuffle_id: int) -> _ShuffleState:
         with self._lock:
@@ -497,17 +573,30 @@ class HbmBlockStore:
         st.device_blocks = {}
         st.round += 1
 
-    def _spill_round(self, st: _ShuffleState, staging: np.ndarray) -> np.ndarray:
-        """Write the current round's staging to the disk tier; returns the
-        memmap that replaces the RAM snapshot (caller holds self._lock).
+    def _spill_round(
+        self,
+        st: _ShuffleState,
+        staging: np.ndarray,
+        round_idx: Optional[int] = None,
+        region_used: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Write one round's staging to the disk tier; returns the memmap that
+        replaces the RAM snapshot (caller holds self._lock).
 
         The file is logically full-capacity (so block offsets are unchanged)
         but only each region's used prefix is written — the rest stays a sparse
         hole, so disk writes and the spillDiskCap budget are proportional to
-        bytes actually staged, not to stagingCapacity."""
+        bytes actually staged, not to stagingCapacity.
+
+        Defaults spill the LIVE round (rollover); the eviction manager passes
+        ``round_idx``/``region_used`` to demote an already-completed round."""
         import os
         import tempfile
 
+        if round_idx is None:
+            round_idx = st.round
+        if region_used is None:
+            region_used = st.region_used
         if self._spill_dir is None:
             if self.conf.spill_dir is not None:
                 os.makedirs(self.conf.spill_dir, exist_ok=True)
@@ -516,16 +605,16 @@ class HbmBlockStore:
                 dir=self.conf.spill_dir,
             )
         cap = self.conf.spill_disk_cap_bytes
-        nbytes = int(st.region_used.sum())
+        nbytes = int(region_used.sum())
         if cap and self._spill_bytes + nbytes > cap:
             raise TransportError(
                 f"disk spill cap exceeded: {self._spill_bytes} B spilled + "
                 f"{nbytes} B round > spillDiskCap {cap} B"
             )
-        path = os.path.join(self._spill_dir, f"s{st.shuffle_id}_r{st.round}.bin")
+        path = os.path.join(self._spill_dir, f"s{st.shuffle_id}_r{round_idx}.bin")
         mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=staging.shape)
         for p in range(len(st.peer_ranges)):
-            used = int(st.region_used[p])
+            used = int(region_used[p])
             if used:
                 start = p * st.region_size
                 mm[start : start + used] = staging[start : start + used]
@@ -533,6 +622,22 @@ class HbmBlockStore:
         st.spill_files.append((path, nbytes))
         self._spill_bytes += nbytes
         return mm
+
+    def _unspill_file(self, st: _ShuffleState, path: str) -> None:
+        """Drop one spill file after its round restaged to RAM (caller holds
+        self._lock): unlink, return its budget, forget the bookkeeping entry.
+        A later re-demotion simply recreates the file."""
+        import os
+
+        for i, (p, nbytes) in enumerate(st.spill_files):
+            if p == path:
+                self._spill_bytes -= nbytes
+                del st.spill_files[i]
+                break
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
     def _release_spill(self, st: _ShuffleState) -> None:
         """Unlink a removed shuffle's spill files (caller holds self._lock).
@@ -758,6 +863,151 @@ class HbmBlockStore:
             shuffle_id, map_id, tuple(parts), tuple(rounds) if any(rounds) else None
         )
 
+    # -- tiered eviction (service/eviction.py drives these) ----------------
+
+    def _round_nbytes(self, st: _ShuffleState, round_idx: int) -> int:
+        """Staged (padded) bytes of one round (caller holds self._lock)."""
+        used = (
+            st.prev_rounds[round_idx][1]
+            if round_idx < len(st.prev_rounds)
+            else st.region_used
+        )
+        return int(used.sum())
+
+    def _tier_of(self, st: _ShuffleState, round_idx: int) -> str:
+        """Which tier currently backs a round (caller holds self._lock):
+        ``'hbm'`` (live device payload), ``'host'`` (RAM snapshot/staging),
+        ``'disk'`` (np.memmap spill)."""
+        if round_idx < len(st.prev_rounds):
+            arr = st.prev_rounds[round_idx][0]
+            return "disk" if isinstance(arr, np.memmap) else "host"
+        if st.sealed:
+            payload = st.sealed_payload[round_idx]
+            if hasattr(payload, "is_deleted"):
+                if not payload.is_deleted():
+                    return "hbm"
+            elif st._staging is None:
+                # demoted device round: the snapshot in sealed_payload is the
+                # only backing (device shuffles never allocate host staging)
+                return "disk" if isinstance(payload, np.memmap) else "host"
+        return "disk" if isinstance(st._staging, np.memmap) else "host"
+
+    def round_tier(self, shuffle_id: int, round_idx: int) -> Optional[str]:
+        """Public tier probe; None for unknown shuffles/rounds."""
+        with self._lock:
+            st = self._shuffles.get(shuffle_id)
+            if st is None or not (0 <= round_idx <= st.round):
+                return None
+            return self._tier_of(st, round_idx)
+
+    def round_bytes(self, shuffle_id: int, round_idx: int) -> int:
+        """Staged bytes of one round — the footprint the eviction manager's
+        restage plan orders by (arXiv:2112.01075)."""
+        with self._lock:
+            st = self._shuffles.get(shuffle_id)
+            if st is None or not (0 <= round_idx <= st.round):
+                return 0
+            return self._round_nbytes(st, round_idx)
+
+    def eviction_candidates(self) -> List[Tuple[int, int, str, int]]:
+        """``(shuffle_id, round, tier, staged_bytes)`` for every SEALED round
+        — the eviction manager's demotion/restage work list.  Unsealed
+        shuffles are excluded: their rounds are still being written and their
+        HBM payloads may be owned by an in-flight exchange."""
+        out: List[Tuple[int, int, str, int]] = []
+        with self._lock:
+            for sid, st in self._shuffles.items():
+                if not st.sealed:
+                    continue
+                for r in range(st.round + 1):
+                    out.append((sid, r, self._tier_of(st, r), self._round_nbytes(st, r)))
+        return out
+
+    def demote_round(self, shuffle_id: int, round_idx: int) -> Optional[str]:
+        """Move one sealed round ONE tier down: ``hbm -> host`` (drop the
+        device payload, keep/snapshot the host bytes) or ``host -> disk``
+        (``_spill_round`` memmap, RAM released, tenant quota bytes returned).
+        Returns the transition performed, or None when nothing moved (unknown
+        round, unsealed shuffle, already on disk, shm staging, or
+        spill_to_disk off).  ``read_block``/``block_staging_view`` keep
+        serving the round at every tier."""
+        with self._lock:
+            st = self._shuffles.get(shuffle_id)
+            if st is None or not st.sealed or not (0 <= round_idx <= st.round):
+                return None
+            lane = st.alignment // 4
+            tier = self._tier_of(st, round_idx)
+            if tier == "hbm":
+                payload = st.sealed_payload[round_idx]
+                if st.device_mode:
+                    # Device shuffles have no host staging: snapshot D2H once
+                    # (the same boundary _rollover_device pays), THEN delete.
+                    st.sealed_payload[round_idx] = np.asarray(payload)
+                else:
+                    st.sealed_payload[round_idx] = st.staging.view(np.int32).reshape(-1, lane)
+                try:
+                    payload.delete()
+                except Exception:
+                    pass  # already donated to an exchange
+                return "hbm->host"
+            if tier != "host" or not self.conf.spill_to_disk:
+                return None
+            if st.staging_closer is not None:
+                return None  # shm staging is shared with other processes
+            nbytes = self._round_nbytes(st, round_idx)
+            if round_idx < len(st.prev_rounds):
+                snap, used = st.prev_rounds[round_idx]
+                mm = self._spill_round(st, snap, round_idx, used)
+                st.prev_rounds[round_idx] = (mm, used)
+                st.sealed_payload[round_idx] = mm.view(np.int32).reshape(-1, lane)
+            elif st.device_mode:
+                host = st.sealed_payload[round_idx]
+                flat = np.asarray(host).reshape(-1).view(np.uint8)
+                mm = self._spill_round(st, flat, round_idx, st.region_used)
+                st.sealed_payload[round_idx] = mm.view(np.int32).reshape(-1, lane)
+            else:
+                snap = st.staging
+                mm = self._spill_round(st, snap, round_idx, st.region_used)
+                st.staging = mm
+                st.sealed_payload[round_idx] = mm.view(np.int32).reshape(-1, lane)
+            self._release_tenant(st, nbytes)
+            return "host->disk"
+
+    def restage_round(self, shuffle_id: int, round_idx: int) -> bool:
+        """Promote one disk-tier round back to host RAM (restage-on-fetch).
+        Re-charges the owning tenant's quota FIRST — an over-quota tenant
+        gets the typed TenantQuotaExceededError and the round stays on disk,
+        still serveable through the memmap.  The spill file is dropped once
+        the RAM copy is installed (a later demotion recreates it)."""
+        with self._lock:
+            st = self._shuffles.get(shuffle_id)
+            if st is None or not (0 <= round_idx <= st.round):
+                return False
+            if self._tier_of(st, round_idx) != "disk":
+                return False
+            lane = st.alignment // 4
+            self._charge_tenant(st, self._round_nbytes(st, round_idx))
+            if round_idx < len(st.prev_rounds):
+                mm, used = st.prev_rounds[round_idx]
+                arr = np.array(mm)
+                st.prev_rounds[round_idx] = (arr, used)
+                if st.sealed:
+                    st.sealed_payload[round_idx] = arr.view(np.int32).reshape(-1, lane)
+            elif st.device_mode:
+                mm = st.sealed_payload[round_idx]
+                arr = np.array(mm)
+                st.sealed_payload[round_idx] = arr
+            else:
+                mm = st.staging
+                arr = np.array(mm)
+                st.staging = arr
+                if st.sealed:
+                    st.sealed_payload[round_idx] = arr.view(np.int32).reshape(-1, lane)
+            path = getattr(mm, "filename", None)
+            if path:
+                self._unspill_file(st, str(path))
+            return True
+
     # -- read path (serve staged blocks) ----------------------------------
 
     def read_block(self, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
@@ -784,6 +1034,11 @@ class HbmBlockStore:
             raise BlockNotFoundError(shuffle_id, map_id, reduce_id, "not staged")
         if e.length == 0:
             return b""
+        # Eviction hook (no lock held): bumps the round's LRU clock and
+        # transparently restages a disk-tier round to RAM before we serve.
+        ev = self.eviction
+        if ev is not None:
+            ev.on_access(shuffle_id, e.round)
         if st.sealed:
             payload = st.sealed_payload[e.round]
             if not (hasattr(payload, "is_deleted") and payload.is_deleted()):
@@ -824,6 +1079,9 @@ class HbmBlockStore:
         e = st.blocks.get((map_id, reduce_id))
         if e is None:
             return None
+        ev = self.eviction
+        if ev is not None:
+            ev.on_access(shuffle_id, e.round)
         with self._lock:
             if e.round >= len(st.prev_rounds) and st.device_mode:
                 rows = st.device_blocks.get((map_id, reduce_id))

@@ -68,8 +68,11 @@ from sparkucx_tpu.core.operation import (
     OperationStats,
     OperationStatus,
     Request,
+    TenantQuotaExceededError,
     TransportError,
+    UnknownTenantError,
 )
+from sparkucx_tpu.service.reactor import Reactor
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 # tier-(a) wire compression policy + page formats; ops.compress keeps its jax
 # imports function-local, so this pulls no accelerator stack into the transport
@@ -87,6 +90,19 @@ _TAG = struct.Struct("<Q")
 _COUNT = struct.Struct("<I")
 _TRIPLE = struct.Struct("<iii")
 _SIZE = struct.Struct("<q")
+#: Tenant header extension of FETCH_BLOCK_REQ: <u32 len><utf-8 app_id> after
+#: the block triples.  Absent by default (single-tenant frames stay
+#: byte-identical to the golden captures); unpack_batch_fetch_req reads
+#: exactly ``count`` triples, so old servers ignore the extension.
+_APP = struct.Struct("<I")
+#: Negative size codes in fetch-reply size lists.  -1 is the historical
+#: block-not-found (retryable through replica failover); -2/-3 are the
+#: tenant admission rejections, surfaced client-side as the typed
+#: UnknownTenantError / TenantQuotaExceededError which readers treat as
+#: NOT retryable (every replica enforces the same registry).
+SIZE_NOT_FOUND = -1
+SIZE_UNKNOWN_TENANT = -2
+SIZE_QUOTA_EXCEEDED = -3
 #: CRC32C trailer appended to chunk / ReplicaPut headers when
 #: ``spark.shuffle.tpu.wire.checksum`` is on.  Receivers detect it by header
 #: length — the knob never changes frame layout when off (golden frames).
@@ -187,12 +203,23 @@ def recv_frame(sock: socket.socket, peer: str = "") -> Optional[Tuple[AmId, byte
     return am_id, header, body
 
 
-def pack_batch_fetch_req(tag: int, block_ids: Sequence[ShuffleBlockId]) -> bytes:
+def pack_batch_fetch_req(
+    tag: int, block_ids: Sequence[ShuffleBlockId], app_id: Optional[str] = None
+) -> bytes:
     """Header: tag + count + (sid, mid, rid) triples — the batched variant of the
-    reference's 12-byte fetch header (UcxWorkerWrapper.scala:96-126)."""
+    reference's 12-byte fetch header (UcxWorkerWrapper.scala:96-126).
+
+    With ``app_id`` (tenants.enabled) the requesting tenant rides as a
+    self-describing extension after the triples (``_APP`` length + utf-8
+    bytes); the triples then carry TENANT-LOCAL shuffle ids, which the server
+    translates through its registry.  ``app_id=None`` emits the historical
+    bytes exactly."""
     out = bytearray(_TAG.pack(tag) + _COUNT.pack(len(block_ids)))
     for b in block_ids:
         out += _TRIPLE.pack(b.shuffle_id, b.map_id, b.reduce_id)
+    if app_id:
+        raw = app_id.encode("utf-8")
+        out += _APP.pack(len(raw)) + raw
     return bytes(out)
 
 
@@ -206,6 +233,23 @@ def unpack_batch_fetch_req(header: bytes) -> Tuple[int, List[ShuffleBlockId]]:
         ids.append(ShuffleBlockId(s, m, r))
         pos += _TRIPLE.size
     return tag, ids
+
+
+def unpack_fetch_req_app_id(header: bytes, count: int) -> Optional[str]:
+    """The tenant extension of a FETCH_BLOCK_REQ header, or None when absent
+    (single-tenant frame) or malformed (treated as absent — the request then
+    resolves in the untranslated namespace, exactly like an old client)."""
+    pos = _TAG.size + _COUNT.size + count * _TRIPLE.size
+    if len(header) < pos + _APP.size:
+        return None
+    (n,) = _APP.unpack_from(header, pos)
+    raw = bytes(header[pos + _APP.size : pos + _APP.size + n])
+    if n == 0 or len(raw) != n:
+        return None
+    try:
+        return raw.decode("utf-8")
+    except UnicodeDecodeError:
+        return None
 
 
 class _ServerGroup:
@@ -313,6 +357,22 @@ class _ServerGroup:
                 pass
 
 
+class _ConnState:
+    """Per-connection serve state, shared by the thread-per-connection loop
+    and the reactor's frame-at-a-time serving: the stripe group this lane
+    joined (via WIRE_HELLO), its lane id, and the send lock the lane's group
+    sender thread shares with the serving code."""
+
+    __slots__ = ("peer", "send_lock", "group", "lane", "use_sendmsg")
+
+    def __init__(self, conn: socket.socket) -> None:
+        self.peer = _peername(conn)
+        self.send_lock = threading.Lock()
+        self.group: Optional[_ServerGroup] = None
+        self.lane = -1
+        self.use_sendmsg = hasattr(conn, "sendmsg")
+
+
 class BlockServer:
     """Serves registered blocks + staged-store blocks to peers.
 
@@ -331,10 +391,16 @@ class BlockServer:
         host: str = "127.0.0.1",
         port: int = 0,
         member_sink: Optional[Callable[[int, int, int, int], None]] = None,
+        tenants=None,
     ) -> None:
         self.conf = conf or TpuShuffleConf()
         self.store = store
         self.registry_lookup = registry_lookup
+        #: TenantRegistry of the owning process (service/tenants.py), or None
+        #: for the historical single-tenant server.  With a registry, FETCH
+        #: requests carrying the tenant extension get their shuffle ids
+        #: translated and their reply bytes drawn from per-tenant CreditGates.
+        self.tenants = tenants
         #: membership-frame sink: called as (am_id, epoch, subject, observer)
         #: for every MemberSuspect/MemberRejoin frame a peer sends us
         self.member_sink = member_sink
@@ -379,14 +445,26 @@ class BlockServer:
         #: once the encoded bytes held exceed _ENCODED_POOL_CAP.
         self._encoded_pool: Dict[tuple, tuple] = {}  #: guarded by self._compress_lock
         self._encoded_pool_bytes = 0  #: guarded by self._compress_lock
-        # numListenerThreads accept loops on one listen socket
-        # (UcxShuffleConf.scala:73-78; the kernel load-balances accepts).
-        self._threads = [
-            threading.Thread(target=self._accept_loop, daemon=True)
-            for _ in range(max(1, self.conf.num_listener_threads))
-        ]
-        for t in self._threads:
-            t.start()
+        # Serving plane: by default, numListenerThreads accept loops on one
+        # listen socket (UcxShuffleConf.scala:73-78; the kernel load-balances
+        # accepts) and a thread per accepted connection.  With server.workers
+        # set (or tenants.enabled), the shared reactor holds every idle
+        # connection in one selector and serves frames from a bounded pool —
+        # the scalable plane for many-tenant fan-in.
+        self._reactor: Optional[Reactor] = None
+        self._threads: list = []
+        if self.conf.server_workers > 0 or self.conf.tenants_enabled:
+            self._reactor = Reactor(
+                self.conf.server_workers, name=f"blocksrv-{self.address[1]}"
+            )
+            self._reactor.add_listener(self._srv, self._on_accept)
+        else:
+            self._threads = [
+                threading.Thread(target=self._accept_loop, daemon=True)
+                for _ in range(max(1, self.conf.num_listener_threads))
+            ]
+            for t in self._threads:
+                t.start()
         self.handshaken: Dict[int, bytes] = {}  # executor_id -> context blob
 
     def address_bytes(self) -> bytes:
@@ -413,6 +491,25 @@ class BlockServer:
             with self._accepted_lock:
                 self._accepted.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def _on_accept(self, conn: socket.socket) -> None:
+        """Reactor accept path: same socket setup as ``_accept_loop``, but the
+        connection parks in the shared selector instead of owning a thread."""
+        apply_wire_sockopts(conn, self.conf, sndbuf=4 << 20)
+        # accepted from a non-blocking listener: restore blocking reads (with
+        # the usual mid-frame timeout) for the frame-at-a-time workers
+        if self.conf.wire_timeout_ms:
+            conn.settimeout(self.conf.wire_timeout_ms / 1000.0)
+        else:
+            conn.setblocking(True)
+        with self._accepted_lock:
+            self._accepted.append(conn)
+        state = _ConnState(conn)
+        self._reactor.add_connection(
+            conn,
+            lambda c, s=state: self._serve_frame(c, s),
+            on_close=lambda c, s=state: self._drop_conn(c, s),
+        )
 
     def _resolve_one(self, bid: ShuffleBlockId):
         """Resolve to a ``(buffer, offset, length)`` view or None.
@@ -450,6 +547,11 @@ class BlockServer:
                 return self.store.block_staging_view(
                     bid.shuffle_id, bid.map_id, bid.reduce_id
                 )
+            except TenantQuotaExceededError:
+                # restage-on-fetch needed HBM headroom the owning tenant no
+                # longer has: a typed, addressed admission failure — NOT the
+                # retryable block-not-found
+                return SIZE_QUOTA_EXCEEDED
             except TransportError:
                 return None
         return None
@@ -465,8 +567,8 @@ class BlockServer:
 
         sizes, total = [], 0
         for e in entries:
-            if e is None:
-                sizes.append(-1)
+            if e is None or isinstance(e, int):
+                sizes.append(SIZE_NOT_FOUND if e is None else e)
             else:
                 sizes.append(e[2])
                 total += e[2]
@@ -474,7 +576,7 @@ class BlockServer:
         by_staging: Dict[int, Tuple[np.ndarray, list]] = {}
         pos = 0
         for e in entries:
-            if e is None:
+            if e is None or isinstance(e, int):
                 continue
             staging, off, ln = e
             if ln:
@@ -497,8 +599,8 @@ class BlockServer:
         replaced by vectored IO)."""
         sizes, parts, total = [], [], 0
         for e in entries:
-            if e is None:
-                sizes.append(-1)
+            if e is None or isinstance(e, int):
+                sizes.append(SIZE_NOT_FOUND if e is None else e)
                 continue
             staging, off, ln = e
             if ln:
@@ -540,8 +642,8 @@ class BlockServer:
         cspec = self._compress
         raw_total = wire_total = encoded_chunks = raw_chunks = cache_hits = 0
         for i, e in enumerate(entries):
-            if e is None:
-                sizes.append(-1)
+            if e is None or isinstance(e, int):
+                sizes.append(SIZE_NOT_FOUND if e is None else e)
                 continue
             staging, off, ln = e
             sizes.append(ln)
@@ -619,141 +721,217 @@ class BlockServer:
         group.enqueue(0, [manifest])
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        use_sendmsg = hasattr(conn, "sendmsg")
-        peer = _peername(conn)
-        # shared with this lane's stripe sender thread so control acks and
-        # chunk frames interleave only at frame granularity
-        send_lock = threading.Lock()
-        group: Optional[_ServerGroup] = None
-        lane = -1
+        state = _ConnState(conn)
         try:
             while self._running:
-                frame = recv_frame(conn, peer=peer)
+                frame = recv_frame(conn, peer=state.peer)
                 if frame is None:
                     return
-                am_id, header, body = frame
-                faults.check("peer.server.frame", peer=peer, am_id=int(am_id))
-                if am_id == AmId.FETCH_BLOCK_REQ:
-                    tag, bids = unpack_batch_fetch_req(header)
-                    if self._io is not None:
-                        # executor.map is lazy-in-order: all resolves run
-                        # concurrently, iteration yields each block as soon
-                        # as it (and its predecessors) complete
-                        entries = self._io.map(self._resolve_one, bids)
-                    else:
-                        entries = map(self._resolve_one, bids)
-                    if group is not None and group.ready():
-                        self._serve_fetch_striped(group, tag, bids, entries)
-                        continue
-                    entries = list(entries)
-                    if use_sendmsg:
-                        sizes, parts, total = self._reply_parts(entries)
-                        reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
-                        prefix = pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, total)
-                        with send_lock:
-                            self._sendmsg_all(conn, [prefix] + parts)
-                        continue
-                    sizes, body = self._assemble_reply(entries)
-                    reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
-                    with send_lock:
-                        conn.sendall(
-                            pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, body.size)
-                        )
-                        if body.size:
-                            conn.sendall(memoryview(body))
-                elif am_id == AmId.WIRE_HELLO:
-                    gid, lane, nlanes, chunk_bytes = unpack_wire_hello(header)
-                    with self._groups_lock:
-                        group = self._groups.get(gid)
-                        if group is None:
-                            group = self._groups[gid] = _ServerGroup(gid, nlanes, chunk_bytes)
-                    group.register(lane, conn, send_lock)
-                elif am_id == AmId.MAPPER_INFO:
-                    info = MapperInfo.unpack(body)
-                    if self.store is not None:
-                        try:
-                            self.store.apply_mapper_info(info)
-                        except TransportError:
-                            pass  # shuffle not created on this server yet
-                elif am_id == AmId.REPLICA_PUT:
-                    # header extensions after the entry table, detected by the
-                    # residue mod entry size: 0 plain, 4 crc, 8 codec, 12
-                    # codec+crc (core/definitions.py).  The crc trailer is
-                    # always LAST and covers the WIRE (possibly encoded) body.
-                    residue = (len(header) - REPLICA_HEADER_SIZE) % REPLICA_ENTRY_SIZE
-                    if residue in (4, 12):
-                        # wire.checksum trailer: verify before installing; a
-                        # corrupt replica gets NO ack, so the pusher's
-                        # replication_wait names this successor as stalled
-                        # instead of the store holding silently bad bytes
-                        (want,) = _CRC.unpack(bytes(header[-4:]))
-                        header = header[:-4]
-                        if crc32c(body) != want:
-                            sid, src, rnd, _ = unpack_replica_put(header)
-                            logger.warning(
-                                "replica round (shuffle=%d, src=%d, round=%d) from "
-                                "peer %s failed crc32c — discarded, not acked",
-                                sid, src, rnd, peer,
-                            )
-                            continue
-                    if residue in (8, 12):
-                        # compress.codec ext: the whole round body is one
-                        # encoded page; a decode failure is handled exactly
-                        # like a crc mismatch — discard, no ack
-                        codec_id, raw_len = unpack_chunk_codec_ext(
-                            header, len(header) - CHUNK_CODEC_EXT_SIZE
-                        )
-                        header = header[:-CHUNK_CODEC_EXT_SIZE]
-                        if codec_id != CODEC_RAW or raw_len != len(body):
-                            decoded = bytearray(raw_len)
-                            try:
-                                decode_page(codec_id, body, decoded)
-                            except CodecError as e:
-                                sid, src, rnd, _ = unpack_replica_put(header)
-                                logger.warning(
-                                    "replica round (shuffle=%d, src=%d, round=%d) "
-                                    "from peer %s failed page decode (%s) — "
-                                    "discarded, not acked",
-                                    sid, src, rnd, peer, e,
-                                )
-                                continue
-                            body = decoded
-                    sid, src, rnd, entries = unpack_replica_put(header)
-                    faults.check(
-                        "replica.apply", shuffle_id=sid, src_executor=src, round_idx=rnd
-                    )
-                    if self.store is not None:
-                        self.store.put_replica(sid, src, rnd, entries, body)
-                    with send_lock:
-                        conn.sendall(
-                            pack_frame(AmId.REPLICA_ACK, pack_replica_ack(sid, src, rnd))
-                        )
-                elif am_id in (AmId.MEMBER_SUSPECT, AmId.MEMBER_REJOIN):
-                    epoch, subject, observer = unpack_member_event(header)
-                    if self.member_sink is not None:
-                        self.member_sink(int(am_id), epoch, subject, observer)
-                elif am_id == AmId.INIT_EXECUTOR_REQ:
-                    (eid,) = _TAG.unpack_from(header)
-                    self.handshaken[eid] = body
-                    with send_lock:
-                        conn.sendall(pack_frame(AmId.INIT_EXECUTOR_ACK, header, b""))
+                self._dispatch_frame(conn, state, *frame)
         except (OSError, ValueError, struct.error):
             # malformed frame or dead socket: drop THIS connection, keep serving
             # (the reference's endpoint error handler evicts one endpoint,
             # UcxWorkerWrapper.scala:248-253)
             pass
         finally:
-            if group is not None:
-                group.drop_lane(lane)
-                with self._groups_lock:
-                    if self._groups.get(group.group_id) is group:
-                        del self._groups[group.group_id]
+            self._drop_conn(conn, state)
+
+    def _serve_frame(self, conn: socket.socket, state: _ConnState) -> bool:
+        """Reactor worker entry: serve exactly ONE frame; True re-arms the
+        connection in the selector.  The header read blocks only briefly —
+        the selector fired because bytes are pending — and the dispatch is
+        the same code the per-connection threads run."""
+        if not self._running:
+            return False
+        try:
+            frame = recv_frame(conn, peer=state.peer)
+            if frame is None:
+                return False
+            self._dispatch_frame(conn, state, *frame)
+            return True
+        except (OSError, ValueError, struct.error):
+            return False
+
+    def _drop_conn(self, conn: socket.socket, state: _ConnState) -> None:
+        """Connection teardown shared by both serving planes (idempotent)."""
+        if state.group is not None:
+            state.group.drop_lane(state.lane)
+            with self._groups_lock:
+                if self._groups.get(state.group.group_id) is state.group:
+                    del self._groups[state.group.group_id]
+            state.group = None
+        try:
             conn.close()
-            with self._accepted_lock:
+        except OSError:
+            pass
+        with self._accepted_lock:
+            try:
+                self._accepted.remove(conn)
+            except ValueError:
+                pass
+
+    def _serve_fetch_req(self, conn: socket.socket, state: _ConnState, header: bytes) -> None:
+        tag, bids = unpack_batch_fetch_req(header)
+        app_id = unpack_fetch_req_app_id(header, len(bids))
+        gate = None
+        code: Optional[int] = None
+        if app_id is not None:
+            # tenant-addressed request: translate its local shuffle ids (or
+            # reject the whole batch with the typed unknown-tenant code — a
+            # server with no registry cannot admit ANY tenant traffic)
+            if self.tenants is None:
+                code = SIZE_UNKNOWN_TENANT
+            else:
                 try:
-                    self._accepted.remove(conn)
-                except ValueError:
-                    pass
+                    bids = [
+                        ShuffleBlockId(
+                            self.tenants.translate(app_id, b.shuffle_id),
+                            b.map_id,
+                            b.reduce_id,
+                        )
+                        for b in bids
+                    ]
+                    gate = self.tenants.gate(app_id)
+                except UnknownTenantError:
+                    code = SIZE_UNKNOWN_TENANT
+        if code is not None:
+            entries = [code] * len(bids)
+        elif self._io is not None:
+            # executor.map is lazy-in-order: all resolves run concurrently,
+            # iteration yields each block as soon as it (and its
+            # predecessors) complete
+            entries = self._io.map(self._resolve_one, bids)
+        else:
+            entries = map(self._resolve_one, bids)
+        group = state.group
+        if group is not None and group.ready():
+            if gate is None:
+                self._serve_fetch_striped(group, tag, bids, entries)
+                return
+            # per-tenant wire credits: the whole reply's bytes are held
+            # against the tenant's gate while its chunks stream, so one
+            # tenant's fan-in cannot monopolize every lane
+            entries = list(entries)
+            total = sum(e[2] for e in entries if isinstance(e, tuple))
+            gate.acquire(total)
+            try:
+                self._serve_fetch_striped(group, tag, bids, entries)
+            finally:
+                gate.release(total)
+            return
+        entries = list(entries)
+        if state.use_sendmsg:
+            sizes, parts, total = self._reply_parts(entries)
+            reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
+            prefix = pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, total)
+            if gate is not None:
+                gate.acquire(total)
+            try:
+                with state.send_lock:
+                    self._sendmsg_all(conn, [prefix] + parts)
+            finally:
+                if gate is not None:
+                    gate.release(total)
+            return
+        sizes, body = self._assemble_reply(entries)
+        reply_hdr = _TAG.pack(tag) + _COUNT.pack(len(bids)) + sizes
+        if gate is not None:
+            gate.acquire(body.size)
+        try:
+            with state.send_lock:
+                conn.sendall(
+                    pack_frame_prefix(AmId.FETCH_BLOCK_REQ_ACK, reply_hdr, body.size)
+                )
+                if body.size:
+                    conn.sendall(memoryview(body))
+        finally:
+            if gate is not None:
+                gate.release(body.size)
+
+    def _dispatch_frame(
+        self, conn: socket.socket, state: _ConnState, am_id: AmId, header: bytes, body: bytes
+    ) -> None:
+        peer, send_lock = state.peer, state.send_lock
+        faults.check("peer.server.frame", peer=peer, am_id=int(am_id))
+        if am_id == AmId.FETCH_BLOCK_REQ:
+            self._serve_fetch_req(conn, state, header)
+        elif am_id == AmId.WIRE_HELLO:
+            gid, lane, nlanes, chunk_bytes = unpack_wire_hello(header)
+            with self._groups_lock:
+                group = self._groups.get(gid)
+                if group is None:
+                    group = self._groups[gid] = _ServerGroup(gid, nlanes, chunk_bytes)
+            state.group, state.lane = group, lane
+            group.register(lane, conn, send_lock)
+        elif am_id == AmId.MAPPER_INFO:
+            info = MapperInfo.unpack(body)
+            if self.store is not None:
+                try:
+                    self.store.apply_mapper_info(info)
+                except TransportError:
+                    pass  # shuffle not created on this server yet
+        elif am_id == AmId.REPLICA_PUT:
+            # header extensions after the entry table, detected by the
+            # residue mod entry size: 0 plain, 4 crc, 8 codec, 12
+            # codec+crc (core/definitions.py).  The crc trailer is
+            # always LAST and covers the WIRE (possibly encoded) body.
+            residue = (len(header) - REPLICA_HEADER_SIZE) % REPLICA_ENTRY_SIZE
+            if residue in (4, 12):
+                # wire.checksum trailer: verify before installing; a
+                # corrupt replica gets NO ack, so the pusher's
+                # replication_wait names this successor as stalled
+                # instead of the store holding silently bad bytes
+                (want,) = _CRC.unpack(bytes(header[-4:]))
+                header = header[:-4]
+                if crc32c(body) != want:
+                    sid, src, rnd, _ = unpack_replica_put(header)
+                    logger.warning(
+                        "replica round (shuffle=%d, src=%d, round=%d) from "
+                        "peer %s failed crc32c — discarded, not acked",
+                        sid, src, rnd, peer,
+                    )
+                    return
+            if residue in (8, 12):
+                # compress.codec ext: the whole round body is one
+                # encoded page; a decode failure is handled exactly
+                # like a crc mismatch — discard, no ack
+                codec_id, raw_len = unpack_chunk_codec_ext(
+                    header, len(header) - CHUNK_CODEC_EXT_SIZE
+                )
+                header = header[:-CHUNK_CODEC_EXT_SIZE]
+                if codec_id != CODEC_RAW or raw_len != len(body):
+                    decoded = bytearray(raw_len)
+                    try:
+                        decode_page(codec_id, body, decoded)
+                    except CodecError as e:
+                        sid, src, rnd, _ = unpack_replica_put(header)
+                        logger.warning(
+                            "replica round (shuffle=%d, src=%d, round=%d) "
+                            "from peer %s failed page decode (%s) — "
+                            "discarded, not acked",
+                            sid, src, rnd, peer, e,
+                        )
+                        return
+                    body = decoded
+            sid, src, rnd, entries = unpack_replica_put(header)
+            faults.check(
+                "replica.apply", shuffle_id=sid, src_executor=src, round_idx=rnd
+            )
+            if self.store is not None:
+                self.store.put_replica(sid, src, rnd, entries, body)
+            with send_lock:
+                conn.sendall(
+                    pack_frame(AmId.REPLICA_ACK, pack_replica_ack(sid, src, rnd))
+                )
+        elif am_id in (AmId.MEMBER_SUSPECT, AmId.MEMBER_REJOIN):
+            epoch, subject, observer = unpack_member_event(header)
+            if self.member_sink is not None:
+                self.member_sink(int(am_id), epoch, subject, observer)
+        elif am_id == AmId.INIT_EXECUTOR_REQ:
+            (eid,) = _TAG.unpack_from(header)
+            self.handshaken[eid] = body
+            with send_lock:
+                conn.sendall(pack_frame(AmId.INIT_EXECUTOR_ACK, header, b""))
 
     def close(self) -> None:
         self._running = False
@@ -776,6 +954,9 @@ class BlockServer:
                 conn.close()
             except OSError:
                 pass
+        if self._reactor is not None:
+            # after the conns are shut down, so no worker is blocked mid-frame
+            self._reactor.close()
         if self._io is not None:
             self._io.shutdown(wait=False)
 
@@ -1259,6 +1440,12 @@ class PeerTransport(ShuffleTransport):
         #: driver / loopback harness); peer-observed wire failures and rejoin
         #: announcements feed it.  None = membership-unaware (the default).
         self.membership = None
+        #: Multi-tenant identity of this executor's fetches: with
+        #: ``conf.tenants_enabled`` and an ``app_id`` set, every
+        #: FETCH_BLOCK_REQ carries the tenant header extension and its triples
+        #: use tenant-local shuffle ids (servers translate via their
+        #: registry).  None (the default) emits the historical frames.
+        self.app_id: Optional[str] = None
         self.stats_agg = StatsAggregator() if self.conf.collect_stats else None
         #: Wakeup doorbell (conf.use_wakeup): recv threads set it when an ack
         #: parks, so fetch loops can sleep in wait_for_activity() instead of
@@ -1405,6 +1592,7 @@ class PeerTransport(ShuffleTransport):
         self.server = BlockServer(
             self.conf, store=self.store, registry_lookup=self.registered_block,
             host=host, port=port, member_sink=self._on_member_event,
+            tenants=getattr(self.store, "tenants", None),
         )
         return self.server.address_bytes()
 
@@ -1686,7 +1874,16 @@ class PeerTransport(ShuffleTransport):
                         # group's lanes: start the receive accounting now,
                         # before any chunk can race the request send
                         self._stripe_rx[tag] = _StripeRx()
-            conn.send(pack_frame(AmId.FETCH_BLOCK_REQ, pack_batch_fetch_req(tag, bids)))
+            conn.send(
+                pack_frame(
+                    AmId.FETCH_BLOCK_REQ,
+                    pack_batch_fetch_req(
+                        tag,
+                        bids,
+                        app_id=self.app_id if self.conf.tenants_enabled else None,
+                    ),
+                )
+            )
         except (TransportError, OSError) as e:
             # endpoint failure: evict the cached connection and fail the batch —
             # the reference's error-handler drop-from-cache path
@@ -1878,10 +2075,22 @@ class PeerTransport(ShuffleTransport):
             size = sizes[i]
             if size < 0:
                 req.stats.mark_done()
+                peer = getattr(_conn, "peer", "?")
+                if size == SIZE_UNKNOWN_TENANT:
+                    err: TransportError = UnknownTenantError(
+                        self.app_id or "?",
+                        f"peer {peer} rejected the fetch: tenant not registered there",
+                    )
+                elif size == SIZE_QUOTA_EXCEEDED:
+                    err = TenantQuotaExceededError(
+                        self.app_id or "?",
+                        -1,
+                        detail=f"peer {peer} could not stage the block within quota",
+                    )
+                else:
+                    err = TransportError("block not found on peer")
                 result = OperationResult(
-                    OperationStatus.FAILURE,
-                    error=TransportError("block not found on peer"),
-                    stats=req.stats,
+                    OperationStatus.FAILURE, error=err, stats=req.stats
                 )
             else:
                 view = buf.host_view()

@@ -313,3 +313,69 @@ class TestExecutorLossChaos:
                 list(reader.fetch_blocks())
         finally:
             _close_all(ts)
+
+
+# ---------------------------------------------------------------------------
+# tiered eviction x replication: demoted rounds through the chaos path
+# ---------------------------------------------------------------------------
+
+
+class TestDemotedRoundReplication:
+    def test_demoted_round_bit_identical_through_kill(self):
+        """Eviction composed with the existing resilience features: the
+        primary's sealed round is demoted to disk (checksummed + compressed
+        striped wire), the first fetch restages it transparently, the primary
+        is then killed mid-stream and the ring replica — never demoted —
+        serves the remainder.  Output must be bit-identical throughout."""
+        from sparkucx_tpu.service.eviction import EvictionManager
+
+        ts = _cluster(
+            3,
+            replication_factor=1,
+            wire_timeout_ms=5000,
+            wire_streams=2,
+            wire_checksum=True,
+            wire_compress_codec="dict",
+        )
+        try:
+            payloads = _stage(ts[1], 0, 2, 3, seed=9)
+            ts[1].store.seal(0)
+            assert ts[1].replication_wait(0, timeout=10.0)
+            ev = EvictionManager(ts[1].store)
+            ts[1].store.eviction = ev
+            while ts[1].store.round_tier(0, 0) != "disk":
+                assert ts[1].store.demote_round(0, 0) is not None
+            reader = _reader(ts[0], payloads, 2, 3, executors=[0, 1, 2])
+            got = {}
+            it = reader.fetch_blocks()
+            first = next(it)  # cold fetch: restages the demoted round
+            got[(first.block_id.map_id, first.block_id.reduce_id)] = bytes(first.data)
+            first.release()
+            assert ts[1].store.round_tier(0, 0) == "host"
+            assert ev.eviction_stats()["restages"] >= 1
+            faults.kill_executor(ts[1])  # replica takes over mid-stream
+            for blk in it:
+                got[(blk.block_id.map_id, blk.block_id.reduce_id)] = bytes(blk.data)
+                blk.release()
+            assert got == payloads  # bit-identical across tier + holder moves
+            assert reader.metrics.failovers >= 1
+        finally:
+            _close_all(ts)
+
+    def test_demotion_never_touches_replica_tier(self):
+        """Demoting the primary's round is local: the neighbor's replica
+        bytes stay resident and serve reads unchanged."""
+        from sparkucx_tpu.service.eviction import EvictionManager
+
+        ts = _cluster(2, replication_factor=1)
+        try:
+            payloads = _stage(ts[0], 6, 1, 2, seed=5)
+            ts[0].store.seal(6)
+            assert ts[0].replication_wait(6, timeout=10.0)
+            ts[0].store.eviction = EvictionManager(ts[0].store)
+            while ts[0].store.round_tier(6, 0) != "disk":
+                assert ts[0].store.demote_round(6, 0) is not None
+            for (m, r), data in payloads.items():
+                assert ts[1].store.read_block(6, m, r) == data
+        finally:
+            _close_all(ts)

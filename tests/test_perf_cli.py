@@ -77,6 +77,20 @@ def test_elastic_mode(capsys):
     assert "mesh 4 -> 2" in out
 
 
+def test_tenants_mode(capsys):
+    # multi-tenant serving plane: N concurrent apps streaming their own
+    # tenant-namespaced blocks back through the shared-selector reactor
+    benchmark.run_tenants(
+        benchmark._parse_args(
+            ["tenants", "--apps", "3", "-n", "4", "-s", "64k", "-i", "1"]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "tenants: 3 apps" in out
+    assert "fairness" in out and "p99 fetch" in out
+    assert out.count("GB/s,") >= 3  # one per-app line per registered app
+
+
 def test_cli_flags_match_reference():
     # -a/-f/-n/-s/-i/-o/-r/-t (UcxPerfBenchmark.scala:41-59)
     args = benchmark._parse_args(

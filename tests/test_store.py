@@ -387,3 +387,69 @@ class TestLifecycle:
         s = HbmBlockStore(TpuShuffleConf(staging_capacity_per_executor=64))
         with pytest.raises(ValueError, match="too small"):
             s.create_shuffle(0, 1, 8, peer_ranges=default_peer_ranges(8, 8))
+
+
+class TestSpillDirLifecycle:
+    """The DEFAULT spill location (spill_dir=None -> per-store system tempdir,
+    prefix sparkucx_tpu_spill_e*) must be fully reclaimed: per-shuffle files on
+    remove_shuffle, the directory itself on close() or when the last spilled
+    shuffle goes away.  Guards the leak where long-lived executors littered
+    /tmp with sparkucx_tpu_spill_e* dirs."""
+
+    def _fill_rounds(self, s, shuffle_id, num_rounds, region):
+        for m in range(num_rounds):
+            w = s.map_writer(shuffle_id, m)
+            w.write_partition(0, bytes([m + 1]) * region)
+            w.commit()
+
+    def _spilled_store(self):
+        s = HbmBlockStore(
+            TpuShuffleConf(staging_capacity_per_executor=4096, block_alignment=ALIGN)
+        )
+        s.create_shuffle(0, 3, 1)
+        self._fill_rounds(s, 0, 3, s._state(0).region_size)
+        return s
+
+    def test_close_removes_default_tempdir(self):
+        import os
+
+        s = self._spilled_store()
+        d = s._spill_dir
+        assert d is not None and os.path.isdir(d)
+        assert os.path.basename(d).startswith("sparkucx_tpu_spill_e")
+        s.close()
+        assert not os.path.exists(d)
+
+    def test_remove_last_spilled_shuffle_reclaims_dir(self):
+        import os
+
+        s = self._spilled_store()
+        d = s._spill_dir
+        assert d is not None and len(os.listdir(d)) == 2  # 3 rounds, 2 spilled
+        s.remove_shuffle(0)
+        # files AND the tempdir itself are gone; bookkeeping reset
+        assert not os.path.exists(d)
+        assert s._spill_dir is None
+        # a later spill transparently recreates a fresh dir
+        s.create_shuffle(1, 3, 1)
+        self._fill_rounds(s, 1, 3, s._state(1).region_size)
+        d2 = s._spill_dir
+        assert d2 is not None and d2 != d and os.path.isdir(d2)
+        s.close()
+        assert not os.path.exists(d2)
+
+    def test_no_leftover_spill_dirs_in_tempdir(self):
+        import os
+        import tempfile
+
+        def leftovers():
+            return {
+                f
+                for f in os.listdir(tempfile.gettempdir())
+                if f.startswith("sparkucx_tpu_spill_e")
+            }
+
+        before = leftovers()
+        s = self._spilled_store()
+        s.close()
+        assert leftovers() == before

@@ -1293,3 +1293,54 @@ class TestReplicaCompression:
                 sock.close()
             a.close()
             b.close()
+
+
+# ---------------------------------------------------------------------------
+# tiered eviction x the wire: rounds demoted mid-fetch serve from every tier
+# ---------------------------------------------------------------------------
+
+
+class TestDemoteMidFetch:
+    @pytest.mark.parametrize("streams", [1, 2])
+    def test_round_demoted_between_windows_bit_identical(self, streams):
+        """A sealed round demoted host->disk BETWEEN fetch windows keeps
+        serving bit-identically: the next fetch lands on the memmap tier and
+        the eviction manager transparently restages the round to RAM
+        (service/eviction.py restage-on-fetch), on both the monolithic and
+        the striped serve paths."""
+        from sparkucx_tpu.service.eviction import EvictionManager
+
+        a, b = _pair(streams=streams)
+        try:
+            rng = np.random.default_rng(11)
+            b.store.create_shuffle(3, 1, 4)
+            w = b.store.map_writer(3, 0)
+            oracle = {}
+            for r in range(4):
+                data = rng.integers(0, 256, size=700 + 41 * r, dtype=np.uint8).tobytes()
+                oracle[r] = data
+                w.write_partition(r, data)
+            w.commit()
+            b.store.seal(3)
+            ev = EvictionManager(b.store)
+            b.store.eviction = ev
+
+            def fetch(r):
+                buf = _buf(len(oracle[r]))
+                req = a.fetch_block(2, 3, 0, r, buf)
+                _drive(a, [req])
+                res = req.wait(0)
+                assert res.status == OperationStatus.SUCCESS, str(res.error)
+                return buf.host_view()[: buf.size].tobytes()
+
+            assert fetch(0) == oracle[0]  # served from the resident tier
+            while b.store.round_tier(3, 0) != "disk":  # demote mid-stream
+                assert b.store.demote_round(3, 0) is not None
+            assert fetch(1) == oracle[1]  # cold fetch: restage-on-fetch
+            assert b.store.round_tier(3, 0) == "host"
+            assert ev.eviction_stats()["restages"] >= 1
+            assert fetch(2) == oracle[2]
+            assert fetch(3) == oracle[3]
+        finally:
+            a.close()
+            b.close()
