@@ -12,29 +12,48 @@ the fixture tests in ``tests/test_analysis.py`` stay jax-free.
 
 Passes (see docs/ANALYSIS.md for the conventions each one enforces):
 
-================  ==========================================================
-use-after-donate  reads of a local after it was passed into a donating jit
-                  call (``build_exchange`` arg 0, ``build_block_scatter``
-                  arg 4, literal ``donate_argnums``)
-lock-discipline   fields annotated ``#: guarded by self._lock`` mutated
-                  outside a ``with <lock>:`` block
-host-sync         blocking host syncs (``block_until_ready``, ``np.asarray``
-                  on non-literals, ``jax.device_get``) inside RoundPipeline
-                  submit/drain stages or code reachable from ``_run_exchange``
-cache-hygiene     raw shape/capacity parameters flowing into a compile cache
-                  key without pow2 bucketing (recompile-bomb detector)
-private-access    cross-object ``expr._name`` access (ex lint_private_access)
-required-surface  load-bearing public methods must keep existing (ex lint)
-================  ==========================================================
+==================  ========================================================
+use-after-donate    reads of a local after it was passed into a donating jit
+                    call (``build_exchange`` arg 0, ``build_block_scatter``
+                    arg 4, literal ``donate_argnums``)
+lock-discipline     fields annotated ``#: guarded by self._lock`` mutated
+                    outside a ``with <lock>:`` block
+host-sync           blocking host syncs (``block_until_ready``,
+                    ``np.asarray`` on non-literals, ``jax.device_get``)
+                    inside RoundPipeline submit/drain stages or code
+                    reachable from ``_run_exchange``
+cache-hygiene       raw shape/capacity parameters flowing into a compile
+                    cache key without pow2 bucketing (recompile-bomb
+                    detector)
+private-access      cross-object ``expr._name`` access (ex
+                    lint_private_access)
+required-surface    load-bearing public methods must keep existing (ex lint)
+lock-order          whole-program lock acquisition graph: cycles,
+                    inversions, blocking calls under a lock
+reactor-discipline  nothing blocking reachable from reactor loop/worker
+                    callbacks (``add_listener`` / ``add_connection``)
+thread-lifecycle    spawned threads daemonized-or-joined; inter-thread
+                    queues bounded
+resource-balance    CreditGate/tenant/pool acquire-release pairs balanced
+                    on every exception path
+wire-schema         AmId enum + header struct formats extracted from source
+                    and cross-checked against docs/SHIM_PROTOCOL.md
+conf-registry       every ``spark.shuffle.tpu.*`` knob is a real field,
+                    has a DEPLOYMENT.md row, a test reference, and a
+                    byte-identical off-path default
+==================  ========================================================
 
-The runtime half of this PR — the buffer sanitizer — lives in
+The runtime half of PR 3 — the buffer sanitizer — lives in
 ``sparkucx_tpu/memory/sanitizer.py`` (``spark.shuffle.tpu.sanitize``).
 """
 
 from sparkucx_tpu.analysis.base import (  # noqa: F401
     Finding,
+    Program,
+    all_pass_names,
     analyze_tree,
     is_allowlisted,
+    registered_global_passes,
     registered_passes,
     run_source,
 )
@@ -42,8 +61,14 @@ from sparkucx_tpu.analysis.base import (  # noqa: F401
 # Importing the pass modules registers them (base.register side effect).
 from sparkucx_tpu.analysis import (  # noqa: F401,E402
     cache,
+    confreg,
     donation,
     hostsync,
+    lockorder,
     locks,
     private,
+    protocol,
+    reactor,
+    resources,
+    threads,
 )

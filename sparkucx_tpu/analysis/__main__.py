@@ -1,17 +1,27 @@
 """CLI: ``python -m sparkucx_tpu.analysis [--ci]``.
 
-Runs every registered pass over ``sparkucx_tpu/`` and exits non-zero on any
-finding not covered by a reviewed allowlist entry (analysis/config.py).
-Imports no jax/numpy — safe on a bare interpreter and cheap in CI.
+Runs every registered pass (module and whole-program) over
+``sparkucx_tpu/`` and exits non-zero on any finding not covered by a
+reviewed allowlist entry (analysis/config.py).  A full default run also
+FAILS on stale configuration: an allowlist entry no finding matches, or a
+REQUIRED_SURFACE path that names no analyzed file — reviewed exceptions
+that have rotted get pruned, not accumulated.  Imports no jax/numpy —
+safe on a bare interpreter and cheap in CI.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
-from sparkucx_tpu.analysis import analyze_tree, registered_passes
-from sparkucx_tpu.analysis.config import ALLOWLIST
+from sparkucx_tpu.analysis import all_pass_names, analyze_tree
+from sparkucx_tpu.analysis.base import load_program, package_root
+from sparkucx_tpu.analysis.config import (
+    ALLOWLIST,
+    REQUIRED_SURFACE,
+    TESTS_ALLOWLIST,
+)
 
 
 def main(argv=None) -> int:
@@ -28,21 +38,38 @@ def main(argv=None) -> int:
     parser.add_argument("--list-passes", action="store_true")
     parser.add_argument("--show-allowlisted", action="store_true",
                         help="also print findings suppressed by the allowlist")
+    parser.add_argument("--allowlist", choices=("package", "tests"), default="package",
+                        help="which reviewed-exception table applies: the package "
+                             "ALLOWLIST (default) or TESTS_ALLOWLIST for runs "
+                             "over the tests/ tree")
+    parser.add_argument("--dump-lock-graph", action="store_true",
+                        help="print the whole-program lock acquisition graph as "
+                             "Graphviz DOT and exit")
     args = parser.parse_args(argv)
 
     if args.list_passes:
-        for name in sorted(registered_passes()):
+        for name in all_pass_names():
             print(name)
+        return 0
+
+    if args.dump_lock_graph:
+        from sparkucx_tpu.analysis.lockorder import build_lock_graph, render_dot
+
+        edges, _blocking = build_lock_graph(load_program(args.root))
+        print(render_dot(edges))
         return 0
 
     passes = args.passes.split(",") if args.passes else None
     if passes:
-        unknown = sorted(set(passes) - set(registered_passes()))
+        unknown = sorted(set(passes) - set(all_pass_names()))
         if unknown:
             print(f"unknown pass(es): {', '.join(unknown)}", file=sys.stderr)
             return 2
 
-    violations, suppressed, num_files = analyze_tree(root=args.root, passes=passes)
+    allowlist = TESTS_ALLOWLIST if args.allowlist == "tests" else ALLOWLIST
+    violations, suppressed, num_files = analyze_tree(
+        root=args.root, passes=passes, allowlist=allowlist
+    )
 
     if args.show_allowlisted:
         for finding, entry in suppressed:
@@ -50,16 +77,27 @@ def main(argv=None) -> int:
     for finding in violations:
         print(finding.render())
 
-    # an allowlist entry nothing matches is stale — surface it (warn, not fail)
-    if passes is None and args.root is None:
+    # Stale reviewed-exception config is a FAILURE on the full default run:
+    # an unused entry either outlived its construct (prune it) or quietly
+    # stopped matching the message it was reviewed against (re-review it).
+    stale = 0
+    if passes is None and args.root is None and args.allowlist == "package":
         used = {entry for _, entry in suppressed}
         for entry in sorted(ALLOWLIST - used):
-            print(f"warning: unused allowlist entry {entry}", file=sys.stderr)
+            stale += 1
+            print(f"stale allowlist entry (matched no finding): {entry}",
+                  file=sys.stderr)
+        for path in sorted(REQUIRED_SURFACE):
+            if not os.path.isfile(os.path.join(package_root(), path)):
+                stale += 1
+                print(f"stale REQUIRED_SURFACE path (no such file): {path}",
+                      file=sys.stderr)
 
-    npass = len(passes) if passes else len(registered_passes())
-    if violations:
+    npass = len(passes) if passes else len(all_pass_names())
+    if violations or stale:
         print(
-            f"\n{len(violations)} violation(s) across {num_files} files "
+            f"\n{len(violations)} violation(s), {stale} stale config "
+            f"entr(ies) across {num_files} files "
             f"({npass} passes, {len(suppressed)} allowlisted)",
             file=sys.stderr,
         )

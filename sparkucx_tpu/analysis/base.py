@@ -1,9 +1,13 @@
 """Analyzer core: Finding, pass registry, tree walking, allowlist matching.
 
-A *pass* is a function ``(tree, source, rel_path) -> list[Finding]`` over one
-already-parsed module.  Passes never import the code under analysis — every
-check is AST + source-comment based, so the analyzer runs without jax (and the
-fixture tests feed it snippets that could never import).
+A *module pass* is a function ``(tree, source, rel_path) -> list[Finding]``
+over one already-parsed module.  A *global pass* is a function
+``(program) -> list[Finding]`` over a :class:`Program` — every parsed module
+plus the docs and tests text the pass cross-references (lock graphs, wire
+schema vs SHIM_PROTOCOL.md, conf knobs vs DEPLOYMENT.md).  Passes never
+import the code under analysis — every check is AST + source-comment based,
+so the analyzer runs without jax (and the fixture tests feed it snippets
+that could never import).
 
 Allowlisting: entries live in :mod:`sparkucx_tpu.analysis.config` as
 ``(file_suffix, pass_name, message_substring)`` triples, each with a reviewed
@@ -39,8 +43,33 @@ PassFn = Callable[[ast.Module, str, str], List[Finding]]
 _REGISTRY: Dict[str, PassFn] = {}
 
 
+@dataclass
+class Program:
+    """Whole-program view handed to global passes.
+
+    ``modules`` maps package-relative paths to ``(tree, source)``.  ``docs``
+    maps doc basenames (``"SHIM_PROTOCOL.md"``, ``"DEPLOYMENT.md"``) to their
+    text — empty when the repo checkout has no docs/ (installed-package runs
+    skip doc cross-checks rather than failing).  ``tests_text`` is the
+    concatenated source of the tests/ tree, used only for textual
+    "is this knob referenced by a test" checks.
+    """
+
+    modules: Dict[str, Tuple[ast.Module, str]]
+    docs: Dict[str, str]
+    tests_text: str
+
+    def module(self, rel_path: str) -> Optional[Tuple[ast.Module, str]]:
+        return self.modules.get(rel_path)
+
+
+GlobalPassFn = Callable[[Program], List[Finding]]
+
+_GLOBAL_REGISTRY: Dict[str, GlobalPassFn] = {}
+
+
 def register(name: str) -> Callable[[PassFn], PassFn]:
-    """Decorator: add a pass to the registry under ``name``."""
+    """Decorator: add a module pass to the registry under ``name``."""
 
     def deco(fn: PassFn) -> PassFn:
         _REGISTRY[name] = fn
@@ -49,8 +78,26 @@ def register(name: str) -> Callable[[PassFn], PassFn]:
     return deco
 
 
+def register_global(name: str) -> Callable[[GlobalPassFn], GlobalPassFn]:
+    """Decorator: add a whole-program pass to the registry under ``name``."""
+
+    def deco(fn: GlobalPassFn) -> GlobalPassFn:
+        _GLOBAL_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
 def registered_passes() -> Dict[str, PassFn]:
     return dict(_REGISTRY)
+
+
+def registered_global_passes() -> Dict[str, GlobalPassFn]:
+    return dict(_GLOBAL_REGISTRY)
+
+
+def all_pass_names() -> List[str]:
+    return sorted(set(_REGISTRY) | set(_GLOBAL_REGISTRY))
 
 
 # ----------------------------------------------------------------------
@@ -84,13 +131,33 @@ def run_source(
     source: str,
     passes: Optional[Sequence[str]] = None,
     filename: str = "<fixture>",
+    docs: Optional[Dict[str, str]] = None,
+    tests_text: str = "",
 ) -> List[Finding]:
-    """Run passes over one source string (the fixture-test entry point)."""
+    """Run passes over one source string (the fixture-test entry point).
+
+    Global passes see the string as a one-module :class:`Program` with the
+    injected ``docs`` / ``tests_text``; they run only when named explicitly
+    in ``passes`` (with no ``passes`` argument every *module* pass runs,
+    matching the historical contract fixtures are written against).
+    """
     tree = ast.parse(source, filename=filename)
     names = list(passes) if passes else sorted(_REGISTRY)
     out: List[Finding] = []
+    program: Optional[Program] = None
     for name in names:
-        out.extend(_REGISTRY[name](tree, source, filename))
+        if name in _REGISTRY:
+            out.extend(_REGISTRY[name](tree, source, filename))
+        elif name in _GLOBAL_REGISTRY:
+            if program is None:
+                program = Program(
+                    modules={filename: (tree, source)},
+                    docs=dict(docs or {}),
+                    tests_text=tests_text,
+                )
+            out.extend(_GLOBAL_REGISTRY[name](program))
+        else:
+            raise KeyError(name)
     out.sort(key=lambda f: (f.path, f.line, f.pass_name))
     return out
 
@@ -100,43 +167,98 @@ def package_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def analyze_tree(
-    root: Optional[str] = None,
-    passes: Optional[Sequence[str]] = None,
-) -> Tuple[List[Finding], List[Tuple[Finding, Tuple[str, str, str]]], int]:
-    """Run passes over every .py under ``root``.
+def repo_root() -> str:
+    """The checkout directory holding sparkucx_tpu/, docs/, and tests/."""
+    return os.path.dirname(package_root())
 
-    Returns ``(violations, allowlisted, num_files)`` where ``allowlisted``
-    pairs each suppressed finding with the entry that matched it.
-    """
-    from sparkucx_tpu.analysis.config import ALLOWLIST
 
+#: Docs that global passes cross-reference, loaded by basename from
+#: ``<repo>/docs`` when present.
+PROGRAM_DOCS = ("SHIM_PROTOCOL.md", "DEPLOYMENT.md")
+
+
+def _load_docs() -> Dict[str, str]:
+    docs: Dict[str, str] = {}
+    docs_dir = os.path.join(repo_root(), "docs")
+    for name in PROGRAM_DOCS:
+        path = os.path.join(docs_dir, name)
+        if os.path.isfile(path):
+            with open(path) as f:
+                docs[name] = f.read()
+    return docs
+
+
+def _load_tests_text() -> str:
+    chunks: List[str] = []
+    tests_dir = os.path.join(repo_root(), "tests")
+    for dirpath, dirs, files in os.walk(tests_dir):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                with open(os.path.join(dirpath, fname)) as f:
+                    chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def load_program(root: Optional[str] = None) -> Program:
+    """Gather every .py under ``root`` plus docs/tests into a Program
+    (also the ``--dump-lock-graph`` entry point)."""
     root = root or package_root()
-    names = list(passes) if passes else sorted(_REGISTRY)
-    violations: List[Finding] = []
-    suppressed: List[Tuple[Finding, Tuple[str, str, str]]] = []
-    num_files = 0
+    modules: Dict[str, Tuple[ast.Module, str]] = {}
     for dirpath, dirs, files in os.walk(root):
         dirs[:] = sorted(d for d in dirs if d != "__pycache__")
         for fname in sorted(files):
             if not fname.endswith(".py"):
                 continue
-            num_files += 1
             path = os.path.join(dirpath, fname)
             rel = os.path.relpath(path, root).replace(os.sep, "/")
             with open(path) as f:
                 source = f.read()
-            tree = ast.parse(source, filename=path)
-            for name in names:
-                for finding in _REGISTRY[name](tree, source, rel):
-                    entry = is_allowlisted(finding, ALLOWLIST)
-                    if entry is not None:
-                        suppressed.append((finding, entry))
-                    else:
-                        violations.append(finding)
+            modules[rel] = (ast.parse(source, filename=path), source)
+    return Program(modules=modules, docs=_load_docs(), tests_text=_load_tests_text())
+
+
+def analyze_tree(
+    root: Optional[str] = None,
+    passes: Optional[Sequence[str]] = None,
+    allowlist: Optional[Iterable[Tuple[str, str, str]]] = None,
+) -> Tuple[List[Finding], List[Tuple[Finding, Tuple[str, str, str]]], int]:
+    """Run passes over every .py under ``root``.
+
+    Module passes run per file; global passes run once over the gathered
+    :class:`Program`.  Returns ``(violations, allowlisted, num_files)`` where
+    ``allowlisted`` pairs each suppressed finding with the entry that
+    matched it.  ``allowlist`` defaults to the package ALLOWLIST (the
+    tests-tree CI step passes TESTS_ALLOWLIST instead).
+    """
+    if allowlist is None:
+        from sparkucx_tpu.analysis.config import ALLOWLIST
+
+        allowlist = ALLOWLIST
+    names = list(passes) if passes else all_pass_names()
+    module_names = [n for n in names if n in _REGISTRY]
+    global_names = [n for n in names if n in _GLOBAL_REGISTRY]
+    violations: List[Finding] = []
+    suppressed: List[Tuple[Finding, Tuple[str, str, str]]] = []
+
+    def _sieve(finding: Finding) -> None:
+        entry = is_allowlisted(finding, allowlist)
+        if entry is not None:
+            suppressed.append((finding, entry))
+        else:
+            violations.append(finding)
+
+    program = load_program(root)
+    for rel, (tree, source) in program.modules.items():
+        for name in module_names:
+            for finding in _REGISTRY[name](tree, source, rel):
+                _sieve(finding)
+    for name in global_names:
+        for finding in _GLOBAL_REGISTRY[name](program):
+            _sieve(finding)
     violations.sort(key=lambda f: (f.path, f.line, f.pass_name))
     suppressed.sort(key=lambda p: (p[0].path, p[0].line, p[0].pass_name))
-    return violations, suppressed, num_files
+    return violations, suppressed, len(program.modules)
 
 
 # ----------------------------------------------------------------------

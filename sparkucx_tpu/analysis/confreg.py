@@ -1,0 +1,166 @@
+"""conf-registry: every knob is real, documented, tested, and off by default.
+
+Parses ``TpuShuffleConf`` out of config.py — the dataclass field defaults
+and the ``from_spark_conf`` knob table (the ``(name, attr, conv)`` tuple
+list, plus the bespoke ``get("...")`` special cases) — and enforces four
+invariants per ``spark.shuffle.tpu.*`` knob:
+
+* **real** — the attr the knob sets must be an actual conf field (a typo
+  here is a knob that silently does nothing),
+* **documented** — ``spark.shuffle.tpu.<name>`` must appear in
+  docs/DEPLOYMENT.md (the operator-facing registry),
+* **tested** — the knob name or its attr must be referenced somewhere in
+  tests/ (an untested knob's parse/convert path rots invisibly),
+* **off-path pinned** — for every field in ``OFF_PATH_DEFAULTS``
+  (analysis/config.py), the dataclass default must equal the pinned
+  byte-identical-off-path value.  Features added since the golden wire
+  captures default OFF; flipping one requires editing the pin table,
+  which is the review this pass forces.
+
+Doc and test checks are skipped when the program carries no
+DEPLOYMENT.md / tests text (installed package; fixtures may inject both
+through ``run_source(docs=..., tests_text=...)``).  Escape hatch: the
+standard allowlist, entry per knob, justification required.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from sparkucx_tpu.analysis.base import Finding, Program, register_global
+from sparkucx_tpu.analysis.config import (
+    CONF_DOC,
+    CONF_KEY_PREFIX,
+    CONF_MODULE,
+    OFF_PATH_DEFAULTS,
+    SPECIAL_CONF_KNOBS,
+)
+
+PASS = "conf-registry"
+
+
+def _conf_class(tree: ast.Module) -> Optional[ast.ClassDef]:
+    """The dataclass holding from_spark_conf (TpuShuffleConf in the real
+    module; any class with that classmethod in fixtures)."""
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and item.name == "from_spark_conf":
+                    return node
+    return None
+
+
+def extract_conf_fields(cls: ast.ClassDef) -> Dict[str, Tuple[object, int]]:
+    """``{field: (default_literal, line)}``; non-constant defaults map to
+    an ``...`` sentinel (factory/tuple defaults are not off-path pins)."""
+    out: Dict[str, Tuple[object, int]] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            default: object = Ellipsis
+            if isinstance(stmt.value, ast.Constant):
+                default = stmt.value.value
+            out[stmt.target.id] = (default, stmt.lineno)
+    return out
+
+
+def extract_conf_knobs(cls: ast.ClassDef) -> List[Tuple[str, Optional[str], int]]:
+    """``(knob_name, attr, line)`` from from_spark_conf: the tuple-table
+    entries plus the bespoke ``get("...")`` constants (attr resolved
+    through SPECIAL_CONF_KNOBS, None when unknown there)."""
+    fn = next(
+        item for item in cls.body
+        if isinstance(item, ast.FunctionDef) and item.name == "from_spark_conf"
+    )
+    knobs: List[Tuple[str, Optional[str], int]] = []
+    seen = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.List):
+            for elt in node.elts:
+                if (
+                    isinstance(elt, ast.Tuple)
+                    and len(elt.elts) >= 2
+                    and isinstance(elt.elts[0], ast.Constant)
+                    and isinstance(elt.elts[0].value, str)
+                    and isinstance(elt.elts[1], ast.Constant)
+                    and isinstance(elt.elts[1].value, str)
+                ):
+                    name, attr = elt.elts[0].value, elt.elts[1].value
+                    if name not in seen:
+                        seen.add(name)
+                        knobs.append((name, attr, elt.lineno))
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "get"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            name = node.args[0].value
+            if name not in seen:
+                seen.add(name)
+                knobs.append((name, SPECIAL_CONF_KNOBS.get(name), node.lineno))
+    return knobs
+
+
+def _find_conf_module(program: Program) -> Optional[Tuple[str, ast.Module]]:
+    entry = program.module(CONF_MODULE)
+    if entry is not None:
+        return CONF_MODULE, entry[0]
+    for rel, (tree, _source) in sorted(program.modules.items()):
+        if _conf_class(tree) is not None:
+            return rel, tree
+    return None
+
+
+@register_global(PASS)
+def conf_registry_pass(program: Program) -> List[Finding]:
+    located = _find_conf_module(program)
+    if located is None:
+        return []
+    rel, tree = located
+    cls = _conf_class(tree)
+    if cls is None:
+        return []
+    fields = extract_conf_fields(cls)
+    knobs = extract_conf_knobs(cls)
+    doc = program.docs.get(CONF_DOC)
+    tests = program.tests_text
+    findings: List[Finding] = []
+
+    for name, attr, line in knobs:
+        key = f"{CONF_KEY_PREFIX}.{name}"
+        if attr is not None and attr not in fields:
+            findings.append(Finding(rel, line, PASS,
+                f"knob '{key}' maps to unknown conf field '{attr}' — the "
+                f"knob silently does nothing"))
+        if doc is not None and key not in doc:
+            findings.append(Finding(rel, line, PASS,
+                f"knob '{key}' has no {CONF_DOC} row — every operator-facing "
+                f"knob needs its registry entry"))
+        if tests and name not in tests and (attr is None or attr not in tests):
+            findings.append(Finding(rel, line, PASS,
+                f"knob '{key}' (field '{attr}') is referenced by no test — "
+                f"its parse/convert path is unguarded"))
+
+    for attr, want in sorted(OFF_PATH_DEFAULTS.items()):
+        if attr not in fields:
+            # only the real conf module owes every pinned field; a fixture
+            # class defining a knob subset is not a stale-pin signal
+            if rel == CONF_MODULE:
+                findings.append(Finding(rel, cls.lineno, PASS,
+                    f"OFF_PATH_DEFAULTS pins unknown conf field '{attr}' — "
+                    f"prune the stale pin"))
+            continue
+        got, line = fields[attr]
+        if got is Ellipsis:
+            continue  # non-literal default; nothing to compare statically
+        if got != want or type(got) is not type(want):
+            findings.append(Finding(rel, line, PASS,
+                f"off-path default drift: '{attr}' defaults to {got!r} but "
+                f"the byte-identical off-path pins {want!r} — flipping a "
+                f"default requires re-capturing the golden frames and "
+                f"editing OFF_PATH_DEFAULTS"))
+    return findings

@@ -1,0 +1,300 @@
+"""lock-order: whole-program lock acquisition graph.
+
+Builds the inter-lock acquisition graph across every module: a node is a
+lock identified as ``Class.attr`` (``with self._lock`` inside a method of
+``Class``), or ``*.name`` when the owner cannot be resolved statically (a
+bare-name lock parameter, or an attribute chain not covered by
+``LOCK_ATTR_CLASSES``).  An edge ``A -> B`` means some code path acquires
+``B`` while holding ``A`` — directly (nested ``with``) or transitively
+(a call made under ``A`` reaches a method that acquires ``B``, resolved
+through the ``self.<attr>`` wiring table).
+
+Findings:
+
+* **cycles** — ``A -> B -> A`` (including 2-cycles, the classic lock-order
+  inversion, and self-edges: re-acquiring a non-reentrant ``Lock`` the
+  caller already holds).  Wildcard ``*.name`` nodes never participate in
+  cycle detection: two ``send_lock`` instances on different connections are
+  different locks, and proving them identical is beyond a static pass.
+* **blocking calls under a lock** — ``sendall``/``recv``/``connect``/
+  ``time.sleep``/untimed ``wait``/thread ``join`` lexically inside a
+  ``with <lock>:`` body stalls every other acquirer for the call's
+  duration.  Locks whose JOB is serializing a blocking wire write are
+  exempted via ``LOCK_BLOCKING_EXEMPT`` (with justification, in
+  analysis/config.py).
+
+Known limits (documented, deliberate): explicit ``lock.acquire()`` calls
+are not tracked (the package idiom is ``with``); the blocking check is
+lexical per function (a blocking call inside a helper invoked under a lock
+is not flagged — the edge it creates still is).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sparkucx_tpu.analysis.base import (
+    Finding,
+    Program,
+    callee_name,
+    dotted_name,
+    register_global,
+)
+from sparkucx_tpu.analysis.config import LOCK_ATTR_CLASSES, LOCK_BLOCKING_EXEMPT
+
+PASS = "lock-order"
+
+#: Callee names treated as blocking when reached while holding a lock.
+BLOCKING_CALLS = {"sendall", "sendmsg", "recv", "recv_into", "accept", "connect", "select", "sleep"}
+
+
+def _lock_node(expr: ast.AST, cls_name: str) -> Optional[str]:
+    """Map a ``with`` context expression to a lock node, or None."""
+    d = dotted_name(expr)
+    if d is None:
+        return None
+    parts = d.split(".")
+    final = parts[-1]
+    if "lock" not in final.lower():
+        return None
+    if parts[0] in ("self", "cls"):
+        if len(parts) == 2:
+            return f"{cls_name}.{final}"
+        owner = LOCK_ATTR_CLASSES.get(parts[1])
+        return f"{owner}.{final}" if owner else f"*.{final}"
+    return f"*.{final}"
+
+
+def _is_exempt(node: str) -> bool:
+    name = node.split(".", 1)[1]
+    return node in LOCK_BLOCKING_EXEMPT or f"*.{name}" in LOCK_BLOCKING_EXEMPT
+
+
+def _blocking_label(call: ast.Call) -> Optional[str]:
+    name = callee_name(call)
+    if name in BLOCKING_CALLS:
+        return name
+    if name in ("wait", "wait_for"):
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        has_timeout = has_timeout or len(call.args) >= (2 if name == "wait_for" else 1)
+        if not has_timeout:
+            return f"{name}() without timeout"
+    if name == "join" and not call.args and not call.keywords:
+        recv = call.func.value if isinstance(call.func, ast.Attribute) else None
+        if isinstance(recv, ast.Constant):
+            return None  # "sep".join(...)
+        base = dotted_name(recv) if recv is not None else None
+        if base is not None and base.split(".")[-1] in ("path", "sep"):
+            return None  # os.path.join
+        return "join() without timeout"
+    return None
+
+
+def _resolve_callee(call: ast.Call, cls_name: str) -> Optional[Tuple[str, str]]:
+    """``(class, method)`` for self./cross-object calls this pass can track."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    base = dotted_name(f.value)
+    if base in ("self", "cls"):
+        return (cls_name, f.attr)
+    if base is not None and base.count(".") == 1 and base.startswith("self."):
+        owner = LOCK_ATTR_CLASSES.get(base.split(".")[1])
+        if owner:
+            return (owner, f.attr)
+    return None
+
+
+class _MethodInfo:
+    __slots__ = ("direct", "calls", "edges", "blocking")
+
+    def __init__(self) -> None:
+        self.direct: Set[str] = set()
+        #: (callee key, held-locks snapshot, line)
+        self.calls: List[Tuple[Tuple[str, str], Tuple[str, ...], int]] = []
+        #: direct nested acquisitions: (held, acquired, line)
+        self.edges: List[Tuple[str, str, int]] = []
+        #: (lock, label, line)
+        self.blocking: List[Tuple[str, str, int]] = []
+
+
+class _MethodWalker(ast.NodeVisitor):
+    def __init__(self, cls_name: str, info: _MethodInfo) -> None:
+        self.cls = cls_name
+        self.info = info
+        self.held: List[str] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            ln = _lock_node(item.context_expr, self.cls)
+            if ln is not None:
+                acquired.append(ln)
+        for a in acquired:
+            self.info.direct.add(a)
+            for h in self.held:
+                self.info.edges.append((h, a, node.lineno))
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            del self.held[-len(acquired):]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            label = _blocking_label(node)
+            if label is not None:
+                for h in self.held:
+                    if not _is_exempt(h):
+                        self.info.blocking.append((h, label, node.lineno))
+        callee = _resolve_callee(node, self.cls)
+        if callee is not None:
+            self.info.calls.append((callee, tuple(self.held), node.lineno))
+        self.generic_visit(node)
+
+    # A nested def/lambda's body does not run under the enclosing locks —
+    # and does not run *now* at all (closures fire later, on whatever
+    # thread invokes them), so nothing inside contributes acquisitions,
+    # edges, or blocking findings to the enclosing method.  Documented
+    # limit: lock use inside closures is invisible to this pass.
+    def _nested(self, node: ast.AST) -> None:
+        del node
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._nested(node)
+
+
+def _index_program(program: Program):
+    """(cls, method) -> (_MethodInfo, rel_path) over every module."""
+    methods: Dict[Tuple[str, str], Tuple[_MethodInfo, str]] = {}
+    for rel, (tree, _source) in sorted(program.modules.items()):
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                key = (node.name, item.name)
+                if key in methods:
+                    continue  # first definition wins (same-name helper classes)
+                info = _MethodInfo()
+                walker = _MethodWalker(node.name, info)
+                for stmt in item.body:
+                    walker.visit(stmt)
+                methods[key] = (info, rel)
+    return methods
+
+
+def build_lock_graph(program: Program):
+    """``(edges, blocking)``: edges maps ``(held, acquired)`` to the site
+    ``(rel_path, line, via)`` that first creates it; blocking is a list of
+    ``(lock, label, rel_path, line)``."""
+    methods = _index_program(program)
+
+    # Transitive acquisition summaries, to fixpoint (call graph has cycles).
+    acq: Dict[Tuple[str, str], Set[str]] = {
+        key: set(info.direct) for key, (info, _rel) in methods.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for key, (info, _rel) in methods.items():
+            for callee, _held, _line in info.calls:
+                extra = acq.get(callee)
+                if extra and not extra <= acq[key]:
+                    acq[key] |= extra
+                    changed = True
+
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    blocking: List[Tuple[str, str, str, int]] = []
+    for (cls, meth), (info, rel) in sorted(methods.items()):
+        for held, acquired, line in info.edges:
+            edges.setdefault((held, acquired), (rel, line, f"{cls}.{meth}"))
+        for callee, held, line in info.calls:
+            if not held:
+                continue
+            for acquired in sorted(acq.get(callee, ())):
+                via = f"{cls}.{meth} via {callee[0]}.{callee[1]}"
+                for h in held:
+                    edges.setdefault((h, acquired), (rel, line, via))
+        for lock, label, line in info.blocking:
+            blocking.append((lock, label, rel, line))
+    return edges, blocking
+
+
+def render_dot(edges) -> str:
+    """Graphviz DOT of the lock graph (``--dump-lock-graph``)."""
+    lines = ["digraph lock_order {", "  rankdir=LR;"]
+    for (a, b), (rel, line, via) in sorted(edges.items()):
+        lines.append(f'  "{a}" -> "{b}" [label="{via} ({rel}:{line})"];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def _find_cycles(edges) -> List[Tuple[Tuple[str, ...], Tuple[str, str]]]:
+    """Elementary cycles among resolvable nodes, canonicalized.  Returns
+    ``(cycle_nodes, first_edge)`` pairs, one per distinct cycle."""
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        if a.startswith("*.") or b.startswith("*."):
+            continue  # wildcard nodes: distinct instances, not provably one lock
+        if a == b:
+            continue  # self-edges are reported separately below
+        graph.setdefault(a, []).append(b)
+
+    seen: Set[Tuple[str, ...]] = set()
+    out: List[Tuple[Tuple[str, ...], Tuple[str, str]]] = []
+
+    def dfs(start: str, node: str, path: List[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cycle = tuple(path)
+                i = cycle.index(min(cycle))
+                canon = cycle[i:] + cycle[:i]
+                if canon not in seen:
+                    seen.add(canon)
+                    out.append((canon, (path[0], path[1] if len(path) > 1 else path[0])))
+            elif nxt not in path and nxt > start:
+                # only explore nodes > start so each cycle is found once,
+                # from its smallest node
+                dfs(start, nxt, path + [nxt])
+
+    for (a, b) in sorted(edges):
+        if a == b and not a.startswith("*."):
+            out.append(((a,), (a, a)))
+    for start in sorted(graph):
+        dfs(start, start, [start])
+    return out
+
+
+@register_global(PASS)
+def lock_order_pass(program: Program) -> List[Finding]:
+    edges, blocking = build_lock_graph(program)
+    findings: List[Finding] = []
+    for cycle, first_edge in _find_cycles(edges):
+        if len(cycle) == 1:
+            rel, line, via = edges[(cycle[0], cycle[0])]
+            findings.append(Finding(rel, line, PASS,
+                f"lock self-cycle: '{cycle[0]}' re-acquired while already held (in {via})"))
+            continue
+        arrows = " -> ".join(cycle + (cycle[0],))
+        sites = "; ".join(
+            f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]} ({edges[(a, b)][2]})"
+            for a, b in zip(cycle, cycle[1:] + (cycle[0],))
+            if (a, b) in edges
+        )
+        rel, line, _via = edges.get((cycle[0], cycle[1]), ("", 0, ""))
+        findings.append(Finding(rel, line, PASS,
+            f"lock-order cycle: {arrows} [{sites}]"))
+    for lock, label, rel, line in blocking:
+        findings.append(Finding(rel, line, PASS,
+            f"blocking call '{label}' while holding {lock}"))
+    return findings

@@ -1,0 +1,154 @@
+"""wire-schema: the wire the code speaks is the wire the doc describes.
+
+Parses the protocol *out of the source* — the ``AmId`` enum and every
+module-level ``struct.Struct("...")`` header format in
+core/definitions.py — and cross-checks it against docs/SHIM_PROTOCOL.md:
+
+* AmId values must be contiguous from 0 with no duplicates (the wire
+  carries the integer; a gap or collision is a silent protocol fork),
+* every AmId must appear in the doc next to its pinned value (CamelCase
+  name, e.g. ``REPLICA_PUT`` -> ``ReplicaPut``), so adding a frame type
+  without documenting it fails CI,
+* every header struct format string (``<IQQ>``, ``<iiiI>``, ...) must
+  appear in the doc — header layout drift is exactly the silent breakage
+  the golden captures exist to catch, and the doc is the reviewable copy.
+
+The extraction half is exported (:func:`extract_am_ids`,
+:func:`extract_structs`) and is also what tests/test_core.py uses to
+auto-generate the AmId pin list, so the pin and the source cannot
+diverge.  When the analyzed program has no SHIM_PROTOCOL.md (installed
+package, fixture without injected docs) the doc cross-checks are skipped;
+the enum-shape checks always run.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from sparkucx_tpu.analysis.base import Finding, Program, dotted_name, register_global
+from sparkucx_tpu.analysis.config import WIRE_DEFS_MODULE, WIRE_DOC
+
+PASS = "wire-schema"
+
+
+def _am_class(tree: ast.Module) -> Optional[ast.ClassDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "AmId":
+            return node
+    return None
+
+
+def extract_am_ids(source: str) -> Dict[str, int]:
+    """``{member_name: value}`` from the AmId enum, in definition order."""
+    tree = ast.parse(source)
+    cls = _am_class(tree)
+    out: Dict[str, int] = {}
+    if cls is None:
+        return out
+    for stmt in cls.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, int)
+        ):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+def extract_structs(source: str) -> Dict[str, str]:
+    """``{name: format}`` for module-level ``NAME = struct.Struct("fmt")``."""
+    tree = ast.parse(source)
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        val = stmt.value
+        if (
+            isinstance(tgt, ast.Name)
+            and isinstance(val, ast.Call)
+            and dotted_name(val.func) in ("struct.Struct", "Struct")
+            and val.args
+            and isinstance(val.args[0], ast.Constant)
+            and isinstance(val.args[0].value, str)
+        ):
+            out[tgt.id] = val.args[0].value
+    return out
+
+
+def camel(name: str) -> str:
+    """``REPLICA_PUT`` -> ``ReplicaPut`` (the doc's spelling)."""
+    return "".join(part.capitalize() for part in name.split("_"))
+
+
+def _find_defs_module(program: Program) -> Optional[Tuple[str, str]]:
+    entry = program.module(WIRE_DEFS_MODULE)
+    if entry is not None:
+        return WIRE_DEFS_MODULE, entry[1]
+    # fixture mode: any module defining an AmId enum
+    for rel, (tree, source) in sorted(program.modules.items()):
+        if _am_class(tree) is not None:
+            return rel, source
+    return None
+
+
+@register_global(PASS)
+def wire_schema_pass(program: Program) -> List[Finding]:
+    located = _find_defs_module(program)
+    if located is None:
+        return []
+    rel, source = located
+    tree = ast.parse(source)
+    cls = _am_class(tree)
+    line_of = {
+        stmt.targets[0].id: stmt.lineno
+        for stmt in (cls.body if cls is not None else [])
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.targets[0], ast.Name)
+    }
+    am_ids = extract_am_ids(source)
+    structs = extract_structs(source)
+    struct_lines = {
+        stmt.targets[0].id: stmt.lineno
+        for stmt in tree.body
+        if isinstance(stmt, ast.Assign)
+        and len(stmt.targets) == 1
+        and isinstance(stmt.targets[0], ast.Name)
+    }
+    findings: List[Finding] = []
+    cls_line = cls.lineno if cls is not None else 1
+
+    # -- enum shape -----------------------------------------------------
+    values = list(am_ids.values())
+    if len(set(values)) != len(values):
+        dupes = sorted(v for v in set(values) if values.count(v) > 1)
+        findings.append(Finding(rel, cls_line, PASS,
+            f"AmId has duplicate values {dupes} — two frame types sharing "
+            f"one wire id is a protocol fork"))
+    elif values and sorted(values) != list(range(len(values))):
+        findings.append(Finding(rel, cls_line, PASS,
+            f"AmId values {sorted(values)} are not contiguous from 0 — a "
+            f"gap means a reserved id nobody documented"))
+
+    # -- doc cross-check ------------------------------------------------
+    doc = program.docs.get(WIRE_DOC)
+    if doc is not None:
+        doc_lines = doc.splitlines()
+        for name, value in am_ids.items():
+            spelled = camel(name)
+            pat = re.compile(rf"\b{value}\b")
+            if not any(spelled in dl and pat.search(dl) for dl in doc_lines):
+                findings.append(Finding(rel, line_of.get(name, cls_line), PASS,
+                    f"AmId {name}={value} ('{spelled}') has no row in "
+                    f"{WIRE_DOC} — every wire frame type must be documented "
+                    f"next to its pinned id"))
+        for sname, fmt in structs.items():
+            if fmt not in doc:
+                findings.append(Finding(rel, struct_lines.get(sname, cls_line), PASS,
+                    f"header struct {sname} format '{fmt}' does not appear "
+                    f"in {WIRE_DOC} — document the layout before the wire "
+                    f"drifts from the doc"))
+    return findings

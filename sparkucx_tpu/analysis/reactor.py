@@ -1,0 +1,205 @@
+"""reactor-discipline: nothing blocking reachable from reactor callbacks.
+
+The shared serving plane (service/reactor.py) multiplexes every idle
+connection over ONE selector loop thread and a bounded worker pool.  Two
+lanes, two contracts:
+
+* ``add_listener(sock, on_accept)`` — ``on_accept`` runs ON the loop
+  thread.  A blocking socket op, untimed wait, ``join``, ``time.sleep``,
+  or a put into a full queue there stalls *every* connection the process
+  serves.  The loop lane must stay non-blocking, full stop.
+* ``add_connection(conn, serve_once, on_close=...)`` — callbacks run on
+  the bounded worker pool.  Blocking frame *reads* are the documented
+  design (the owner's serve code runs unchanged), but ``join``, untimed
+  ``wait``/``wait_for``, and unbounded/untimed ``queue.put`` can deadlock
+  the pool against itself once all workers block on each other.
+
+The pass finds registration call sites in each module, resolves the
+callback (method reference, function name, or a lambda whose body calls a
+method), and walks the module-local call graph from those seeds — the
+same reachability machinery as the host-sync pass, labelling findings
+with the ``(via 'helper')`` chain.
+
+Escape hatch: a ``#: reactor-ok`` comment on the flagged line, for calls
+reviewed to be non-blocking in context (e.g. a nonblocking socket's
+``recv`` used as a drain).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sparkucx_tpu.analysis.base import Finding, callee_name, dotted_name, register
+from sparkucx_tpu.analysis.config import (
+    REACTOR_LOOP_REGISTRARS,
+    REACTOR_WORKER_REGISTRARS,
+)
+
+PASS = "reactor-discipline"
+
+#: Blocking socket ops never allowed on the loop lane.
+LOOP_BLOCKING = {"recv", "recv_into", "sendall", "sendmsg", "connect", "accept"}
+
+ESCAPE_COMMENT = "#: reactor-ok"
+
+
+def _index_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    fns: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, node)
+    return fns
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function's own body, excluding nested defs AND lambdas — a
+    lambda handed to a registrar runs on whatever lane the registrar puts
+    it on (it is seeded there by ``_registration_seeds``), not on the lane
+    of the function that happens to construct it."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_callees(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                base = dotted_name(f.value)
+                if base in ("self", "cls"):
+                    out.add(f.attr)
+    return out
+
+
+def _callback_names(node: ast.AST) -> List[str]:
+    """Function names a callback expression resolves to, module-locally."""
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        if base in ("self", "cls"):
+            return [node.attr]
+        return []
+    if isinstance(node, ast.Lambda):
+        body = node.body
+        if isinstance(body, ast.Call):
+            return _callback_names(body.func)
+        return []
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        out: Set[str] = set()
+        for sub in _own_nodes(node):
+            if isinstance(sub, ast.Call):
+                out.update(_callback_names(sub.func))
+        return sorted(out)
+    return []
+
+
+def _registration_seeds(tree: ast.Module) -> List[Tuple[str, str]]:
+    """``(fn_name, lane)`` seeds from add_listener/add_connection sites."""
+    seeds: List[Tuple[str, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = callee_name(node)
+        if name in REACTOR_LOOP_REGISTRARS:
+            cb_args, lane = node.args[1:2], "loop"
+        elif name in REACTOR_WORKER_REGISTRARS:
+            cb_args, lane = list(node.args[1:2]), "worker"
+            cb_args += [kw.value for kw in node.keywords if kw.arg == "on_close"]
+        else:
+            continue
+        for arg in cb_args:
+            for fn_name in _callback_names(arg):
+                seeds.append((fn_name, lane))
+    return seeds
+
+
+def _line_escaped(source_lines: List[str], lineno: int) -> bool:
+    if 1 <= lineno <= len(source_lines):
+        return ESCAPE_COMMENT in source_lines[lineno - 1]
+    return False
+
+
+def _blocking_in(fn: ast.AST, lane: str, source_lines: List[str]):
+    """``(label, line)`` blocking constructs in one function, per lane."""
+    out: List[Tuple[str, int]] = []
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if _line_escaped(source_lines, node.lineno):
+            continue
+        name = callee_name(node)
+        label: Optional[str] = None
+        if lane == "loop" and name in LOOP_BLOCKING:
+            label = f"blocking socket op '{name}'"
+        elif lane == "loop" and name == "sleep":
+            label = "'time.sleep'"
+        elif name == "join" and not node.args and not node.keywords:
+            recv = node.func.value if isinstance(node.func, ast.Attribute) else None
+            if isinstance(recv, ast.Constant):
+                continue  # "sep".join(...)
+            base = dotted_name(recv) if recv is not None else None
+            if base is not None and base.split(".")[-1] in ("path", "sep"):
+                continue
+            label = "'join()' without timeout"
+        elif name in ("wait", "wait_for"):
+            has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+            has_timeout = has_timeout or len(node.args) >= (2 if name == "wait_for" else 1)
+            if not has_timeout:
+                label = f"'{name}()' without timeout"
+        elif name == "put":
+            bounded = any(
+                kw.arg == "timeout"
+                or (kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False)
+                for kw in node.keywords
+            )
+            if not bounded:
+                label = "queue 'put' without timeout/block=False"
+        if label is not None:
+            out.append((label, node.lineno))
+    return out
+
+
+@register(PASS)
+def reactor_discipline_pass(tree: ast.Module, source: str, rel_path: str) -> List[Finding]:
+    seeds = _registration_seeds(tree)
+    if not seeds:
+        return []
+    fns = _index_functions(tree)
+    source_lines = source.splitlines()
+    findings: List[Finding] = []
+    reported: Set[Tuple[str, int, str]] = set()
+
+    for seed, lane in sorted(set(seeds)):
+        if seed not in fns:
+            continue
+        # BFS over the module-local call graph, tracking the via-chain.
+        queue: List[Tuple[str, Tuple[str, ...]]] = [(seed, ())]
+        visited: Set[str] = {seed}
+        while queue:
+            fn_name, chain = queue.pop(0)
+            fn = fns[fn_name]
+            via = f" (via '{chain[-1]}')" if chain else ""
+            for label, line in _blocking_in(fn, lane, source_lines):
+                key = (lane, line, label)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(Finding(rel_path, line, PASS,
+                    f"{label} reachable from reactor {lane} callback "
+                    f"'{seed}'{via}"))
+            for callee in sorted(_local_callees(fn)):
+                if callee in fns and callee not in visited:
+                    visited.add(callee)
+                    queue.append((callee, chain + (fn_name,)))
+    return findings

@@ -1,0 +1,157 @@
+"""resource-balance: paired acquire/release must survive exceptions.
+
+Credits, tenant quota bytes, and pooled buffers are refundable resources:
+``CreditGate.acquire``/``release``, ``TenantRegistry.charge``/``release``,
+the store's ``_charge_tenant``/``_release_tenant``, pooled-buffer
+``checkout``/``release`` (table: ``RESOURCE_PAIRS`` in analysis/config.py).
+A call that claims one and then raises without refunding leaks the
+resource forever — the gate's budget shrinks, the tenant's quota fills,
+and nothing ever gives it back.
+
+The pass finds every acquire call and demands exception-path balance in
+the acquiring function: either the acquire sits inside a ``try`` whose
+``finally`` (or an ``except`` handler) calls the paired release on the
+*same receiver*, or such a ``try`` is a subsequent sibling statement at
+some enclosing block level (the ``gate.acquire(n)`` / ``try: ...
+finally: gate.release(n)`` idiom all over the transport).  Receivers that
+are synchronization primitives (``*lock*``/``*cond*``/``*sem*``) belong
+to the lock passes and are skipped.
+
+Escape hatches, for true ownership transfers:
+
+* a ``#: balanced by <release>`` comment on the acquire line, naming the
+  function that carries the refund duty (the ``#: guarded by`` idiom),
+* the acquiring function's docstring declaring the transfer: "released
+  by ...", "caller releases", or "ownership transfers".
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Tuple
+
+from sparkucx_tpu.analysis.base import Finding, docstring_of, dotted_name, register
+from sparkucx_tpu.analysis.config import RESOURCE_PAIRS, RESOURCE_RECEIVER_SKIP
+
+PASS = "resource-balance"
+
+_BALANCED_BY = re.compile(r"#:\s*balanced by\s+([A-Za-z_][\w.]*)")
+
+_TRANSFER_PHRASES = ("released by", "caller releases", "ownership transfers")
+
+#: A frame is ``(block, index, try_ctx)``: the statement list containing
+#: the (ancestor of the) acquire, its index there, and the enclosing Try
+#: when the block is a ``try:`` body.
+Frame = Tuple[List[ast.stmt], int, Optional[ast.Try]]
+
+
+def _stmt_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Calls in one statement's own expressions — child statements are
+    visited by the block walk, nested defs/lambdas run elsewhere."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            stack.append(child)
+
+
+def _acquire_of(call: ast.Call) -> Optional[Tuple[str, str, str]]:
+    """``(receiver, acquire_name, release_name)`` when the call is a
+    tracked resource acquisition."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in RESOURCE_PAIRS:
+        return None
+    recv = dotted_name(f.value)
+    if recv is None:
+        return None
+    final = recv.split(".")[-1].lower()
+    if any(frag in final for frag in RESOURCE_RECEIVER_SKIP):
+        return None
+    return recv, f.attr, RESOURCE_PAIRS[f.attr]
+
+
+def _releases(try_node: ast.Try, recv: str, release: str) -> bool:
+    """Does the Try's finally or any except handler call recv.release?"""
+    regions: List[ast.AST] = list(try_node.finalbody)
+    for handler in try_node.handlers:
+        regions.extend(handler.body)
+    for region in regions:
+        for node in ast.walk(region):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == release
+                and dotted_name(node.func.value) == recv
+            ):
+                return True
+    return False
+
+
+def _protected(frames: List[Frame], recv: str, release: str) -> bool:
+    for block, idx, try_ctx in frames:
+        if try_ctx is not None and _releases(try_ctx, recv, release):
+            return True
+        for later in block[idx + 1:]:
+            if isinstance(later, ast.Try) and _releases(later, recv, release):
+                return True
+    return False
+
+
+def _walk_block(
+    block: List[ast.stmt], frames: List[Frame], try_ctx: Optional[ast.Try], sink
+) -> None:
+    for i, stmt in enumerate(block):
+        here = frames + [(block, i, try_ctx)]
+        for call in _stmt_calls(stmt):
+            sink(call, here)
+        if isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            _walk_block(stmt.body, here, None, sink)
+            _walk_block(stmt.orelse, here, None, sink)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            _walk_block(stmt.body, here, None, sink)
+        elif isinstance(stmt, ast.Try):
+            _walk_block(stmt.body, here, stmt, sink)
+            for handler in stmt.handlers:
+                _walk_block(handler.body, here, None, sink)
+            _walk_block(stmt.orelse, here, None, sink)
+            _walk_block(stmt.finalbody, here, None, sink)
+
+
+@register(PASS)
+def resource_balance_pass(tree: ast.Module, source: str, rel_path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    source_lines = source.splitlines()
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        doc = docstring_of(fn).lower()
+        transfer_ok = any(p in doc for p in _TRANSFER_PHRASES)
+
+        def sink(call: ast.Call, frames: List[Frame]) -> None:
+            acq = _acquire_of(call)
+            if acq is None:
+                return
+            recv, name, release = acq
+            if transfer_ok:
+                return
+            line = source_lines[call.lineno - 1] if call.lineno <= len(source_lines) else ""
+            m = _BALANCED_BY.search(line)
+            if m is not None and m.group(1).split(".")[-1] == release:
+                return
+            if _protected(frames, recv, release):
+                return
+            findings.append(Finding(rel_path, call.lineno, PASS,
+                f"'{recv}.{name}(...)' is not balanced by '{recv}.{release}' "
+                f"on exception paths (no enclosing/sibling try whose "
+                f"finally/except releases it) — leaks the resource on error; "
+                f"add the try/finally, or annotate '#: balanced by {release}' "
+                f"/ document the ownership transfer in the docstring"))
+
+        _walk_block(fn.body, [], None, sink)
+    return findings

@@ -1,0 +1,147 @@
+"""thread-lifecycle: every spawned thread is daemonized-or-joined; every
+inter-thread queue is bounded.
+
+The package spawns background threads in half a dozen places (replicator,
+eviction epochs, server-group lane senders, reactor loop, drain workers).
+Two ways such a thread is allowed to exist:
+
+* ``daemon=True`` at construction — process exit never hangs on it (the
+  thread must then tolerate dying mid-loop, which the package's daemon
+  threads do by polling closed/broken flags), or
+* the constructed thread is bound to a name that some code in the same
+  module ``join``\\ s — the owner's ``close()`` path reaps it.
+
+A non-daemon, never-joined thread is a shutdown hang waiting for its
+first exception.  Separately, every ``queue.Queue()`` feeding such
+threads must be constructed with a positive ``maxsize`` — an unbounded
+queue turns a slow consumer into an unbounded-memory producer stall
+(exactly the bug class the bounded server-group lanes were built to
+avoid).  ``SimpleQueue`` has no bound at all and is flagged outright.
+
+Escape hatch: a ``#: lifecycle: <reason>`` comment on the construction
+line, for reviewed cases (e.g. a benchmark harness thread the harness
+joins through a helper the pass cannot see).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from sparkucx_tpu.analysis.base import Finding, dotted_name, register
+
+PASS = "thread-lifecycle"
+
+ESCAPE_COMMENT = "#: lifecycle:"
+
+
+def _call_named(node: ast.Call, names) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in names
+    if isinstance(f, ast.Attribute):
+        return f.attr in names
+    return False
+
+
+def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _joined_names(tree: ast.Module) -> Set[str]:
+    """Final names of every receiver of a ``.join(...)`` call, plus every
+    collection a ``for t in <name>: ... t.join()`` loop drains — the
+    spawn-list-then-join-all idiom binds threads to a list, not a name."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "join":
+                base = dotted_name(node.func.value)
+                if base is not None:
+                    out.add(base.split(".")[-1])
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.For)
+            and isinstance(node.target, ast.Name)
+            and isinstance(node.iter, ast.Name)
+            and node.target.id in out
+        ):
+            out.add(node.iter.id)
+    return out
+
+
+def _bound_name(tree: ast.Module, call: ast.Call) -> Optional[str]:
+    """Final name a constructor call's result is assigned to — directly or
+    as an element of a comprehension/list the assignment builds."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and node.value is not None:
+            if any(sub is call for sub in ast.walk(node.value)):
+                for tgt in node.targets:
+                    d = dotted_name(tgt)
+                    if d is not None:
+                        return d.split(".")[-1]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if any(sub is call for sub in ast.walk(node.value)):
+                d = dotted_name(node.target)
+                if d is not None:
+                    return d.split(".")[-1]
+    return None
+
+
+def _line_escaped(source_lines: List[str], lineno: int) -> bool:
+    if 1 <= lineno <= len(source_lines):
+        return ESCAPE_COMMENT in source_lines[lineno - 1]
+    return False
+
+
+@register(PASS)
+def thread_lifecycle_pass(tree: ast.Module, source: str, rel_path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    source_lines = source.splitlines()
+    joined = _joined_names(tree)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _line_escaped(source_lines, node.lineno):
+            continue
+
+        if _call_named(node, ("Thread",)):
+            daemon = _kw(node, "daemon")
+            if isinstance(daemon, ast.Constant) and daemon.value is True:
+                continue
+            bound = _bound_name(tree, node)
+            if bound is not None and bound in joined:
+                continue
+            what = (
+                f"thread bound to '{bound}' is never joined in this module"
+                if bound is not None
+                else "thread is neither bound to a joinable name nor daemonized"
+            )
+            findings.append(Finding(rel_path, node.lineno, PASS,
+                f"Thread(...) without daemon=True: {what} — daemonize it or "
+                f"join it on the owner's close() path"))
+
+        elif _call_named(node, ("SimpleQueue",)):
+            findings.append(Finding(rel_path, node.lineno, PASS,
+                "SimpleQueue() has no maxsize — use a bounded queue.Queue "
+                "so a slow consumer backpressures instead of buffering "
+                "unboundedly"))
+
+        elif _call_named(node, ("Queue", "LifoQueue", "PriorityQueue")):
+            size = _kw(node, "maxsize")
+            if size is None and node.args:
+                size = node.args[0]
+            unbounded = size is None or (
+                isinstance(size, ast.Constant) and isinstance(size.value, int)
+                and size.value <= 0
+            )
+            if unbounded:
+                findings.append(Finding(rel_path, node.lineno, PASS,
+                    "queue constructed without a positive maxsize — "
+                    "unbounded queues turn a slow consumer into an "
+                    "unbounded-memory stall"))
+    return findings

@@ -250,7 +250,7 @@ class MapWriter:
                 st.device_mode = False
                 # Admission check first: an over-quota tenant write must fail
                 # typed with nothing allocated, rolled over, or copied.
-                self._store._charge_tenant(st, padded)
+                self._store._charge_tenant(st, padded)  #: balanced by _release_tenant
                 # Allocate in the current round; roll the staging epoch when the
                 # region can't take this partition (multi-round spill).
                 if int(st.region_used[peer]) + padded > st.region_size:
@@ -329,7 +329,7 @@ class MapWriter:
                         "host and device writes cannot mix"
                     )
                 st.device_mode = True
-                self._store._charge_tenant(st, padded)
+                self._store._charge_tenant(st, padded)  #: balanced by _release_tenant
                 if int(st.region_used[peer]) + padded > st.region_size:
                     if st.staging_closer is not None:
                         raise TransportError(
@@ -518,7 +518,10 @@ class HbmBlockStore:
         """Admission check at allocation time (caller holds self._lock): claim
         ``nbytes`` against the owning tenant's HBM quota.  Raises the typed
         TenantQuotaExceededError BEFORE any state mutation, so a rejected
-        write leaves the store exactly as it was."""
+        write leaves the store exactly as it was.  The charge is tracked in
+        ``st.tenant_charged`` and released by ``_release_tenant`` on shuffle
+        removal, store close, or tier demotion — ownership transfers to the
+        shuffle state, not the calling frame."""
         if self.tenants is None or st.app_id is None or nbytes <= 0:
             return
         self.tenants.charge(st.app_id, st.shuffle_id, nbytes)
@@ -986,7 +989,7 @@ class HbmBlockStore:
             if self._tier_of(st, round_idx) != "disk":
                 return False
             lane = st.alignment // 4
-            self._charge_tenant(st, self._round_nbytes(st, round_idx))
+            self._charge_tenant(st, self._round_nbytes(st, round_idx))  #: balanced by _release_tenant
             if round_idx < len(st.prev_rounds):
                 mm, used = st.prev_rounds[round_idx]
                 arr = np.array(mm)

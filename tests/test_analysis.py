@@ -12,6 +12,7 @@ use-after-release, and re-pooling with live exported views, and poisons
 freed host buffers with 0xDD.
 """
 
+import os
 import textwrap
 
 import numpy as np
@@ -474,6 +475,614 @@ class TestPrivateAndSurface:
 
 
 # ----------------------------------------------------------------------
+# lock-order (whole-program pass)
+
+
+class TestLockOrder:
+    def test_flags_inverted_acquisition_order(self):
+        findings = run_source(
+            src(
+                """
+                class Store:
+                    def fwd(self):
+                        with self._lock:
+                            with self._order_lock:
+                                pass
+
+                    def rev(self):
+                        with self._order_lock:
+                            with self._lock:
+                                pass
+                """
+            ),
+            passes=["lock-order"],
+        )
+        assert len(findings) == 1
+        assert "lock-order cycle" in findings[0].message
+        assert "Store._lock" in findings[0].message
+        assert "Store._order_lock" in findings[0].message
+
+    def test_flags_transitive_self_reacquire(self):
+        # get() holds the lock and calls a helper that takes it again — the
+        # classic non-reentrant-Lock deadlock, visible only through the call
+        # summary, not lexically.
+        findings = run_source(
+            src(
+                """
+                class Pool:
+                    def get(self):
+                        with self._lock:
+                            return self._allocate_more()
+
+                    def _allocate_more(self):
+                        with self._lock:
+                            return 1
+                """
+            ),
+            passes=["lock-order"],
+        )
+        assert len(findings) == 1
+        assert "self-cycle" in findings[0].message
+        assert "Pool._lock" in findings[0].message
+
+    def test_flags_blocking_call_under_lock(self):
+        findings = run_source(
+            src(
+                """
+                class Tx:
+                    def send(self, sock, data):
+                        with self._lock:
+                            sock.sendall(data)
+                """
+            ),
+            passes=["lock-order"],
+        )
+        assert len(findings) == 1
+        assert "blocking call 'sendall'" in findings[0].message
+        assert "Tx._lock" in findings[0].message
+
+    def test_consistent_order_clean(self):
+        findings = run_source(
+            src(
+                """
+                class Ok:
+                    def a(self):
+                        with self._lock:
+                            with self._inner_lock:
+                                pass
+
+                    def b(self):
+                        with self._lock:
+                            x = compute()
+                            with self._inner_lock:
+                                use(x)
+                """
+            ),
+            passes=["lock-order"],
+        )
+        assert findings == []
+
+    def test_send_lock_exempt_from_blocking_check(self):
+        # LOCK_BLOCKING_EXEMPT wildcards *.send_lock: serializing a blocking
+        # frame write IS that lock's documented job.
+        findings = run_source(
+            src(
+                """
+                class Conn:
+                    def write(self, sock, data):
+                        with self.send_lock:
+                            sock.sendall(data)
+                """
+            ),
+            passes=["lock-order"],
+        )
+        assert findings == []
+
+    def test_closure_lock_use_invisible(self):
+        # Documented limit: a nested def's body runs later, on another
+        # thread — its lock use must NOT count as the enclosing method's
+        # (the pool.py recycle-closure shape that false-positived as a
+        # self-cycle during development).
+        findings = run_source(
+            src(
+                """
+                class P:
+                    def get(self):
+                        with self._lock:
+                            def recycle():
+                                with self._lock:
+                                    pass
+                            return recycle
+                """
+            ),
+            passes=["lock-order"],
+        )
+        assert findings == []
+
+    def test_cross_object_edges_and_dot(self):
+        import ast as ast_mod
+
+        from sparkucx_tpu.analysis.base import Program
+        from sparkucx_tpu.analysis.lockorder import build_lock_graph, render_dot
+
+        srcs = {
+            "transport/peer.py": src(
+                """
+                class PeerTransport:
+                    def seal(self):
+                        with self._tag_lock:
+                            return self.store.num_rounds()
+                """
+            ),
+            "store/hbm_store.py": src(
+                """
+                class HbmBlockStore:
+                    def num_rounds(self):
+                        with self._lock:
+                            return 1
+                """
+            ),
+        }
+        program = Program(
+            modules={k: (ast_mod.parse(v), v) for k, v in srcs.items()},
+            docs={},
+            tests_text="",
+        )
+        edges, blocking = build_lock_graph(program)
+        # self.store.* resolves through LOCK_ATTR_CLASSES to HbmBlockStore
+        assert ("PeerTransport._tag_lock", "HbmBlockStore._lock") in edges
+        assert blocking == []
+        dot = render_dot(edges)
+        assert dot.startswith("digraph lock_order")
+        assert '"PeerTransport._tag_lock" -> "HbmBlockStore._lock"' in dot
+
+
+# ----------------------------------------------------------------------
+# reactor-discipline
+
+
+class TestReactorDiscipline:
+    def test_loop_lane_flags_blocking_socket_op_via_chain(self):
+        findings = run_source(
+            src(
+                """
+                class Server:
+                    def start(self, reactor):
+                        reactor.add_listener(self._sock, self._on_accept)
+
+                    def _on_accept(self):
+                        self._drain()
+
+                    def _drain(self):
+                        return self._sock.recv(4096)
+                """
+            ),
+            passes=["reactor-discipline"],
+        )
+        assert len(findings) == 1
+        assert "blocking socket op 'recv'" in findings[0].message
+        assert "loop" in findings[0].message
+        assert "(via '_on_accept')" in findings[0].message
+
+    def test_worker_lane_allows_reads_but_flags_join(self):
+        findings = run_source(
+            src(
+                """
+                class Conn:
+                    def start(self, reactor):
+                        reactor.add_connection(self, self._serve, on_close=self._closed)
+
+                    def _serve(self):
+                        return self._sock.recv(4096)
+
+                    def _closed(self):
+                        self._thread.join()
+                """
+            ),
+            passes=["reactor-discipline"],
+        )
+        # blocking frame reads are the worker lane's documented design;
+        # an untimed join can deadlock the pool against itself
+        assert len(findings) == 1
+        assert "'join()' without timeout" in findings[0].message
+        assert "worker" in findings[0].message
+
+    def test_escape_comment(self):
+        findings = run_source(
+            src(
+                """
+                class Server:
+                    def start(self, reactor):
+                        reactor.add_listener(self._sock, self._on_accept)
+
+                    def _on_accept(self):
+                        return self._sock.recv(4096)  #: reactor-ok
+                """
+            ),
+            passes=["reactor-discipline"],
+        )
+        assert findings == []
+
+    def test_module_without_registrations_ignored(self):
+        findings = run_source(
+            src(
+                """
+                class Plain:
+                    def fetch(self):
+                        return self._sock.recv(4096)
+                """
+            ),
+            passes=["reactor-discipline"],
+        )
+        assert findings == []
+
+
+# ----------------------------------------------------------------------
+# thread-lifecycle
+
+
+class TestThreadLifecycle:
+    def test_flags_nondaemon_unjoined_thread(self):
+        findings = run_source(
+            src(
+                """
+                import threading
+
+                def start(work):
+                    t = threading.Thread(target=work)
+                    t.start()
+                    return t
+                """
+            ),
+            passes=["thread-lifecycle"],
+        )
+        assert len(findings) == 1
+        assert "never joined" in findings[0].message
+        assert "'t'" in findings[0].message
+
+    def test_daemon_joined_and_spawn_list_idioms_clean(self):
+        findings = run_source(
+            src(
+                """
+                import threading
+
+                def daemonized(work):
+                    t = threading.Thread(target=work, daemon=True)
+                    t.start()
+
+                def reaped(work):
+                    t = threading.Thread(target=work)
+                    t.start()
+                    t.join()
+
+                def harness(work, n):
+                    threads = [threading.Thread(target=work) for _ in range(n)]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                """
+            ),
+            passes=["thread-lifecycle"],
+        )
+        assert findings == []
+
+    def test_queue_bounds(self):
+        findings = run_source(
+            src(
+                """
+                import queue
+
+                def make():
+                    a = queue.Queue()
+                    b = queue.Queue(maxsize=0)
+                    c = queue.SimpleQueue()
+                    good = queue.Queue(maxsize=64)
+                    also_good = queue.Queue(8)
+                    return a, b, c, good, also_good
+                """
+            ),
+            passes=["thread-lifecycle"],
+        )
+        msgs = messages(findings)
+        assert len(findings) == 3
+        assert sum("without a positive maxsize" in m for m in msgs) == 2
+        assert sum("SimpleQueue" in m for m in msgs) == 1
+
+    def test_escape_comment(self):
+        findings = run_source(
+            src(
+                """
+                import threading
+
+                def start(work):
+                    t = threading.Thread(target=work)  #: lifecycle: joined by the harness teardown helper
+                    t.start()
+                    return t
+                """
+            ),
+            passes=["thread-lifecycle"],
+        )
+        assert findings == []
+
+
+# ----------------------------------------------------------------------
+# resource-balance
+
+
+class TestResourceBalance:
+    def test_flags_unbalanced_acquire(self):
+        findings = run_source(
+            src(
+                """
+                class Reader:
+                    def admit(self, n):
+                        self._gate.acquire(n)
+                        self.do_fetch(n)
+                """
+            ),
+            passes=["resource-balance"],
+        )
+        assert len(findings) == 1
+        assert "self._gate.acquire" in findings[0].message
+        assert "exception paths" in findings[0].message
+
+    def test_try_finally_sibling_and_enclosing_clean(self):
+        findings = run_source(
+            src(
+                """
+                class Reader:
+                    def sibling(self, n):
+                        self._gate.acquire(n)
+                        try:
+                            self.do_fetch(n)
+                        finally:
+                            self._gate.release(n)
+
+                    def enclosing(self, n):
+                        try:
+                            self._gate.acquire(n)
+                            self.do_fetch(n)
+                        finally:
+                            self._gate.release(n)
+
+                    def handler(self, st, n):
+                        try:
+                            self.tenants.charge(st, n)
+                            self.stage(st)
+                        except Exception:
+                            self.tenants.release(st, n)
+                            raise
+                """
+            ),
+            passes=["resource-balance"],
+        )
+        assert findings == []
+
+    def test_lock_receivers_skipped(self):
+        # lock.acquire() belongs to the lock passes, not resource balance
+        findings = run_source(
+            src(
+                """
+                class C:
+                    def f(self):
+                        self._lock.acquire()
+                        self._cond.acquire()
+                """
+            ),
+            passes=["resource-balance"],
+        )
+        assert findings == []
+
+    def test_escape_comment_and_docstring_transfer(self):
+        findings = run_source(
+            src(
+                """
+                class Store:
+                    def restage(self, st, n):
+                        self._charge_tenant(st, n)  #: balanced by _release_tenant
+                        self.promote(st)
+
+                    def _charge_tenant(self, st, n):
+                        \"\"\"Claim quota; released by ``_release_tenant`` on removal.\"\"\"
+                        self.tenants.charge(st.app_id, n)
+                """
+            ),
+            passes=["resource-balance"],
+        )
+        assert findings == []
+
+    def test_wrong_release_name_in_comment_still_flags(self):
+        findings = run_source(
+            src(
+                """
+                class Store:
+                    def restage(self, st, n):
+                        self._charge_tenant(st, n)  #: balanced by something_else
+                """
+            ),
+            passes=["resource-balance"],
+        )
+        assert len(findings) == 1
+
+
+# ----------------------------------------------------------------------
+# wire-schema (whole-program pass; docs injected through run_source)
+
+
+WIRE_FIXTURE = """
+import struct
+
+class AmId:
+    FETCH_REQ = 0
+    FETCH_ACK = 1
+
+_HDR = struct.Struct("<IQQ")
+"""
+
+WIRE_DOC_COMPLETE = (
+    "| 0 | FetchReq | request |\n"
+    "| 1 | FetchAck | reply |\n"
+    "frame prefix is `<IQQ>` little-endian\n"
+)
+
+
+class TestWireSchema:
+    def test_flags_undocumented_id_and_struct(self):
+        findings = run_source(
+            src(WIRE_FIXTURE),
+            passes=["wire-schema"],
+            docs={"SHIM_PROTOCOL.md": "| 0 | FetchReq | request |\n"},
+        )
+        msgs = messages(findings)
+        assert len(findings) == 2
+        assert any("FETCH_ACK=1" in m and "FetchAck" in m for m in msgs)
+        assert any("_HDR" in m and "<IQQ" in m for m in msgs)
+
+    def test_complete_doc_clean(self):
+        findings = run_source(
+            src(WIRE_FIXTURE),
+            passes=["wire-schema"],
+            docs={"SHIM_PROTOCOL.md": WIRE_DOC_COMPLETE},
+        )
+        assert findings == []
+
+    def test_duplicate_and_gap_values_flagged_without_doc(self):
+        dup = run_source(
+            src(
+                """
+                class AmId:
+                    A = 0
+                    B = 0
+                """
+            ),
+            passes=["wire-schema"],
+        )
+        assert len(dup) == 1 and "duplicate values" in dup[0].message
+        gap = run_source(
+            src(
+                """
+                class AmId:
+                    A = 0
+                    B = 2
+                """
+            ),
+            passes=["wire-schema"],
+        )
+        assert len(gap) == 1 and "not contiguous" in gap[0].message
+
+    def test_doc_checks_skipped_without_doc(self):
+        # installed-package runs have no docs/; the shape checks still run
+        findings = run_source(src(WIRE_FIXTURE), passes=["wire-schema"])
+        assert findings == []
+
+    def test_extractors_roundtrip(self):
+        from sparkucx_tpu.analysis.protocol import camel, extract_am_ids, extract_structs
+
+        assert extract_am_ids(src(WIRE_FIXTURE)) == {"FETCH_REQ": 0, "FETCH_ACK": 1}
+        assert extract_structs(src(WIRE_FIXTURE)) == {"_HDR": "<IQQ"}
+        assert camel("REPLICA_PUT") == "ReplicaPut"
+        assert camel("MEMBER_SUSPECT") == "MemberSuspect"
+
+
+# ----------------------------------------------------------------------
+# conf-registry (whole-program pass; docs + tests text injected)
+
+
+CONF_FIXTURE = """
+class Conf:
+    alpha: int = 0
+    beta: bool = False
+
+    @classmethod
+    def from_spark_conf(cls, conf):
+        out = cls()
+        for name, attr, conv in [
+            ("alpha", "alpha", int),
+            ("beta.enabled", "beta", bool),
+            ("gamma", "gamma_typo", int),
+        ]:
+            pass
+        return out
+"""
+
+
+class TestConfRegistry:
+    def test_flags_typo_field_missing_doc_and_missing_test(self):
+        findings = run_source(
+            src(CONF_FIXTURE),
+            passes=["conf-registry"],
+            docs={"DEPLOYMENT.md": "| `spark.shuffle.tpu.alpha` | 0 | the alpha |\n"},
+            tests_text="conf.alpha == 3",
+        )
+        msgs = messages(findings)
+        assert any("unknown conf field 'gamma_typo'" in m for m in msgs)
+        assert any("'spark.shuffle.tpu.beta.enabled' has no DEPLOYMENT.md row" in m for m in msgs)
+        assert any("'spark.shuffle.tpu.beta.enabled'" in m and "no test" in m for m in msgs)
+        assert not any("alpha" in m and "no test" in m for m in msgs)
+
+    def test_fully_registered_clean(self):
+        findings = run_source(
+            src(
+                """
+                class Conf:
+                    alpha: int = 0
+
+                    @classmethod
+                    def from_spark_conf(cls, conf):
+                        out = cls()
+                        for name, attr, conv in [("alpha", "alpha", int)]:
+                            pass
+                        return out
+                """
+            ),
+            passes=["conf-registry"],
+            docs={"DEPLOYMENT.md": "| `spark.shuffle.tpu.alpha` | 0 | the alpha |\n"},
+            tests_text="spark.shuffle.tpu.alpha",
+        )
+        assert findings == []
+
+    def test_off_path_default_drift_flagged(self):
+        # `elastic` is pinned False in OFF_PATH_DEFAULTS: a fixture class
+        # defaulting it True is exactly the flipped-default drift the pass
+        # exists to catch
+        findings = run_source(
+            src(
+                """
+                class Conf:
+                    elastic: bool = True
+
+                    @classmethod
+                    def from_spark_conf(cls, conf):
+                        return cls()
+                """
+            ),
+            passes=["conf-registry"],
+        )
+        assert len(findings) == 1
+        assert "off-path default drift" in findings[0].message
+        assert "'elastic'" in findings[0].message
+
+    def test_fixture_subset_no_stale_pin_noise(self):
+        # only the real config.py owes every pinned field; a fixture class
+        # defining one knob must not spray "stale pin" findings
+        findings = run_source(
+            src(
+                """
+                class Conf:
+                    alpha: int = 0
+
+                    @classmethod
+                    def from_spark_conf(cls, conf):
+                        return cls()
+                """
+            ),
+            passes=["conf-registry"],
+        )
+        assert findings == []
+
+
+# ----------------------------------------------------------------------
 # CLI
 
 
@@ -511,8 +1120,41 @@ class TestCli:
             "cache-hygiene",
             "private-access",
             "required-surface",
+            "lock-order",
+            "reactor-discipline",
+            "thread-lifecycle",
+            "resource-balance",
+            "wire-schema",
+            "conf-registry",
         ):
             assert name in out
+
+    def test_stale_allowlist_entry_fails_full_run(self, capsys, monkeypatch):
+        import sparkucx_tpu.analysis.__main__ as cli
+
+        stale = ("no/such_file.py", "lock-discipline", "never-matches-anything")
+        monkeypatch.setattr(cli, "ALLOWLIST", cli.ALLOWLIST | {stale})
+        assert analysis_main([]) == 1
+        err = capsys.readouterr().err
+        assert "stale allowlist entry" in err
+        assert "never-matches-anything" in err
+
+    def test_dump_lock_graph(self, capsys):
+        assert analysis_main(["--dump-lock-graph"]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("digraph lock_order")
+        # the store lock nests inside the transport's tag lock, and the
+        # tenant registry lock inside the store lock — the documented chain
+        assert '"HbmBlockStore._lock" -> "TenantRegistry._lock"' in out
+
+    def test_tests_tree_private_access_clean(self):
+        from sparkucx_tpu.analysis.base import repo_root
+
+        tests_dir = os.path.join(repo_root(), "tests")
+        assert analysis_main(
+            ["--ci", "--root", tests_dir, "--passes", "private-access",
+             "--allowlist", "tests"]
+        ) == 0
 
 
 # ----------------------------------------------------------------------

@@ -193,7 +193,19 @@ class TestWireFrames:
         # gossip (MemberSuspect / MemberRejoin) — peer plane only, never
         # emitted at wire.streams=1 / replication.factor=0 / elastic off, so
         # reference parity holds for every frame a stock deployment sees.
-        assert [int(a) for a in AmId] == [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+        #
+        # The pin list is generated from the SOURCE of core/definitions.py by
+        # the analyzer's wire-schema extractor, then cross-checked against the
+        # runtime enum: a new AmId cannot land without showing up here AND in
+        # SHIM_PROTOCOL.md (the wire-schema pass gates the doc side in CI).
+        import inspect
+
+        from sparkucx_tpu.analysis.protocol import extract_am_ids
+        from sparkucx_tpu.core import definitions
+
+        extracted = extract_am_ids(inspect.getsource(definitions))
+        assert extracted == {a.name: int(a) for a in AmId}
+        assert sorted(extracted.values()) == list(range(11))
         assert AmId.FETCH_BLOCK_CHUNK == 5 and AmId.WIRE_HELLO == 6
         assert AmId.REPLICA_PUT == 7 and AmId.REPLICA_ACK == 8
         assert AmId.MEMBER_SUSPECT == 9 and AmId.MEMBER_REJOIN == 10
@@ -235,6 +247,27 @@ class TestConf:
         assert c.max_blocks_per_request == 10
         assert c.num_executors == 8
         assert c.num_client_workers == 4  # falls back to spark.executor.cores
+
+    def test_from_spark_conf_sizes_and_service_knobs(self):
+        # Parse/convert coverage for every knob the conf-registry analyzer
+        # pass tracks that the round-trip test above doesn't touch: size
+        # suffixes, ms durations, and the service-plane integers.
+        c = TpuShuffleConf.from_spark_conf(
+            {
+                "spark.shuffle.tpu.numListenerThreads": "5",
+                "spark.shuffle.tpu.wire.creditBytes": "32m",
+                "spark.shuffle.tpu.wire.sockBufBytes": "8m",
+                "spark.shuffle.tpu.membership.suspectAfterMs": "250",
+                "spark.shuffle.tpu.tenants.hbmQuotaBytes": "16m",
+                "spark.shuffle.tpu.eviction.epochMs": "1000",
+            }
+        )
+        assert c.num_listener_threads == 5
+        assert c.wire_credit_bytes == 32 << 20
+        assert c.wire_sock_buf_bytes == 8 << 20
+        assert c.membership_suspect_after_ms == 250
+        assert c.tenant_hbm_quota_bytes == 16 << 20
+        assert c.eviction_epoch_ms == 1000
 
     def test_validation(self):
         with pytest.raises(ValueError):
