@@ -32,7 +32,10 @@ aggregate GB/s, the min/max per-app fairness ratio, and p99 per-block fetch
 latency, ``compress`` — wire payload
 compression (perf/benchmark.py measure_compress; TPU-free): per-codec fetch
 GB/s and compression ratio on a dictionary-heavy matrix vs incompressible
-noise, plus an end-to-end compressed shuffle-read leg.
+noise, plus an end-to-end compressed shuffle-read leg, ``obs`` — the
+telemetry plane (perf/benchmark.py measure_obs; TPU-free): fetch GB/s with
+tracing off vs ring-only (the always-on flight recorder's steady state) vs
+full wire-context export, asserting the recorder's accounted overhead < 1%.
 
 A small end-to-end shuffle (stage -> commit -> exchange -> fetch vs oracle) runs
 untimed first as an integrity gate.
@@ -384,6 +387,28 @@ def main():
         }
     except Exception as e:
         RESULT["compress_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # 1f. Observability sub-metric — also TPU-free (2-executor loopback
+    # fetch): GB/s with tracing off / ring-only (the always-on flight
+    # recorder's steady state) / full wire-context export.  measure_obs
+    # asserts the recorder's accounted overhead (events/pass x ns/record)
+    # < 1%; the disabled-span() fast path is the docs/PERF.md number.
+    try:
+        from sparkucx_tpu.perf.benchmark import measure_obs
+
+        ob = measure_obs(num_blocks=8, block_bytes=4 << 20, iterations=3)
+        RESULT["obs"] = {
+            "off_gbps": round(ob["off_gbps"], 3),
+            "ring_gbps": round(ob["ring_gbps"], 3),
+            "full_gbps": round(ob["full_gbps"], 3),
+            "ring_overhead_pct": round(ob["ring_overhead_pct"], 3),
+            "span_disabled_ns": round(ob["span_disabled_ns"], 1),
+            "span_record_ns": round(ob["span_record_ns"], 1),
+            "merged_events": ob["merged_events"],
+            "export_ms": round(ob["export_ms"], 1),
+        }
+    except Exception as e:
+        RESULT["obs_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # 2. Bounded chip probe — never touch the backend in-process before this.
     platform, probe_err = probe_tpu(budget_left)

@@ -198,6 +198,14 @@ LOCK_ATTR_CLASSES = {
     "_reactor": "Reactor",
     "server": "BlockServer",
     "membership": "ClusterMembership",
+    # obs plane (PR 14): the registry lock is a leaf by design (providers run
+    # OUTSIDE it — obs/metrics.py snapshot()); the recorder and tracer locks
+    # guard only their own ring/bundle lists.  Wiring them here lets the
+    # lock-order pass prove those claims instead of assuming them.
+    "metrics": "MetricsRegistry",
+    "_metrics": "MetricsRegistry",
+    "recorder": "FlightRecorder",
+    "tracer": "Tracer",
 }
 
 #: Locks that exist to SERIALIZE a blocking wire write and are therefore
@@ -307,6 +315,10 @@ OFF_PATH_DEFAULTS = {
     "slot_quota_rows": 0,
     "host_recv_mode": "array",
     "sanitize": False,
+    "obs_trace_context": False,
+    "obs_metrics_port": 0,
+    "obs_ring_capacity": 8192,
+    "obs_postmortem_dir": "",
 }
 
 # ----------------------------------------------------------------------

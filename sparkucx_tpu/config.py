@@ -325,6 +325,30 @@ class TpuShuffleConf:
 
     # instrumentation
     collect_stats: bool = True
+
+    #: Distributed-trace context propagation (obs plane): when on, fetch
+    #: requests and replica pushes carry the issuing span's (trace_id,
+    #: span_id) as a self-describing trailing header extension
+    #: (core/definitions.py ``_TRACE_EXT`` / ``_REPLICA_TRACE_EXT``), so
+    #: server-side serve/read/restage spans parent under the reducer's fetch
+    #: span in the merged Perfetto view (TpuShuffleCluster.export_trace).
+    #: Default off: every golden wire frame stays byte-identical.
+    obs_trace_context: bool = False
+    #: Local Prometheus scrape endpoint port (obs/metrics.py
+    #: ``start_http_server``): GET /metrics serves this executor's
+    #: MetricsRegistry text exposition.  0 (default) = no HTTP server; the
+    #: peer-plane METRICS_PULL Active Message works regardless.
+    obs_metrics_port: int = 0
+    #: Flight-recorder ring capacity (utils/trace.py): the bounded
+    #: drop-oldest event ring that backs both full tracing and the always-on
+    #: postmortem recorder.  Oldest events are evicted (and counted) once the
+    #: ring is full, so long-running tracing can't OOM an executor.
+    obs_ring_capacity: int = 8192
+    #: Postmortem bundle directory (obs/recorder.py): when set, every
+    #: flight-recorder capture (TransportError, elastic recovery, chaos
+    #: fault) is additionally written as a JSON file here.  Empty (default) =
+    #: in-memory only (``FlightRecorder.last_postmortem``) — no file writes.
+    obs_postmortem_dir: str = ""
     #: Runtime buffer sanitizer (memory/sanitizer.py): track pooled-handle
     #: lifecycles, poison freed host buffers with 0xDD, and RAISE on
     #: double-release / use-after-release / re-pooling a buffer with live
@@ -417,6 +441,10 @@ class TpuShuffleConf:
             ("slotQuotaRows", "slot_quota_rows", int),
             ("deviceStaging", "device_staging", lambda v: str(v).lower() == "true"),
             ("sanitize", "sanitize", lambda v: str(v).lower() == "true"),
+            ("obs.traceContext", "obs_trace_context", lambda v: str(v).lower() == "true"),
+            ("obs.metricsPort", "obs_metrics_port", int),
+            ("obs.ringCapacity", "obs_ring_capacity", int),
+            ("obs.postmortemDir", "obs_postmortem_dir", str),
         ]:
             v = get(name)
             if v is not None:
@@ -484,6 +512,10 @@ class TpuShuffleConf:
             raise ValueError("eviction_epoch_ms must be >= 0 (0 = manual epochs)")
         if self.server_workers < 0:
             raise ValueError("server_workers must be >= 0 (0 = thread-per-connection)")
+        if not (0 <= self.obs_metrics_port <= 65535):
+            raise ValueError("obs_metrics_port must be in [0, 65535] (0 = no HTTP endpoint)")
+        if self.obs_ring_capacity <= 0:
+            raise ValueError("obs_ring_capacity must be positive (the ring is always bounded)")
 
     def replace(self, **kw) -> "TpuShuffleConf":
         out = dataclasses.replace(self, **kw)

@@ -21,6 +21,10 @@ MemberSuspect          9  membership: (epoch, executor, observer) — the observ
                           saw a wire error / timeout naming this executor
 MemberRejoin          10  membership: (epoch, executor, observer) — the executor
                           came back; the full mesh returns next shuffle epoch
+TracePull             11  observability: pull the peer's trace-event ring —
+                          request (tag), reply body = JSON event buffer
+MetricsPull           12  observability: pull the peer's metrics snapshot —
+                          request (tag), reply body = Prometheus text
 ====================  ==  =======================================================
 
 Ids 5-6 extend the reference schema for the striped zero-copy wire path: a
@@ -60,6 +64,8 @@ class AmId(enum.IntEnum):
     REPLICA_ACK = 8
     MEMBER_SUSPECT = 9
     MEMBER_REJOIN = 10
+    TRACE_PULL = 11
+    METRICS_PULL = 12
 
 
 _FRAME = struct.Struct("<IQQ")
@@ -136,7 +142,11 @@ def unpack_chunk_hdr(data) -> Tuple[int, int, int, int]:
 #: ReplicaPut reuses the same two extensions after its entry table, same
 #: order (codec ext, then crc), detected by the residue of
 #: ``len(header) - REPLICA_HEADER_SIZE`` modulo ``REPLICA_ENTRY_SIZE``
-#: (entries are 16 B; residues 0/4/8/12 = plain/crc/codec/codec+crc).
+#: (entries are 16 B; residues 0/4/8/12 = plain/crc/codec/codec+crc).  The
+#: 18-byte trace-context extension (``_REPLICA_TRACE_EXT``, obs plane) — when
+#: present — is appended LAST, after the crc trailer, shifting every residue
+#: by 2 (residues 2/6/10/14); receivers strip it first, then dispatch the
+#: remaining residue through the table above unchanged.
 #: When a server's codec is on, EVERY chunk carries the codec ext —
 #: unprofitable pages ship ``codec_id = 0`` (raw) with ``raw_len`` equal to
 #: the payload length, keeping the header length uniform per reply.
@@ -197,6 +207,64 @@ def pack_replica_ack(shuffle_id: int, src_executor: int, round_idx: int) -> byte
 def unpack_replica_ack(data) -> Tuple[int, int, int]:
     sid, src, rnd, _ = _REPLICA_HDR.unpack_from(data)
     return sid, src, rnd
+
+
+#: Distributed-trace context extensions (obs plane, ``obs.traceContext``).
+#: Self-describing trailers in the same family as the tenant app-id ext
+#: (transport/peer.py ``_APP``): default-off keeps every golden frame
+#: byte-identical, and old receivers that don't know the ext still parse the
+#: base layout because they validate exact lengths / residues.
+#:
+#: FetchBlockReq carries a 20-byte ``<IQQ>`` trailer (magic, trace_id,
+#: span_id) appended LAST — after the optional app-id ext.  The magic
+#: disambiguates it from an app-id ext whose utf-8 payload happens to be
+#: 16 bytes: ``unpack_fetch_req_app_id`` requires the app ext to account for
+#: the EXACT remaining length, so a trailing trace ext simply reads as "not
+#: an app ext" to pre-obs servers.
+#:
+#: ReplicaPut carries an 18-byte ``<HQQ>`` trailer (u16 magic, trace_id,
+#: span_id) appended LAST — after the crc trailer — giving header residues
+#: {2, 6, 10, 14} mod 16, disjoint from the crc/codec residues {0, 4, 8, 12}:
+#: receivers detect ``residue % 4 == 2``, strip the last 18 bytes, and run
+#: the existing codec/crc dispatch on what remains.
+TRACE_EXT_MAGIC = 0x54524143  # "TRAC"
+REPLICA_TRACE_MAGIC = 0x5443  # "TC"
+_TRACE_EXT = struct.Struct("<IQQ")
+_REPLICA_TRACE_EXT = struct.Struct("<HQQ")
+TRACE_EXT_SIZE = _TRACE_EXT.size
+REPLICA_TRACE_EXT_SIZE = _REPLICA_TRACE_EXT.size
+
+
+def pack_trace_ext(trace_id: int, span_id: int) -> bytes:
+    """FetchBlockReq trace-context trailer."""
+    return _TRACE_EXT.pack(TRACE_EXT_MAGIC, trace_id, span_id)
+
+
+def unpack_trace_ext(data) -> Optional[Tuple[int, int]]:
+    """(trace_id, span_id) when ``data`` ends in a trace ext, else None."""
+    if len(data) < TRACE_EXT_SIZE:
+        return None
+    magic, trace_id, span_id = _TRACE_EXT.unpack_from(data, len(data) - TRACE_EXT_SIZE)
+    if magic != TRACE_EXT_MAGIC:
+        return None
+    return trace_id, span_id
+
+
+def pack_replica_trace_ext(trace_id: int, span_id: int) -> bytes:
+    """ReplicaPut trace-context trailer (appended after the crc trailer)."""
+    return _REPLICA_TRACE_EXT.pack(REPLICA_TRACE_MAGIC, trace_id, span_id)
+
+
+def unpack_replica_trace_ext(data) -> Optional[Tuple[int, int]]:
+    """(trace_id, span_id) when ``data`` ends in a ReplicaPut trace ext."""
+    if len(data) < REPLICA_TRACE_EXT_SIZE:
+        return None
+    magic, trace_id, span_id = _REPLICA_TRACE_EXT.unpack_from(
+        data, len(data) - REPLICA_TRACE_EXT_SIZE
+    )
+    if magic != REPLICA_TRACE_MAGIC:
+        return None
+    return trace_id, span_id
 
 
 #: Membership frame header (MemberSuspect / MemberRejoin): the observer's

@@ -30,8 +30,36 @@ class OperationStatus(enum.Enum):
     FAILURE = "FAILURE"
 
 
+#: Observer callbacks fired when any TransportError (or subclass) is
+#: constructed — the flight recorder (obs/recorder.py) registers here to
+#: capture a postmortem bundle at the instant a transport-level failure is
+#: born, before the catch-site decides whether it is retryable.  Lives in
+#: this leaf module so obs can hook transports without an import cycle.
+_failure_hooks: List[Callable[["TransportError"], None]] = []
+
+
+def register_failure_hook(hook: Callable[["TransportError"], None]) -> None:
+    if hook not in _failure_hooks:
+        _failure_hooks.append(hook)
+
+
+def unregister_failure_hook(hook: Callable[["TransportError"], None]) -> None:
+    try:
+        _failure_hooks.remove(hook)
+    except ValueError:
+        pass
+
+
 class TransportError(RuntimeError):
     """ShuffleTransport.scala:60-62 (``TransportError`` wraps an error message)."""
+
+    def __init__(self, *args) -> None:
+        super().__init__(*args)
+        for hook in list(_failure_hooks):
+            try:
+                hook(self)
+            except Exception:
+                pass  # observability must never turn a failure into two
 
 
 class BlockNotFoundError(TransportError):

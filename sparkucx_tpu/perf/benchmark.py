@@ -123,7 +123,7 @@ def _parse_args(argv):
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "wire", "ici",
-            "failover", "elastic", "compress", "tenants",
+            "failover", "elastic", "compress", "tenants", "obs",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -1062,6 +1062,171 @@ def measure_elastic(
     }
 
 
+def measure_obs(
+    num_blocks: int = 8,
+    block_bytes: int = 4 << 20,
+    iterations: int = 3,
+    report=None,
+) -> dict:
+    """Measurement core of the ``obs`` mode — telemetry-plane overhead.
+
+    Two loopback executors; executor 1 stages ``num_blocks`` blocks and
+    executor 0 streams them back, with ``obs.traceContext`` compiled in but
+    the process tracer flipped per leg:
+
+    * ``off``     — tracing AND recording disabled (the always-on flight
+      recorder switched off; nothing rides the wire, ``span()`` returns the
+      shared no-op singleton);
+    * ``ring``    — recording only: the flight recorder's steady-state
+      default.  Spans land in the bounded ring, nothing rides the wire.
+      The always-on contract is ``ring`` overhead < 1% — asserted here
+      against the ACCOUNTED cost (events recorded per pass x measured
+      ns/record, over the pass wall time), because a loopback socket's
+      run-to-run throughput jitter is itself several percent and would
+      swamp a wall-clock delta of microseconds;
+    * ``full``    — tracing enabled: span contexts ride FetchBlockReq as the
+      trailing ext, the server re-parents serve spans, and afterwards the
+      buffers are pulled over TracePull and merged into one event list
+      (export timed separately, not inside the fetch loop).
+
+    Also times the disabled-``span()`` fast path (ns/call).  Returns GB/s per
+    leg, overhead percentages, the fast-path cost, and the merged-export
+    stats.  ``report(leg, it, seconds, bytes)`` per pass.  Shared by the CLI
+    and bench.py."""
+    from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+    from sparkucx_tpu.utils.trace import TRACER, merge_events, span
+
+    conf = TpuShuffleConf(
+        obs_trace_context=True,
+        staging_capacity_per_executor=num_blocks * block_bytes + (1 << 20),
+    )
+    executors = [0, 1]
+    ts = [PeerTransport(conf, executor_id=i) for i in executors]
+    addrs = [t.init() for t in ts]
+    for t in ts:
+        for j, a in enumerate(addrs):
+            if j != t.executor_id:
+                t.add_executor(j, a)
+    total = num_blocks * block_bytes
+    saved = (TRACER.enabled, TRACER.recording)
+    try:
+        rng = np.random.default_rng(0)
+        payload = rng.integers(0, 256, size=block_bytes, dtype=np.uint8).tobytes()
+        ts[1].store.create_shuffle(0, 1, num_blocks)
+        w = ts[1].store.map_writer(0, 0)
+        for r in range(num_blocks):
+            w.write_partition(r, payload)
+        w.commit()
+        ts[1].store.seal(0)
+
+        def make_reader():
+            return TpuShuffleReader(
+                ts[0],
+                executor_id=0,
+                shuffle_id=0,
+                start_partition=0,
+                end_partition=num_blocks,
+                num_mappers=1,
+                block_sizes=lambda m, r: block_bytes,
+                max_blocks_per_request=1,  # one window per block: every block
+                sender_of=lambda m: 1,     # fetch is its own read.window span
+            )
+
+        def consume():
+            n = 0
+            t0 = time.perf_counter()
+            for blk in make_reader().fetch_blocks():
+                blk.release()
+                n += 1
+            assert n == num_blocks
+            return time.perf_counter() - t0
+
+        # disabled-span fast path: one attribute check + the shared singleton
+        TRACER.enabled = False
+        TRACER.recording = False
+        calls = 200_000
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            with span("bench.noop"):
+                pass
+        span_disabled_ns = (time.perf_counter() - t0) / calls * 1e9
+
+        consume()  # warmup: connect, page in
+
+        def leg(name, enabled, recording):
+            TRACER.clear()
+            TRACER.enabled = enabled
+            TRACER.recording = recording
+            # both transports share ``conf``: the ext rides only on the full
+            # leg, so ``ring`` measures exactly the always-on default
+            conf.obs_trace_context = enabled
+            best_dt = float("inf")
+            for it in range(iterations):
+                dt = consume()
+                best_dt = min(best_dt, dt)
+                if report is not None:
+                    report(name, it, dt, total)
+            return best_dt, len(TRACER.events)
+
+        off_dt, _ = leg("off", False, False)
+        ring_dt, ring_events = leg("ring", False, True)
+        full_dt, _ = leg("full", True, True)
+        off = total / off_dt / 1e9
+        ring = total / ring_dt / 1e9
+        full = total / full_dt / 1e9
+
+        # the full leg's export (while its events are still in the ring):
+        # pull the server's buffer over the TracePull AM and merge with the
+        # local ring — ONE event list, two pids
+        t0 = time.perf_counter()
+        remote = ts[0].pull_trace(1)
+        merged = merge_events([TRACER.events, remote["events"]])
+        export_ms = (time.perf_counter() - t0) * 1e3
+
+        # record-path cost: time actual ring appends while recording
+        TRACER.clear()
+        TRACER.enabled = False
+        TRACER.recording = True
+        calls = 50_000
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            with span("bench.record"):
+                pass
+        span_record_ns = (time.perf_counter() - t0) / calls * 1e9
+
+        # the always-on contract: the recorder's accounted steady-state cost
+        # (events it records per pass x the measured cost of recording one)
+        # must be < 1% of the pass — the wall-clock ring-vs-off delta is also
+        # reported but NOT asserted on, since loopback jitter exceeds 1%
+        events_per_pass = ring_events / max(iterations, 1)
+        ring_overhead = events_per_pass * span_record_ns / (ring_dt * 1e9)
+        assert ring_overhead < 0.01, (
+            f"always-on recorder overhead {ring_overhead * 100:.3f}% >= 1% "
+            f"({events_per_pass:.0f} events/pass x {span_record_ns:.0f} ns "
+            f"over {ring_dt * 1e3:.1f} ms)"
+        )
+
+        return {
+            "off_gbps": off,
+            "ring_gbps": ring,
+            "full_gbps": full,
+            "ring_overhead_pct": ring_overhead * 100.0,
+            "ring_wall_delta_pct": (1.0 - ring / max(off, 1e-9)) * 100.0,
+            "full_wall_delta_pct": (1.0 - full / max(off, 1e-9)) * 100.0,
+            "events_per_pass": events_per_pass,
+            "span_record_ns": span_record_ns,
+            "span_disabled_ns": span_disabled_ns,
+            "export_ms": export_ms,
+            "merged_events": len(merged),
+            "merged_pids": len({e.get("pid") for e in merged}),
+        }
+    finally:
+        TRACER.enabled, TRACER.recording = saved
+        TRACER.clear()
+        for t in ts:
+            t.close()
+
+
 def measure_pipeline(
     executors: int, round_bytes: int, rounds: int, iterations: int,
     depths=(1, 2, 3), report=None,
@@ -1339,6 +1504,33 @@ def run_elastic(args) -> None:
         f"(epoch {r['epoch']}, mesh {n} -> {r['degraded_mesh']} "
         f"on {list(r['survivors'])}), "
         f"{r['recoveries']} recoveries, bit-identical asserted",
+        flush=True,
+    )
+
+
+def run_obs(args) -> None:
+    size = parse_size(args.block_size)
+
+    def report(leg, it, dt, tot):
+        print(
+            f"{leg} iter {it}: {args.num_blocks} x {size} B in "
+            f"{dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    r = measure_obs(args.num_blocks, size, args.iterations, report=report)
+    print(
+        f"obs: off {r['off_gbps']:.2f} GB/s, "
+        f"ring-only {r['ring_gbps']:.2f} GB/s, "
+        f"full export {r['full_gbps']:.2f} GB/s; "
+        f"always-on recorder {r['events_per_pass']:.0f} events/pass x "
+        f"{r['span_record_ns']:.0f} ns = {r['ring_overhead_pct']:.3f}% "
+        f"accounted overhead (<1% asserted; wall delta "
+        f"{r['ring_wall_delta_pct']:+.1f}% ring / "
+        f"{r['full_wall_delta_pct']:+.1f}% full, loopback jitter included), "
+        f"disabled span() {r['span_disabled_ns']:.0f} ns/call, "
+        f"TracePull merge {r['merged_events']} events from "
+        f"{r['merged_pids']} executors in {r['export_ms']:.1f} ms",
         flush=True,
     )
 
@@ -2377,6 +2569,8 @@ def main(argv=None) -> None:
         run_tenants(args)
     elif args.mode == "elastic":
         run_elastic(args)
+    elif args.mode == "obs":
+        run_obs(args)
     elif args.mode == "pipeline":
         run_pipeline(args)
     elif args.mode == "gather":

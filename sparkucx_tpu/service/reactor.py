@@ -54,6 +54,7 @@ class Reactor:
         self._conns: Dict[socket.socket, Tuple] = {}  #: guarded by self._lock
         self._listeners: List[socket.socket] = []  #: guarded by self._lock
         self._closed = False  #: guarded by self._lock
+        self._frames_served = 0  #: worker-pool dispatches; guarded by self._lock
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
         self._thread.start()
@@ -97,6 +98,16 @@ class Reactor:
     def num_connections(self) -> int:
         with self._lock:
             return len(self._conns)
+
+    def stats(self) -> Dict[str, int]:
+        """Serving-plane health for the metrics registry: resident
+        connections, pool width, frames dispatched to workers so far."""
+        with self._lock:
+            return {
+                "connections": len(self._conns),
+                "workers": self.workers,
+                "frames_served": self._frames_served,
+            }
 
     # -- internals ------------------------------------------------------
     def _wake(self) -> None:
@@ -169,6 +180,8 @@ class Reactor:
 
     def _serve(self, conn: socket.socket, serve_once, on_close) -> None:
         keep = False
+        with self._lock:
+            self._frames_served += 1
         try:
             keep = bool(serve_once(conn))
         except Exception:

@@ -73,6 +73,9 @@ class DaemonOp:
     STATS = 22
     SHUTDOWN = 23
     ACK = 24
+    # obs plane (PR 14): control-plane pulls of the daemon-side telemetry
+    EXPORT_TRACE = 25
+    METRICS = 26
 
 
 def _frame(op: int, header: dict, body: bytes = b"") -> bytes:
@@ -249,6 +252,14 @@ class ShuffleDaemon:
             self._ack(conn, True, num_mappers=meta_obj.num_mappers,
                       num_reducers=meta_obj.num_reducers, exchanged=meta_obj.exchanged,
                       block_lengths=sizes)
+        elif op == DaemonOp.EXPORT_TRACE:
+            # merge the daemon-side executors' trace buffers to a file the
+            # CLIENT named — the daemon owns the cluster, so the trace lives
+            # on its side of the control socket
+            count = mgr.cluster.export_trace(str(meta["path"]))
+            self._ack(conn, True, events=count)
+        elif op == DaemonOp.METRICS:
+            self._ack(conn, True, body=mgr.cluster.metrics_text().encode())
         elif op == int(AmId.FETCH_BLOCK_REQ):
             # data-plane fetch: batched AM form (binary batch header travels in
             # the body so the JSON control framing stays uniform)
@@ -369,6 +380,17 @@ class DaemonClient:
     def stats(self, shuffle_id: int) -> dict:
         meta, _ = self._call(DaemonOp.STATS, {"shuffle_id": shuffle_id})
         return meta
+
+    def export_trace(self, path: str) -> int:
+        """Ask the daemon to write its merged Perfetto trace to ``path``
+        (a path on the DAEMON's filesystem); returns the event count."""
+        meta, _ = self._call(DaemonOp.EXPORT_TRACE, {"path": path})
+        return int(meta.get("events", 0))
+
+    def metrics_text(self) -> str:
+        """The daemon cluster's Prometheus exposition."""
+        _, body = self._call(DaemonOp.METRICS, {})
+        return body.decode(errors="replace")
 
     def shutdown(self) -> None:
         try:

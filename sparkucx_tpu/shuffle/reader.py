@@ -37,7 +37,7 @@ from sparkucx_tpu.core.operation import (
 )
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.memory.pool import MemoryPool
-from sparkucx_tpu.utils.trace import instant, span
+from sparkucx_tpu.utils.trace import TRACER, instant
 
 
 @dataclass
@@ -277,10 +277,19 @@ class TpuShuffleReader:
             yield from self._fetch_windows_pipelined(windows)
             return
         for window in windows:
-            requests = self._issue_window(window)
-            self._await_window(requests, len(window))
-            yield from self._yield_window(requests)
+            # open the window span BEFORE issuing: with obs.traceContext on,
+            # the fetch request carries (trace_id, span_id) over the wire and
+            # every server's serve span — primary or replica — parents here
+            wctx = self._start_window_span(len(window))
+            try:
+                with TRACER.activate(wctx):
+                    requests = self._issue_window(window)
+                    self._await_window(requests, len(window))
+                yield from self._yield_window(requests, wctx)
+            finally:
+                self._end_window_span(wctx)
         self._sweep_abandoned()
+        self._flush_read_counters()
 
     def _fetch_windows_pipelined(self, windows) -> Iterator[BlockFetchResult]:
         from collections import deque
@@ -291,7 +300,7 @@ class TpuShuffleReader:
         costs = [
             sum(self.block_sizes(b.map_id, b.reduce_id) for b in w) for w in windows
         ]
-        issued: deque = deque()  # (window, requests, cost) awaiting completion
+        issued: deque = deque()  # (window, wctx, requests, cost) awaiting completion
         nxt = 0
         while nxt < len(windows) or issued:
             while nxt < len(windows):
@@ -300,19 +309,28 @@ class TpuShuffleReader:
                     gate.acquire(cost)  # head window always admits (oversized-alone)
                 elif not gate.try_acquire(cost):
                     break  # budget full: stop issuing ahead
-                issued.append((windows[nxt], self._issue_window(windows[nxt]), cost))
+                # per-window span opened at ISSUE time: windows overlap, so
+                # each carries its own explicit ctx rather than the thread
+                # stack (start_span/end_span straddle the pipeline)
+                wctx = self._start_window_span(len(windows[nxt]))
+                with TRACER.activate(wctx):
+                    reqs = self._issue_window(windows[nxt])
+                issued.append((windows[nxt], wctx, reqs, cost))
                 nxt += 1
-            window, requests, cost = issued.popleft()
+            window, wctx, requests, cost = issued.popleft()
             try:
-                self._await_window(requests, len(window))
-                yield from self._yield_window(requests)
+                with TRACER.activate(wctx):
+                    self._await_window(requests, len(window))
+                yield from self._yield_window(requests, wctx)
             finally:
+                self._end_window_span(wctx)
                 # credits return when the window is consumed (or the caller
                 # abandons the iterator / a fetch raises or times out) — the
                 # gate drains to zero either way, so one dead peer's windows
                 # can never wedge the pipeline's budget
                 gate.release(cost)
         self._sweep_abandoned()
+        self._flush_read_counters()
 
     def _issue_window(
         self, window: List[ShuffleBlockId]
@@ -336,6 +354,38 @@ class TpuShuffleReader:
             requests.extend((bid, buf, req) for (bid, buf), req in zip(items, reqs))
         return requests
 
+    def _start_window_span(self, num_blocks: int):
+        """Open the per-window ``read.window`` span (explicit start/end: the
+        pipelined path overlaps windows, so the span can't live on the
+        thread-local stack).  None when tracing is off."""
+        if not TRACER.active:
+            return None
+        with TRACER.executor_scope(self.executor_id):
+            return TRACER.start_span(
+                "read.window", shuffle_id=self.shuffle_id, blocks=num_blocks
+            )
+
+    def _end_window_span(self, wctx) -> None:
+        if wctx is not None:
+            with TRACER.executor_scope(self.executor_id):
+                TRACER.end_span(wctx)
+
+    def _flush_read_counters(self) -> None:
+        """Surface the reader's failover telemetry through the transport's
+        StatsAggregator, where the metrics registry's ``ops`` provider picks
+        it up (``sparkucx_tpu_ops_*_total{kind="read"}``)."""
+        agg = getattr(self.transport, "stats_agg", None)
+        if agg is None:
+            return
+        m = self.metrics
+        if m.failovers or m.blocks_retried or m.fetch_timeouts:
+            agg.record_counters(
+                "read",
+                failovers=m.failovers,
+                blocks_retried=m.blocks_retried,
+                fetch_timeouts=m.fetch_timeouts,
+            )
+
     def _await_window(self, requests, num_blocks: int) -> None:
         t0 = time.monotonic_ns()
         deadline_ns = self.fetch_deadline_ms * 1_000_000
@@ -343,22 +393,21 @@ class TpuShuffleReader:
         # (use_wakeup; GlobalWorkerRpcThread.scala:46-58) — a local fetch
         # completes on the first poll so the wait never fires there
         park = getattr(self.transport, "wait_for_activity", None)
-        with span("read.window", shuffle_id=self.shuffle_id, blocks=num_blocks):
-            while not all(req.completed() for _, _, req in requests):
-                if deadline_ns and time.monotonic_ns() - t0 > deadline_ns:
-                    # hung peer: stop spinning, let _yield_window fail the
-                    # incomplete fetches over to replicas — this bounds the
-                    # fetch_wait charge per window to the deadline
-                    self.metrics.fetch_timeouts += 1
-                    break
-                self.transport.progress()
-                if park is not None and not all(
-                    req.completed() for _, _, req in requests
-                ):
-                    park(0.002)
+        while not all(req.completed() for _, _, req in requests):
+            if deadline_ns and time.monotonic_ns() - t0 > deadline_ns:
+                # hung peer: stop spinning, let _yield_window fail the
+                # incomplete fetches over to replicas — this bounds the
+                # fetch_wait charge per window to the deadline
+                self.metrics.fetch_timeouts += 1
+                break
+            self.transport.progress()
+            if park is not None and not all(
+                req.completed() for _, _, req in requests
+            ):
+                park(0.002)
         self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
 
-    def _yield_window(self, requests) -> Iterator[BlockFetchResult]:
+    def _yield_window(self, requests, wctx=None) -> Iterator[BlockFetchResult]:
         prev: Optional[BlockFetchResult] = None
         try:
             self._sweep_abandoned()
@@ -369,11 +418,16 @@ class TpuShuffleReader:
                     # (closed by a later sweep once the request settles) and
                     # fail over with a fresh buffer
                     self._abandoned.append((buf, req))
-                    result, buf = self._retry_fetch(bid, None, None)
+                    with TRACER.activate(wctx):
+                        result, buf = self._retry_fetch(bid, None, None)
                 else:
                     result = req.wait(0)
                     if result.status != OperationStatus.SUCCESS:
-                        result, buf = self._retry_fetch(bid, buf, result)
+                        # replica failover under the window span: the replica
+                        # server's serve span parents here too, so the merged
+                        # trace shows primary AND replica children
+                        with TRACER.activate(wctx):
+                            result, buf = self._retry_fetch(bid, buf, result)
                 # Zero-copy hand-off: a read-only view of the recv bytes.
                 # The old `bytes(...)` here copied every fetched block a
                 # second time; now the copy happens only in detach(), and

@@ -48,6 +48,7 @@ import numpy as np
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.definitions import MapperInfo
 from sparkucx_tpu.core.operation import BlockNotFoundError, TransportError
+from sparkucx_tpu.utils.trace import span
 
 
 def default_peer_ranges(num_reducers: int, num_peers: int) -> List[Tuple[int, int]]:
@@ -982,7 +983,10 @@ class HbmBlockStore:
         gets the typed TenantQuotaExceededError and the round stays on disk,
         still serveable through the memmap.  The spill file is dropped once
         the RAM copy is installed (a later demotion recreates it)."""
-        with self._lock:
+        # span OUTSIDE the store lock: restage-on-fetch runs under a serve
+        # thread's remote trace context, so the restage shows up as a child
+        # of the reducer's window in the merged trace
+        with span("store.restage", shuffle_id=shuffle_id, round=round_idx), self._lock:
             st = self._shuffles.get(shuffle_id)
             if st is None or not (0 <= round_idx <= st.round):
                 return False
@@ -1030,8 +1034,12 @@ class HbmBlockStore:
             # shuffle this executor never created locally (failover serving).
             replica = self.replica_view(shuffle_id, map_id, reduce_id)
             if replica is not None:
-                arr, off, ln = replica
-                return arr[off : off + ln].tobytes()
+                with span(
+                    "store.read.replica",
+                    shuffle_id=shuffle_id, map_id=map_id, reduce_id=reduce_id,
+                ):
+                    arr, off, ln = replica
+                    return arr[off : off + ln].tobytes()
             if st is None:
                 raise TransportError(f"unknown shuffle {shuffle_id}")
             raise BlockNotFoundError(shuffle_id, map_id, reduce_id, "not staged")

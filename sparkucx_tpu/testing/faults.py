@@ -69,6 +69,21 @@ _armed: List[_Armed] = []  #: guarded by _lock
 #: total fires per point (telemetry for tests); guarded by _lock
 fired: Dict[str, int] = {}
 
+#: Fault observers, called ``(point, **ctx)`` AFTER an armed fault fires —
+#: the flight recorder (obs/recorder.py) subscribes so chaos events land in
+#: postmortem bundles.  Called outside _lock, before the fault's own action
+#: (which may raise); observer exceptions are swallowed: observability must
+#: never change what a chaos test injects.
+on_fault: List[Callable[..., None]] = []
+
+
+def _notify(point: str, ctx: Dict[str, Any]) -> None:
+    for cb in list(on_fault):
+        try:
+            cb(point, **ctx)
+        except Exception:
+            pass
+
 
 def arm(
     point: str,
@@ -139,6 +154,8 @@ def check(point: str, **ctx) -> None:
             entry.fired += 1
         if hits:
             fired[point] = fired.get(point, 0) + len(hits)
+    if hits:
+        _notify(point, ctx)
     for entry in hits:  # run actions outside the lock: they may sleep
         entry.action(point=point, **ctx)
 
@@ -154,6 +171,8 @@ def transform(point: str, data, **ctx):
             entry.fired += 1
         if hits:
             fired[point] = fired.get(point, 0) + len(hits)
+    if hits:
+        _notify(point, ctx)
     for entry in hits:
         data = entry.action(data, point=point, **ctx)
     return data
@@ -222,6 +241,13 @@ def kill_executor(transport) -> None:
     store and reports the death to cluster membership, so the collective
     plane observes the loss the same way the wire plane observes a RST.
     """
+    recorder = getattr(transport, "recorder", None)
+    if recorder is not None:
+        # full bundle BEFORE the kill: no subsystem lock is held here, and
+        # the dying executor's last metrics view is the interesting one
+        recorder.capture(
+            "chaos_kill", executor=getattr(transport, "executor_id", None)
+        )
     chaos_kill = getattr(transport, "chaos_kill", None)
     if chaos_kill is not None:
         chaos_kill()

@@ -22,6 +22,7 @@ contract (ShuffleTransport.scala:158-165).
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import socket
@@ -43,6 +44,8 @@ from sparkucx_tpu.core.definitions import (
     MAX_FRAME_BYTES,
     REPLICA_ENTRY_SIZE,
     REPLICA_HEADER_SIZE,
+    REPLICA_TRACE_EXT_SIZE,
+    TRACE_EXT_SIZE,
     AmId,
     MapperInfo,
     pack_chunk_codec_ext,
@@ -52,6 +55,8 @@ from sparkucx_tpu.core.definitions import (
     pack_member_event,
     pack_replica_ack,
     pack_replica_put,
+    pack_replica_trace_ext,
+    pack_trace_ext,
     pack_wire_hello,
     unpack_chunk_codec_ext,
     unpack_chunk_hdr,
@@ -59,6 +64,8 @@ from sparkucx_tpu.core.definitions import (
     unpack_member_event,
     unpack_replica_ack,
     unpack_replica_put,
+    unpack_replica_trace_ext,
+    unpack_trace_ext,
     unpack_wire_hello,
 )
 from sparkucx_tpu.core.operation import (
@@ -79,10 +86,21 @@ from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.ops.compress import CompressSpec, encode_chunk
 from sparkucx_tpu.store.hbm_store import HbmBlockStore
 from sparkucx_tpu.testing import faults
+from sparkucx_tpu.obs.metrics import (
+    MetricsRegistry,
+    close_http_server,
+    counter_dict_provider,
+    start_http_server,
+    stats_aggregator_provider,
+    tracer_provider,
+    wire_lane_provider,
+)
+from sparkucx_tpu.obs.recorder import FlightRecorder
 from sparkucx_tpu.utils.checksum import crc32c
 from sparkucx_tpu.utils.pagecodec import CODEC_RAW, CodecError, decode_page
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.stats import StatsAggregator
+from sparkucx_tpu.utils.trace import TRACER
 
 logger = get_logger("transport.peer")
 
@@ -204,7 +222,10 @@ def recv_frame(sock: socket.socket, peer: str = "") -> Optional[Tuple[AmId, byte
 
 
 def pack_batch_fetch_req(
-    tag: int, block_ids: Sequence[ShuffleBlockId], app_id: Optional[str] = None
+    tag: int,
+    block_ids: Sequence[ShuffleBlockId],
+    app_id: Optional[str] = None,
+    trace: Optional[Tuple[int, int]] = None,
 ) -> bytes:
     """Header: tag + count + (sid, mid, rid) triples — the batched variant of the
     reference's 12-byte fetch header (UcxWorkerWrapper.scala:96-126).
@@ -212,15 +233,47 @@ def pack_batch_fetch_req(
     With ``app_id`` (tenants.enabled) the requesting tenant rides as a
     self-describing extension after the triples (``_APP`` length + utf-8
     bytes); the triples then carry TENANT-LOCAL shuffle ids, which the server
-    translates through its registry.  ``app_id=None`` emits the historical
-    bytes exactly."""
+    translates through its registry.  With ``trace`` (obs.traceContext) the
+    issuing span's (trace_id, span_id) rides as a magic-prefixed 20-byte
+    trailer AFTER the app extension (core/definitions.py ``_TRACE_EXT``).
+    Both None (the default) emits the historical bytes exactly."""
     out = bytearray(_TAG.pack(tag) + _COUNT.pack(len(block_ids)))
     for b in block_ids:
         out += _TRIPLE.pack(b.shuffle_id, b.map_id, b.reduce_id)
     if app_id:
         raw = app_id.encode("utf-8")
         out += _APP.pack(len(raw)) + raw
+    if trace is not None:
+        out += pack_trace_ext(trace[0], trace[1])
     return bytes(out)
+
+
+def split_fetch_req_trace(header: bytes) -> Tuple[Optional[Tuple[int, int]], bytes]:
+    """Split a FETCH_BLOCK_REQ header into ``(trace_ctx, header-without-ext)``.
+
+    The trace ext is the LAST 20 bytes when present.  Beyond the magic check,
+    the remaining length must be structurally consistent — either the ext
+    directly follows the triples, or an app extension accounts for EXACTLY
+    the bytes in between — so an app_id whose utf-8 tail happens to contain
+    the magic bytes can never be mis-split."""
+    base = _TAG.size + _COUNT.size
+    if len(header) < base + TRACE_EXT_SIZE:
+        return None, header
+    ctx = unpack_trace_ext(header)
+    if ctx is None:
+        return None, header
+    (count,) = _COUNT.unpack_from(header, _TAG.size)
+    pos = base + count * _TRIPLE.size
+    rem = len(header) - pos
+    if rem < TRACE_EXT_SIZE:
+        return None, header
+    if rem != TRACE_EXT_SIZE:
+        if rem < _APP.size + TRACE_EXT_SIZE:
+            return None, header
+        (n,) = _APP.unpack_from(header, pos)
+        if _APP.size + n + TRACE_EXT_SIZE != rem:
+            return None, header
+    return ctx, header[:-TRACE_EXT_SIZE]
 
 
 def unpack_batch_fetch_req(header: bytes) -> Tuple[int, List[ShuffleBlockId]]:
@@ -392,10 +445,17 @@ class BlockServer:
         port: int = 0,
         member_sink: Optional[Callable[[int, int, int, int], None]] = None,
         tenants=None,
+        executor_id: Optional[int] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ) -> None:
         self.conf = conf or TpuShuffleConf()
         self.store = store
         self.registry_lookup = registry_lookup
+        #: obs plane: which executor this server serves for (trace-event
+        #: attribution in the shared-process loopback mesh) and the metrics
+        #: registry METRICS_PULL answers from (None = empty exposition)
+        self.executor_id = executor_id
+        self.metrics = metrics
         #: TenantRegistry of the owning process (service/tenants.py), or None
         #: for the historical single-tenant server.  With a registry, FETCH
         #: requests carrying the tenant extension get their shuffle ids
@@ -771,6 +831,22 @@ class BlockServer:
                 pass
 
     def _serve_fetch_req(self, conn: socket.socket, state: _ConnState, header: bytes) -> None:
+        # obs plane: a trailing trace ext re-parents this serve under the
+        # requesting reducer's fetch span (merged-trace view); stripped before
+        # any of the historical parsing below sees the header
+        trace_ctx, header = split_fetch_req_trace(header)
+        if trace_ctx is not None and TRACER.active:
+            (count,) = _COUNT.unpack_from(header, _TAG.size)
+            with TRACER.executor_scope(self.executor_id):
+                with TRACER.activate(TRACER.remote_context(*trace_ctx)):
+                    with TRACER.span("server.serve", blocks=count):
+                        self._serve_fetch_req_inner(conn, state, header)
+            return
+        self._serve_fetch_req_inner(conn, state, header)
+
+    def _serve_fetch_req_inner(
+        self, conn: socket.socket, state: _ConnState, header: bytes
+    ) -> None:
         tag, bids = unpack_batch_fetch_req(header)
         app_id = unpack_fetch_req_app_id(header, len(bids))
         gate = None
@@ -874,8 +950,17 @@ class BlockServer:
             # header extensions after the entry table, detected by the
             # residue mod entry size: 0 plain, 4 crc, 8 codec, 12
             # codec+crc (core/definitions.py).  The crc trailer is
-            # always LAST and covers the WIRE (possibly encoded) body.
+            # always LAST and covers the WIRE (possibly encoded) body —
+            # except for the obs trace ext, which (when present) trails
+            # even the crc and shifts every residue by 2: strip it first,
+            # then the historical dispatch below runs unchanged.
+            trace_ctx = None
             residue = (len(header) - REPLICA_HEADER_SIZE) % REPLICA_ENTRY_SIZE
+            if residue % 4 == 2:
+                trace_ctx = unpack_replica_trace_ext(header)
+                if trace_ctx is not None:
+                    header = header[:-REPLICA_TRACE_EXT_SIZE]
+                    residue = (len(header) - REPLICA_HEADER_SIZE) % REPLICA_ENTRY_SIZE
             if residue in (4, 12):
                 # wire.checksum trailer: verify before installing; a
                 # corrupt replica gets NO ack, so the pusher's
@@ -918,7 +1003,19 @@ class BlockServer:
                 "replica.apply", shuffle_id=sid, src_executor=src, round_idx=rnd
             )
             if self.store is not None:
-                self.store.put_replica(sid, src, rnd, entries, body)
+                if trace_ctx is not None and TRACER.active:
+                    # parent the apply under the pusher's replica.push span
+                    with TRACER.executor_scope(self.executor_id):
+                        with TRACER.activate(TRACER.remote_context(*trace_ctx)):
+                            with TRACER.span(
+                                "server.replica_apply",
+                                shuffle_id=sid,
+                                src_executor=src,
+                                round=rnd,
+                            ):
+                                self.store.put_replica(sid, src, rnd, entries, body)
+                else:
+                    self.store.put_replica(sid, src, rnd, entries, body)
             with send_lock:
                 conn.sendall(
                     pack_frame(AmId.REPLICA_ACK, pack_replica_ack(sid, src, rnd))
@@ -927,6 +1024,30 @@ class BlockServer:
             epoch, subject, observer = unpack_member_event(header)
             if self.member_sink is not None:
                 self.member_sink(int(am_id), epoch, subject, observer)
+        elif am_id == AmId.TRACE_PULL:
+            # obs plane: hand the puller this executor's slice of the trace
+            # ring (the loopback mesh shares one process-wide TRACER, so
+            # events are attributed by their executor scope; merge_events
+            # dedups overlap by uid).  Runs on a serving worker thread —
+            # never the reactor loop lane (reactor-discipline).
+            (tag,) = _TAG.unpack_from(header)
+            events = TRACER.events
+            if self.executor_id is not None:
+                events = [e for e in events if e.get("eid") == self.executor_id]
+            payload = json.dumps(
+                {
+                    "executor": self.executor_id,
+                    "events": events,
+                    "dropped": TRACER.dropped,
+                }
+            ).encode()
+            with send_lock:
+                conn.sendall(pack_frame(AmId.TRACE_PULL, _TAG.pack(tag), payload))
+        elif am_id == AmId.METRICS_PULL:
+            (tag,) = _TAG.unpack_from(header)
+            text = self.metrics.prometheus_text() if self.metrics is not None else ""
+            with send_lock:
+                conn.sendall(pack_frame(AmId.METRICS_PULL, _TAG.pack(tag), text.encode()))
         elif am_id == AmId.INIT_EXECUTOR_REQ:
             (eid,) = _TAG.unpack_from(header)
             self.handshaken[eid] = body
@@ -1447,6 +1568,26 @@ class PeerTransport(ShuffleTransport):
         #: registry).  None (the default) emits the historical frames.
         self.app_id: Optional[str] = None
         self.stats_agg = StatsAggregator() if self.conf.collect_stats else None
+        #: obs plane: this executor's unified metrics surface.  Subsystem
+        #: providers are registered below; stores/services owned elsewhere
+        #: (eviction manager, tenant registry, the cluster's elastic stats)
+        #: register theirs through the same object.  METRICS_PULL serves it.
+        self.metrics = MetricsRegistry(executor_id=executor_id)
+        #: obs plane: TRACE_PULL/METRICS_PULL replies waiting on their tag
+        self._pull_pending: Dict[int, dict] = {}  #: guarded by self._tag_lock
+        self._metrics_http = None
+        #: always-on flight recorder: ring stays warm, TransportError /
+        #: elastic-recovery / chaos triggers capture postmortem bundles
+        self.recorder = FlightRecorder(
+            TRACER,
+            executor_id=executor_id,
+            postmortem_dir=self.conf.obs_postmortem_dir or None,
+            ring_capacity=self.conf.obs_ring_capacity,
+        )
+        self.recorder.attach_registry(self.metrics)
+        self.recorder.attach_membership(self._membership_snapshot)
+        self.recorder.install()
+        self._register_metrics_providers()
         #: Wakeup doorbell (conf.use_wakeup): recv threads set it when an ack
         #: parks, so fetch loops can sleep in wait_for_activity() instead of
         #: busy-spinning progress() against the receiver's GIL slices.
@@ -1576,6 +1717,112 @@ class PeerTransport(ShuffleTransport):
             return {"raw_bytes": 0, "wire_bytes": 0, "encoded_chunks": 0, "raw_chunks": 0}
         return self.server.compress_snapshot()
 
+    # -- obs plane ---------------------------------------------------------
+
+    def _replica_stats_snapshot(self) -> Dict[str, int]:
+        with self._tag_lock:
+            return dict(self.replica_stats)
+
+    def _membership_snapshot(self) -> Optional[dict]:
+        """Flight-recorder leg: the executor's membership view, or None when
+        membership-unaware (elastic off)."""
+        m = self.membership
+        if m is None:
+            return None
+        try:
+            return m.snapshot()  # {"epoch", "alive", "dead"}
+        except Exception:
+            return None
+
+    def _register_metrics_providers(self) -> None:
+        """Wire this transport's scattered telemetry surfaces into the one
+        registry: op summaries, per-lane wire counters, replication and
+        store replica-tier accounting, serve-side compression, and the trace
+        ring's own health.  Cluster-owned surfaces (elastic, eviction,
+        tenants) register from their owners (transport/tpu.py)."""
+        if self.stats_agg is not None:
+            self.metrics.register("ops", stats_aggregator_provider(self.stats_agg))
+        self.metrics.register("wire", wire_lane_provider(self.wire_lane_stats))
+        self.metrics.register(
+            "replica", counter_dict_provider("replica", self._replica_stats_snapshot)
+        )
+        self.metrics.register(
+            "replica_tier", counter_dict_provider("replica", self.store.replica_stats)
+        )
+        self.metrics.register("compress", counter_dict_provider("compress", self.compress_stats))
+        # dynamic closures: membership and the eviction manager attach AFTER
+        # construction (elastic wiring, service plane) — resolve at scrape time
+        self.metrics.register(
+            "elastic", counter_dict_provider("elastic", self._elastic_view)
+        )
+        self.metrics.register(
+            "eviction", counter_dict_provider("eviction", self._eviction_view)
+        )
+        self.metrics.register(
+            "reactor", counter_dict_provider("reactor", self._reactor_view)
+        )
+        self.metrics.register("obs", tracer_provider(TRACER))
+
+    def _elastic_view(self) -> Dict[str, int]:
+        m = self.membership
+        if m is None:
+            return {}
+        snap = m.snapshot()
+        return {
+            "epoch": snap["epoch"],
+            "alive": len(snap["alive"]),
+            "dead": len(snap["dead"]),
+        }
+
+    def _eviction_view(self) -> Dict[str, int]:
+        ev = getattr(self.store, "eviction", None)
+        return ev.eviction_stats() if ev is not None else {}
+
+    def _reactor_view(self) -> Dict[str, int]:
+        srv = self.server
+        reactor = getattr(srv, "_reactor", None) if srv is not None else None
+        return reactor.stats() if reactor is not None else {}
+
+    def _pull(self, executor_id: ExecutorId, am_id: AmId, timeout: float = 5.0) -> bytes:
+        """Blocking pull RPC on the peer plane (TRACE_PULL / METRICS_PULL):
+        send the tagged request, pump progress() until the tagged reply parks
+        and drains — the same explicit-poll contract every fetch follows."""
+        with self._tag_lock:
+            tag = self._next_tag
+            self._next_tag += 1
+            pending = self._pull_pending[tag] = {"done": threading.Event(), "body": b""}
+        try:
+            conn = self._connection(executor_id)
+            conn.send(pack_frame(am_id, _TAG.pack(tag)))
+            deadline = time.monotonic() + timeout
+            while not pending["done"].is_set():
+                if time.monotonic() > deadline:
+                    raise TransportError(
+                        f"{am_id.name} from executor {executor_id} timed out "
+                        f"after {timeout:.1f}s"
+                    )
+                self.progress()
+                self.wait_for_activity(0.005)
+            return pending["body"]
+        finally:
+            with self._tag_lock:
+                self._pull_pending.pop(tag, None)
+
+    def pull_trace(self, executor_id: ExecutorId, timeout: float = 5.0) -> dict:
+        """Fetch a peer executor's trace buffer: ``{"executor", "events",
+        "dropped"}`` (TpuShuffleCluster.export_trace merges these)."""
+        body = self._pull(executor_id, AmId.TRACE_PULL, timeout=timeout)
+        try:
+            return json.loads(body.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise TransportError(f"malformed TRACE_PULL reply from executor {executor_id}: {e}")
+
+    def pull_metrics(self, executor_id: ExecutorId, timeout: float = 5.0) -> str:
+        """Fetch a peer executor's Prometheus text exposition."""
+        return self._pull(executor_id, AmId.METRICS_PULL, timeout=timeout).decode(
+            errors="replace"
+        )
+
     def wait_for_activity(self, timeout: float = 0.01) -> None:
         """Park until a recv thread posts an ack (or timeout) — the wakeup-mode
         progress contract (GlobalWorkerRpcThread.scala:46-58).  No-op when
@@ -1593,10 +1840,24 @@ class PeerTransport(ShuffleTransport):
             self.conf, store=self.store, registry_lookup=self.registered_block,
             host=host, port=port, member_sink=self._on_member_event,
             tenants=getattr(self.store, "tenants", None),
+            executor_id=self.executor_id, metrics=self.metrics,
         )
+        if self.conf.obs_metrics_port > 0:
+            try:
+                self._metrics_http = start_http_server(
+                    self.metrics, self.conf.obs_metrics_port
+                )
+            except OSError:
+                # loopback clusters build one transport per virtual executor
+                # on one host: first bind wins the scrape port, the rest skip
+                self._metrics_http = None
         return self.server.address_bytes()
 
     def close(self) -> None:
+        self.recorder.close()  # unhook TransportError capture before teardown
+        if self._metrics_http is not None:
+            close_http_server(self._metrics_http)
+            self._metrics_http = None
         with self._tag_lock:
             self._replica_run = False
             self._replica_queue.clear()
@@ -1874,6 +2135,11 @@ class PeerTransport(ShuffleTransport):
                         # group's lanes: start the receive accounting now,
                         # before any chunk can race the request send
                         self._stripe_rx[tag] = _StripeRx()
+            trace = None
+            if self.conf.obs_trace_context and TRACER.active:
+                ctx = TRACER.current_context()
+                if ctx is not None:
+                    trace = (ctx.trace_id, ctx.span_id)
             conn.send(
                 pack_frame(
                     AmId.FETCH_BLOCK_REQ,
@@ -1881,6 +2147,7 @@ class PeerTransport(ShuffleTransport):
                         tag,
                         bids,
                         app_id=self.app_id if self.conf.tenants_enabled else None,
+                        trace=trace,
                     ),
                 )
             )
@@ -2017,6 +2284,18 @@ class PeerTransport(ShuffleTransport):
                 # from_executor (when the draining path knows the conn's peer)
                 # attributes the ack to its successor for replication_wait
                 self._replica_acked(sid, executor_id=from_executor)
+            return
+        if am_id in (AmId.TRACE_PULL, AmId.METRICS_PULL):
+            # pull-RPC reply (obs plane): tag echo in the header, JSON event
+            # buffer / Prometheus text in the body
+            if len(header) < _TAG.size:
+                return
+            (tag,) = _TAG.unpack_from(header, 0)
+            with self._tag_lock:
+                pending = self._pull_pending.get(tag)
+            if pending is not None:
+                pending["body"] = bytes(body)
+                pending["done"].set()
             return
         if am_id != AmId.FETCH_BLOCK_REQ_ACK:
             return
@@ -2258,6 +2537,7 @@ class PeerTransport(ShuffleTransport):
                 self.replica_stats["replica_backlog_bytes"] += round_bytes * len(neighbors)
             checksum = self.conf.wire_checksum
             cspec = CompressSpec.from_conf(self.conf)
+            trace_on = self.conf.obs_trace_context and TRACER.active
             for eid in neighbors:
                 for rnd, entries, body in rounds:
                     header = pack_replica_put(shuffle_id, self.executor_id, rnd, entries)
@@ -2275,6 +2555,20 @@ class PeerTransport(ShuffleTransport):
                         # header length (knob off = golden replica frames);
                         # the crc covers the WIRE (possibly encoded) body
                         header += _CRC.pack(crc32c(wire_body))
+                    span_ctx = None
+                    if trace_on:
+                        # trace ext rides LAST (after crc): the receiver
+                        # strips it before the crc/codec residue dispatch
+                        with TRACER.executor_scope(self.executor_id):
+                            span_ctx = TRACER.start_span(
+                                "replica.push",
+                                shuffle_id=shuffle_id,
+                                round=rnd,
+                                dst=eid,
+                            )
+                        header += pack_replica_trace_ext(
+                            span_ctx.trace_id, span_ctx.span_id
+                        )
                     frame = pack_frame(AmId.REPLICA_PUT, header, wire_body)
                     try:
                         self._connection(eid).send(frame)
@@ -2288,6 +2582,9 @@ class PeerTransport(ShuffleTransport):
                         )
                         self._replica_acked(shuffle_id, failed=True, executor_id=eid)
                     finally:
+                        if span_ctx is not None:
+                            with TRACER.executor_scope(self.executor_id):
+                                TRACER.end_span(span_ctx)
                         with self._tag_lock:
                             self.replica_stats["replica_backlog_bytes"] = max(
                                 0, self.replica_stats["replica_backlog_bytes"] - len(body)

@@ -51,6 +51,7 @@ from sparkucx_tpu.transport.peer import PeerTransport
 from sparkucx_tpu.transport.pipeline import RoundPipeline
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.stats import StatsAggregator
+from sparkucx_tpu.utils.trace import TRACER, merge_events
 
 logger = get_logger("transport.spmd")
 
@@ -153,6 +154,47 @@ class SpmdShuffleExecutor:
 
     def close(self) -> None:
         self.peer.close()
+
+    # -- obs plane ---------------------------------------------------------
+
+    def export_trace(self, path: str) -> int:
+        """Merge the whole mesh's trace buffers into ONE Perfetto file with
+        pid = executor id: every peer's ring is pulled over the TRACE_PULL
+        Active Message, the local ring read directly.  Unreachable peers are
+        skipped — a postmortem export must work on a degraded mesh."""
+        buffers = [
+            [dict(e, eid=e.get("eid", self.executor_id)) for e in TRACER.events]
+        ]
+        for eid in range(self.num_executors):
+            if eid == self.executor_id:
+                continue
+            try:
+                buf = self.peer.pull_trace(eid)
+                buffers.append(
+                    [dict(e, eid=e.get("eid", eid)) for e in buf.get("events", [])]
+                )
+            except (TransportError, OSError):
+                continue
+        merged = merge_events(buffers)
+        import json as _json
+
+        with open(path, "w") as f:
+            _json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+        return len(merged)
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition for the whole mesh: the local registry's
+        text plus every reachable peer's METRICS_PULL reply, concatenated
+        (rows stay distinct — each executor labels its own samples)."""
+        parts = [self.peer.metrics.prometheus_text()]
+        for eid in range(self.num_executors):
+            if eid == self.executor_id:
+                continue
+            try:
+                parts.append(self.peer.pull_metrics(eid))
+            except (TransportError, OSError):
+                continue
+        return "".join(parts)
 
     # -- shuffle lifecycle -------------------------------------------------
 

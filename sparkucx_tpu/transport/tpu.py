@@ -76,8 +76,15 @@ from sparkucx_tpu.shuffle.resolver import degraded_plan, ring_neighbors
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
 from sparkucx_tpu.testing import faults
 from sparkucx_tpu.transport.pipeline import RoundPipeline
+from sparkucx_tpu.obs.metrics import (
+    MetricsRegistry,
+    counter_dict_provider,
+    stats_aggregator_provider,
+    tracer_provider,
+)
+from sparkucx_tpu.obs.recorder import FlightRecorder
 from sparkucx_tpu.utils.stats import StatsAggregator
-from sparkucx_tpu.utils.trace import instant, span
+from sparkucx_tpu.utils.trace import TRACER, instant, merge_events, span
 
 
 @dataclass
@@ -172,6 +179,27 @@ class TpuShuffleCluster:
             "last_epoch": 0,
             "degraded_mesh": None,
         }  #: guarded by self._lock
+        #: Obs plane (PR 14): cluster-level registry + flight recorder.  The
+        #: registry absorbs the collective plane's surfaces (exchange timings,
+        #: elastic recovery counters, the trace ring's health); per-executor
+        #: wire surfaces live in each PeerTransport's own registry.  The
+        #: recorder does NOT install the global TransportError hook — clusters
+        #: have no close() to unhook from, and PeerTransports already cover
+        #: the wire error path — it captures on the cluster's own fault paths
+        #: (elastic recovery, chaos kills) explicitly.
+        self.metrics = MetricsRegistry()
+        self.metrics.register("ops", stats_aggregator_provider(self.stats))
+        self.metrics.register(
+            "elastic", counter_dict_provider("elastic", self._elastic_snapshot)
+        )
+        self.metrics.register("obs", tracer_provider(TRACER))
+        self.recorder = FlightRecorder(
+            TRACER,
+            postmortem_dir=self.conf.obs_postmortem_dir or None,
+            ring_capacity=self.conf.obs_ring_capacity,
+        )
+        self.recorder.attach_registry(self.metrics)
+        self.recorder.attach_membership(self.membership.snapshot)
 
     # -- membership / lookup ----------------------------------------------
 
@@ -184,6 +212,39 @@ class TpuShuffleCluster:
         if m is None:
             raise TransportError(f"unknown shuffle {shuffle_id}")
         return m
+
+    # -- obs plane ---------------------------------------------------------
+
+    def _elastic_snapshot(self) -> Dict[str, float]:
+        """Numeric view of the elastic telemetry for the metrics registry
+        (the degraded-mesh tuple is for tests, not exposition)."""
+        with self._lock:
+            s = {k: v for k, v in self.elastic_stats.items() if isinstance(v, (int, float))}
+        s["epoch"] = self.membership.epoch
+        s["alive"] = len(self.membership.alive())
+        s["dead"] = len(self.membership.dead())
+        return s
+
+    def export_trace(self, path: str, extra_buffers: Optional[List[List[dict]]] = None) -> int:
+        """Merge every executor's trace events into ONE Perfetto file with
+        pid = executor id; returns the event count.  Single-controller
+        executors share the process-wide TRACER (tracks split by the
+        ``executor_scope`` eid tag); multi-process meshes gather peer buffers
+        over TRACE_PULL (``PeerTransport.pull_trace``) and pass the ``events``
+        lists in via ``extra_buffers``."""
+        import json as _json
+
+        buffers = [TRACER.events]
+        buffers.extend(extra_buffers or [])
+        merged = merge_events(buffers)
+        with open(path, "w") as f:
+            _json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+        return len(merged)
+
+    def metrics_text(self) -> str:
+        """The cluster registry's Prometheus exposition (collective-plane
+        surfaces; per-executor wire surfaces are each peer's METRICS_PULL)."""
+        return self.metrics.prometheus_text()
 
     # -- shuffle lifecycle -------------------------------------------------
 
@@ -951,6 +1012,15 @@ class TpuShuffleCluster:
             shuffle_id=shuffle_id, epoch=epoch, mesh=m, waves=waves,
             recovery_ms=round(recovery_ms, 3),
         )
+        # full postmortem bundle (metrics + membership): safe here — the
+        # recovery is done and no subsystem lock is held on this thread
+        self.recorder.capture(
+            "elastic_recovery",
+            shuffle_id=shuffle_id,
+            epoch=epoch,
+            mesh=m,
+            recovery_ms=round(recovery_ms, 3),
+        )
 
     def _degraded_exchange_fn(self, m: int, phys, sub_rows: int, epoch: int):
         """Compile (or reuse) the shrunk-mesh exchange for a degraded epoch.
@@ -1243,6 +1313,12 @@ class TpuShuffleTransport(ShuffleTransport):
                     req.cancel()
             self._outstanding.clear()
         self.store.close()
+
+    @property
+    def recorder(self) -> FlightRecorder:
+        """The cluster's flight recorder — exposed per-facet so the chaos
+        harness (testing.faults.kill_executor) finds it on any transport."""
+        return self.cluster.recorder
 
     def chaos_kill(self) -> None:
         """Chaos-harness death hook (testing.faults.kill_executor): close the

@@ -190,9 +190,11 @@ class TestWireFrames:
         # 0-4: Definitions.scala:22-29 verbatim.  5-6: striped-wire extensions
         # (FetchBlockChunk / WireHello, docs/SHIM_PROTOCOL.md), 7-8:
         # replication extensions (ReplicaPut / ReplicaAck), 9-10: membership
-        # gossip (MemberSuspect / MemberRejoin) — peer plane only, never
-        # emitted at wire.streams=1 / replication.factor=0 / elastic off, so
-        # reference parity holds for every frame a stock deployment sees.
+        # gossip (MemberSuspect / MemberRejoin), 11-12: observability pulls
+        # (TracePull / MetricsPull) — peer plane only, never emitted at
+        # wire.streams=1 / replication.factor=0 / elastic off with no
+        # export/scrape call, so reference parity holds for every frame a
+        # stock deployment sees.
         #
         # The pin list is generated from the SOURCE of core/definitions.py by
         # the analyzer's wire-schema extractor, then cross-checked against the
@@ -205,10 +207,11 @@ class TestWireFrames:
 
         extracted = extract_am_ids(inspect.getsource(definitions))
         assert extracted == {a.name: int(a) for a in AmId}
-        assert sorted(extracted.values()) == list(range(11))
+        assert sorted(extracted.values()) == list(range(13))
         assert AmId.FETCH_BLOCK_CHUNK == 5 and AmId.WIRE_HELLO == 6
         assert AmId.REPLICA_PUT == 7 and AmId.REPLICA_ACK == 8
         assert AmId.MEMBER_SUSPECT == 9 and AmId.MEMBER_REJOIN == 10
+        assert AmId.TRACE_PULL == 11 and AmId.METRICS_PULL == 12
 
 
 class TestConf:
