@@ -25,7 +25,11 @@ step (ops/sort.py), ``wire`` — the striped loopback peer wire (streams=1 vs 4,
 perf/benchmark.py measure_wire; TPU-free, measured after the TCP baseline),
 ``failover`` — executor-loss robustness (perf/benchmark.py measure_failover;
 TPU-free): steady loopback fetch GB/s vs GB/s with the primary executor killed
-at t=50%, plus recovery time and p99 frame stall, ``tenants`` — the
+at t=50%, plus recovery time and p99 frame stall, ``gray`` — gray-failure
+robustness (perf/benchmark.py measure_gray; TPU-free): the primary executor is
+throttled to ~10% instead of killed, reporting fetch GB/s and p99 frame stall
+with hedged fetches off vs on plus hedge-win counts and an off-the-clock
+bit-equality check, ``tenants`` — the
 multi-tenant serving plane (perf/benchmark.py measure_tenants; TPU-free): 8
 concurrent apps fetching through the shared-selector reactor, reporting
 aggregate GB/s, the min/max per-app fairness ratio, and p99 per-block fetch
@@ -341,6 +345,28 @@ def main():
         }
     except Exception as e:
         RESULT["failover_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # 1c2. Gray-failure sub-metric — also TPU-free (the failover cluster
+    # shape, but the primary is throttled to ~10% of the healthy rate
+    # instead of killed): GB/s and p99 frame stall with hedging off vs on
+    # (fetch.hedgeMs), hedge win counts, bit-equality asserted outside the
+    # clock (perf/benchmark.py measure_gray).
+    try:
+        from sparkucx_tpu.perf.benchmark import measure_gray
+
+        gr = measure_gray(num_blocks=8, block_bytes=8 << 20, iterations=3)
+        RESULT["gray"] = {
+            "healthy_gbps": round(gr["healthy_gbps"], 3),
+            "degraded_gbps": round(gr["degraded_gbps"], 3),
+            "hedged_gbps": round(gr["hedged_gbps"], 3),
+            "degraded_p99_ms": round(gr["degraded_p99_ms"], 2),
+            "hedged_p99_ms": round(gr["hedged_p99_ms"], 2),
+            "hedge_wins": gr["hedge_wins"],
+            "fetch_timeouts": gr["fetch_timeouts"],
+            "bit_identical": gr["bit_identical"],
+        }
+    except Exception as e:
+        RESULT["gray_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # 1d. Multi-tenant serving-plane sub-metric — also TPU-free (one
     # tenants-enabled loopback server on the shared-selector reactor plane,

@@ -72,7 +72,9 @@ from __future__ import annotations
 #:   cache (``._conns``/``._zombies``) out from under the transport is the
 #:   fault being injected, not an API to encourage.  Test-only module (no
 #:   production import path reaches it with nothing armed); reviewed with the
-#:   robustness PR.
+#:   robustness PR.  ``._chaos_killed`` is the harness's own idempotency tag
+#:   stamped onto the victim (second kill = no-op) — chaos bookkeeping, not
+#:   transport state, so it stays the harness's private mark.
 #:
 #: cache-hygiene:
 #: - hbm_store.py ``out_rows``: the scatter output shape IS the staging
@@ -83,6 +85,7 @@ from __future__ import annotations
 ALLOWLIST = {
     ("testing/faults.py", "private-access", "._conns"),
     ("testing/faults.py", "private-access", "._zombies"),
+    ("testing/faults.py", "private-access", "._chaos_killed"),
     ("store/hbm_store.py", "private-access", "._lock"),
     ("store/hbm_store.py", "private-access", "._rollover"),  # also ._rollover_device
     ("store/hbm_store.py", "private-access", "._charge_tenant"),
@@ -315,6 +318,13 @@ OFF_PATH_DEFAULTS = {
     "slot_quota_rows": 0,
     "host_recv_mode": "array",
     "sanitize": False,
+    "fetch_hedge_ms": 0,
+    "fetch_hedge_max_ms": 0,
+    "breaker_failure_threshold": 0,
+    "breaker_cooldown_ms": 1000,
+    "store_soft_watermark": 0,
+    "store_hard_watermark": 0,
+    "server_accept_backlog": 0,
     "obs_trace_context": False,
     "obs_metrics_port": 0,
     "obs_ring_capacity": 8192,

@@ -184,6 +184,31 @@ class TpuShuffleConf:
     #: unsealed policy; counted in replica_stats["dropped_rounds"]) so memory
     #: stays bounded.  0 = unbounded (the historical behavior).
     replication_max_backlog_bytes: int = 0
+    #: Hedged-fetch delay floor (ms): once a fetch window has stragglers
+    #: outstanding past a hedge delay, the reader issues a duplicate request
+    #: for each straggling block to a replica holder; the first completion
+    #: wins bit-identically and the loser's buffer is quarantined.  The actual
+    #: delay is derived from the wire's observed rx stall p99
+    #: (``wire_lane_stats``) clamped to [fetch_hedge_ms, fetch_hedge_max_ms].
+    #: 0 (default) disables hedging entirely — no duplicate requests, reader
+    #: behavior byte-identical to the un-hedged path.
+    fetch_hedge_ms: int = 0
+    #: Hedge delay ceiling (ms): bounds how long the p99-derived hedge delay
+    #: can grow on a wire whose tail is already bad.  0 = unbounded ceiling
+    #: (the floor alone governs).  Ignored while fetch_hedge_ms is 0.
+    fetch_hedge_max_ms: int = 0
+    #: Per-peer circuit breaker: consecutive fetch failures/timeouts that trip
+    #: an executor's breaker from closed to open.  While open, new fetches
+    #: route straight to the replica ring without burning the full deadline
+    #: on the sick primary; after ``breaker_cooldown_ms`` the breaker goes
+    #: half-open and admits ONE probe — success closes it, failure re-opens.
+    #: 0 (default) disables breakers — health EWMAs are still tracked (pure
+    #: local accounting, no wire impact) but routing never changes.
+    breaker_failure_threshold: int = 0
+    #: Cooldown (ms) an open breaker waits before going half-open and
+    #: admitting a probe request to the sick executor.  Only meaningful when
+    #: ``breaker_failure_threshold`` > 0.
+    breaker_cooldown_ms: int = 1000
 
     # staged store (HBM; NVKV analogue).  512 = one exchange row (128 int32
     # lanes, the native XLA:TPU tile width) and exactly NVKV's sector alignment
@@ -214,6 +239,21 @@ class TpuShuffleConf:
     #: UcxShuffleReader.scala:137-199): crossing it spills sorted runs to
     #: ``spill_dir`` and the reader k-way-merges them back.
     reduce_memory_budget: int = 64 << 20
+    #: Soft memory-pressure watermark (bytes) on the store's resident staged
+    #: footprint (live regions + RAM-tier sealed rounds + replica bytes;
+    #: disk-tier memmap rounds cost nothing): crossing it triggers ONE
+    #: out-of-band EvictionManager sweep (``run_epoch(max_demotions=1)`` —
+    #: demote one tier, smallest-footprint-first per arXiv:2112.01075) on a
+    #: background thread, off the allocating caller's path.  0 (default) =
+    #: no soft watermark, store behavior byte-identical.
+    store_soft_watermark: int = 0
+    #: Hard memory-pressure watermark (bytes): an allocation-bearing write or
+    #: serve (region charge, replica install, restage) that would push the
+    #: resident staged footprint past this bound fails BEFORE any mutation
+    #: with a typed retryable ResourceExhaustedError, carried on the wire as
+    #: the dedicated SIZE_RESOURCE_EXHAUSTED code — clients back off and
+    #: retry instead of the store OOMing.  0 (default) = no hard watermark.
+    store_hard_watermark: int = 0
 
     # multi-tenant shuffle service (service/ — ROADMAP item 4)
     #: Multi-tenant mode: shuffles are keyed ``(app_id, shuffle_id)`` through a
@@ -242,6 +282,15 @@ class TpuShuffleConf:
     #: the historical thread-per-connection serving plane (tenants.enabled
     #: implies a reactor with a default-sized pool when left at 0).
     server_workers: int = 0
+    #: Bounded accept backlog for the reactor serving plane: when the reactor
+    #: already holds this many resident connections, a new accept is SHED —
+    #: the server sends one best-effort SERVER_BUSY frame (AM id 13) and
+    #: closes, instead of queuing work unboundedly.  Clients treat the busy
+    #: reply as a retryable ResourceExhaustedError (back off, retry/fail
+    #: over).  0 (default) = unbounded accepts, the historical behavior.
+    #: Only applies when the reactor serving plane is active (server_workers
+    #: > 0 or tenants_enabled).
+    server_accept_backlog: int = 0
 
     # TPU mesh (L2)
     mesh_axis_name: str = "ex"
@@ -408,6 +457,13 @@ class TpuShuffleConf:
             ("replication.maxBacklogBytes", "replication_max_backlog_bytes", parse_size),
             ("fetch.deadlineMs", "fetch_deadline_ms", int),
             ("fetch.backoffMs", "fetch_backoff_ms", int),
+            ("fetch.hedgeMs", "fetch_hedge_ms", int),
+            ("fetch.hedgeMaxMs", "fetch_hedge_max_ms", int),
+            ("breaker.failureThreshold", "breaker_failure_threshold", int),
+            ("breaker.cooldownMs", "breaker_cooldown_ms", int),
+            ("store.softWatermark", "store_soft_watermark", parse_size),
+            ("store.hardWatermark", "store_hard_watermark", parse_size),
+            ("server.acceptBacklog", "server_accept_backlog", int),
             ("wire.checksum", "wire_checksum", lambda v: str(v).lower() == "true"),
             ("compress.codec", "wire_compress_codec", str),
             ("compress.minChunkBytes", "compress_min_chunk_bytes", parse_size),
@@ -512,6 +568,28 @@ class TpuShuffleConf:
             raise ValueError("eviction_epoch_ms must be >= 0 (0 = manual epochs)")
         if self.server_workers < 0:
             raise ValueError("server_workers must be >= 0 (0 = thread-per-connection)")
+        if self.fetch_hedge_ms < 0:
+            raise ValueError("fetch_hedge_ms must be >= 0 (0 = hedging off)")
+        if self.fetch_hedge_max_ms < 0:
+            raise ValueError("fetch_hedge_max_ms must be >= 0 (0 = unbounded ceiling)")
+        if self.fetch_hedge_max_ms and self.fetch_hedge_max_ms < self.fetch_hedge_ms:
+            raise ValueError("fetch_hedge_max_ms must be >= fetch_hedge_ms when set")
+        if self.breaker_failure_threshold < 0:
+            raise ValueError("breaker_failure_threshold must be >= 0 (0 = breakers off)")
+        if self.breaker_cooldown_ms < 0:
+            raise ValueError("breaker_cooldown_ms must be >= 0")
+        if self.store_soft_watermark < 0:
+            raise ValueError("store_soft_watermark must be >= 0 (0 = no soft watermark)")
+        if self.store_hard_watermark < 0:
+            raise ValueError("store_hard_watermark must be >= 0 (0 = no hard watermark)")
+        if (
+            self.store_soft_watermark
+            and self.store_hard_watermark
+            and self.store_soft_watermark > self.store_hard_watermark
+        ):
+            raise ValueError("store_soft_watermark must be <= store_hard_watermark")
+        if self.server_accept_backlog < 0:
+            raise ValueError("server_accept_backlog must be >= 0 (0 = unbounded accepts)")
         if not (0 <= self.obs_metrics_port <= 65535):
             raise ValueError("obs_metrics_port must be in [0, 65535] (0 = no HTTP endpoint)")
         if self.obs_ring_capacity <= 0:

@@ -25,6 +25,10 @@ TracePull             11  observability: pull the peer's trace-event ring —
                           request (tag), reply body = JSON event buffer
 MetricsPull           12  observability: pull the peer's metrics snapshot —
                           request (tag), reply body = Prometheus text
+ServerBusy            13  load shedding: the server's accept backlog is full
+                          (``server.acceptBacklog``) — sent best-effort before
+                          closing the shed connection; headerless, bodyless.
+                          Clients surface it as retryable ResourceExhaustedError
 ====================  ==  =======================================================
 
 Ids 5-6 extend the reference schema for the striped zero-copy wire path: a
@@ -66,6 +70,7 @@ class AmId(enum.IntEnum):
     MEMBER_REJOIN = 10
     TRACE_PULL = 11
     METRICS_PULL = 12
+    SERVER_BUSY = 13
 
 
 _FRAME = struct.Struct("<IQQ")

@@ -148,6 +148,40 @@ class TenantQuotaExceededError(TransportError):
         super().__init__(msg)
 
 
+class ResourceExhaustedError(TransportError):
+    """The serving executor is under memory pressure: an allocation-bearing
+    write/serve hit the store's hard watermark (``store.hardWatermark``), the
+    host buffer pool's cap, or the reactor shed the connection past its accept
+    backlog (``server.acceptBacklog``).
+
+    Typed + addressed like TenantQuotaExceededError — but RETRYABLE WITH
+    BACKOFF, the third arm of the failure taxonomy: unlike a quota rejection
+    (every replica enforces the same registry, fail fast) memory pressure is a
+    transient, per-executor condition — the soft-watermark eviction sweep or a
+    drained backlog clears it — so clients back off and retry the same or a
+    replica holder instead of failing the job.  Carried on the wire as the
+    dedicated ``SIZE_RESOURCE_EXHAUSTED`` fetch-reply size code.
+    """
+
+    def __init__(
+        self,
+        requested: int = 0,
+        used: int = 0,
+        watermark: int = 0,
+        detail: str = "",
+    ) -> None:
+        self.requested = requested
+        self.used = used
+        self.watermark = watermark
+        msg = (
+            "resource exhausted under memory pressure"
+            f" (requested={requested}, used={used}, watermark={watermark})"
+        )
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 class ExecutorLostError(TransportError):
     """An executor died while an exchange depended on it and no recovery path
     exists (elasticity off, replication factor 0, or an unsupported exchange

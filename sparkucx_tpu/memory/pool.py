@@ -28,7 +28,9 @@ import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.block import MemoryBlock
+from sparkucx_tpu.core.operation import ResourceExhaustedError
 from sparkucx_tpu.memory import sanitizer as _sanitizer
+from sparkucx_tpu.testing import faults
 
 
 def round_up_to_next_power_of_two(size: int) -> int:
@@ -59,6 +61,34 @@ def _alloc_aligned(nbytes: int, alignment: int = _DEFAULT_ALIGNMENT):
     raw = np.empty(nbytes + alignment, dtype=np.uint8)
     offset = (-raw.ctypes.data) % alignment
     return raw[offset : offset + nbytes], None
+
+
+class _PoolBudget:
+    """Pool-wide backing-allocation budget (``store.hardWatermark``).
+
+    Shared by every :class:`AllocatorStack` of one pool so the hard watermark
+    bounds the SUM of slab allocations, not each bucket independently.  The
+    lock is a leaf: nothing is called while it is held.
+    """
+
+    __slots__ = ("hard", "allocated", "lock")
+
+    def __init__(self, hard: int) -> None:
+        self.hard = int(hard)
+        self.allocated = 0  #: guarded by self.lock
+        self.lock = threading.Lock()
+
+    def charge(self, nbytes: int) -> None:
+        """Admit a slab allocation or raise the retryable typed error."""
+        with self.lock:
+            if self.hard > 0 and self.allocated + nbytes > self.hard:
+                raise ResourceExhaustedError(
+                    requested=nbytes,
+                    used=self.allocated,
+                    watermark=self.hard,
+                    detail="memory pool hard watermark",
+                )
+            self.allocated += nbytes
 
 
 class _Slab:
@@ -92,11 +122,13 @@ class AllocatorStack:
         min_allocation_size: int,
         alignment: int = _DEFAULT_ALIGNMENT,
         sanitizer: Optional[_sanitizer.BufferSanitizer] = None,
+        budget: Optional[_PoolBudget] = None,
     ) -> None:
         self.size = size
         self.min_allocation_size = min_allocation_size
         self.alignment = alignment
         self.sanitizer = sanitizer or _sanitizer.DISABLED
+        self.budget = budget
         self._free: List[MemoryBlock] = []  #: guarded by self._lock
         self._slabs: List[_Slab] = []  #: guarded by self._lock
         self._lock = threading.Lock()
@@ -138,10 +170,22 @@ class AllocatorStack:
         return mb
 
     def _allocate_more(self) -> None:
-        """Grow the free list by one slab; caller holds ``self._lock``."""
+        """Grow the free list by one slab; caller holds ``self._lock``.
+
+        Budget bytes charged here are never refunded per-slab — the charge's
+        ownership transfers to the slab list, which lives until ``close()``
+        tears the whole stack down; pooled buffers recycle, slabs do not."""
         # Small buckets allocate min_allocation_size slabs and carve them up;
         # buckets >= the slab size allocate exactly one buffer (MemoryPool.scala:64-70).
         alloc_size = max(self.size, self.min_allocation_size)
+        # Memory-pressure gate BEFORE the backing allocation mutates any
+        # state: a shed growth leaves the stack exactly as it was, and the
+        # caller's get()/get_n() surfaces the retryable typed error.  The
+        # chaos point fires first so tests can inject pressure with the
+        # watermark knobs off (byte-identical defaults).
+        faults.check("store.mem_pressure", site="pool_grow", nbytes=alloc_size)
+        if self.budget is not None:
+            self.budget.charge(alloc_size)
         array, closer = _alloc_aligned(alloc_size, self.alignment)
         slab = _Slab(array, closer)
         self._slabs.append(slab)
@@ -224,6 +268,8 @@ class MemoryPool:
         #: the reader attaches view bookkeeping without reaching into pool
         #: internals (analysis: private-access pass)
         self.sanitizer = _sanitizer.from_conf(self.conf)
+        #: pool-wide slab budget (store.hardWatermark); 0 = unbounded
+        self._budget = _PoolBudget(getattr(self.conf, "store_hard_watermark", 0))
         self._stacks: Dict[int, AllocatorStack] = {}  #: guarded by self._lock
         self._lock = threading.Lock()
         self._closed = False  #: guarded by self._lock
@@ -238,7 +284,10 @@ class MemoryPool:
             stack = self._stacks.get(bucket)
             if stack is None:
                 stack = AllocatorStack(
-                    bucket, self.conf.min_allocation_size, sanitizer=self.sanitizer
+                    bucket,
+                    self.conf.min_allocation_size,
+                    sanitizer=self.sanitizer,
+                    budget=self._budget,
                 )
                 self._stacks[bucket] = stack
             return stack

@@ -123,6 +123,10 @@ class ClusterMembership:
             return False
         with self._lock:
             if executor_id in self._alive:
+                # A liveness observation about an already-alive executor still
+                # clears any pending (debounced) suspicion: the peer was seen
+                # working, so the suspicion window must restart from scratch.
+                self._suspects.pop(executor_id, None)
                 return False
             self._alive.add(executor_id)
             self._dead.pop(executor_id, None)

@@ -46,6 +46,13 @@ Modes:
   is killed at t=50% (testing/faults.kill_executor) and the reader fails
   over to the replica holder.  Prints both GB/s, the recovery time (kill ->
   first replica-served block), failovers, and p99 frame stall.
+* ``gray`` — gray-failure robustness under traffic: the ``failover`` cluster
+  shape, but the primary is THROTTLED to ~10% of the measured healthy rate
+  (every served frame stalls) instead of killed — the degraded-but-alive
+  peer that trips no deadline.  Measures GB/s + p99 frame stall healthy,
+  throttled with hedging off, and throttled with ``fetch.hedgeMs`` on
+  (hedges rescue straggling blocks from the replica holder); one unclocked
+  hedged pass asserts every block bit-identical to the staged payload.
 * ``tenants`` — multi-tenant serving plane under concurrent fan-in: one
   tenants-enabled loopback server (the shared-selector reactor plane,
   service/reactor.py) stages -n blocks of -s bytes per registered app;
@@ -123,7 +130,7 @@ def _parse_args(argv):
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "wire", "ici",
-            "failover", "elastic", "compress", "tenants", "obs",
+            "failover", "elastic", "compress", "tenants", "obs", "gray",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -811,6 +818,162 @@ def measure_failover(
             t.close()
 
 
+def measure_gray(
+    num_blocks: int = 8,
+    block_bytes: int = 4 << 20,
+    iterations: int = 3,
+    report=None,
+) -> dict:
+    """Measurement core of the ``gray`` mode — fetch throughput through a
+    gray (degraded-but-alive) primary, hedging off vs on.
+
+    Same 3-executor loopback shape as ``failover`` (executor 1 stages +
+    seals, the replicator pushes to ring neighbor 2, executor 0 streams the
+    set back) — but instead of killing the primary, every frame it serves is
+    stalled so its effective rate is ~10% of the measured healthy rate (the
+    gray failure the breaker/deadline machinery can't see: the peer answers,
+    just slowly).  Three phases over ``iterations`` passes each:
+
+    1. healthy, hedging off — the baseline GB/s,
+    2. primary throttled to ~10%, hedging off — the un-hedged collapse,
+    3. primary throttled to ~10%, ``fetch.hedgeMs`` on — hedges fire after
+       the delay and the replica holder serves the straggling blocks.
+
+    One extra UNCLOCKED hedged pass asserts every delivered block is
+    bit-identical to the staged payload (first-completion-wins must never
+    surface replica/primary divergence), so the equality check can't pollute
+    the timed numbers.  Returns per-phase GB/s + p99 frame stall, hedge
+    counters, and the derived per-frame stall.  ``report(phase, it, seconds,
+    bytes)`` per timed pass.  Shared by the CLI and bench.py."""
+    from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+    from sparkucx_tpu.shuffle.resolver import ring_neighbors
+    from sparkucx_tpu.testing import faults
+
+    conf = TpuShuffleConf(
+        replication_factor=1,
+        wire_timeout_ms=60_000,
+        staging_capacity_per_executor=num_blocks * block_bytes + (1 << 20),
+    )
+    executors = [0, 1, 2]
+    ts = [PeerTransport(conf, executor_id=i) for i in executors]
+    addrs = [t.init() for t in ts]
+    for t in ts:
+        for j, a in enumerate(addrs):
+            if j != t.executor_id:
+                t.add_executor(j, a)
+    total = num_blocks * block_bytes
+    try:
+        rng = np.random.default_rng(0)
+        payload = rng.integers(0, 256, size=block_bytes, dtype=np.uint8).tobytes()
+        ts[1].store.create_shuffle(0, 1, num_blocks)
+        w = ts[1].store.map_writer(0, 0)
+        for r in range(num_blocks):
+            w.write_partition(r, payload)
+        w.commit()
+        ts[1].store.seal(0)
+        assert ts[1].replication_wait(0, timeout=60.0), "replication did not settle"
+
+        def make_reader(hedge_ms=0):
+            return TpuShuffleReader(
+                ts[0],
+                executor_id=0,
+                shuffle_id=0,
+                start_partition=0,
+                end_partition=num_blocks,
+                num_mappers=1,
+                block_sizes=lambda m, r: block_bytes,
+                max_blocks_per_request=1,  # one window per block: each frame
+                sender_of=lambda m: 1,     # the gray primary serves stalls
+                replica_of=lambda p: ring_neighbors(p, executors, 1),
+                fetch_retries=3,
+                fetch_deadline_ms=30_000,  # gray peers answer — no deadline
+                fetch_backoff_ms=10,       # trips, hedges do the rescuing
+                fetch_hedge_ms=hedge_ms,
+                fetch_hedge_max_ms=hedge_ms,
+            )
+
+        def consume(reader, collect=None):
+            n = 0
+            t0 = time.perf_counter()
+            for blk in reader.fetch_blocks():
+                if collect is not None:
+                    collect.append(bytes(blk.data))
+                blk.release()
+                n += 1
+            assert n == num_blocks
+            return time.perf_counter() - t0
+
+        def p99_ms():
+            return max(
+                (s["rx_stall_p99_ns"] for s in ts[0].wire_lane_stats()), default=0
+            ) / 1e6
+
+        consume(make_reader())  # warmup: connect, page in
+        out: dict = {}
+        healthy = 0.0
+        for it in range(iterations):
+            dt = consume(make_reader())
+            healthy = max(healthy, total / dt / 1e9)
+            if report is not None:
+                report("healthy", it, dt, total)
+        out["healthy_gbps"] = healthy
+        out["healthy_p99_ms"] = p99_ms()
+
+        # Throttle the primary to ~10%: each served frame sleeps 9x the
+        # healthy per-block time, so primary-served traffic runs at a tenth
+        # of the measured healthy rate.  The faults registry is process-
+        # global — the executor match key pins the stall to server 1 only.
+        stall_s = min(max(9.0 * (total / (healthy * 1e9)) / num_blocks, 0.005), 2.0)
+        out["frame_stall_ms"] = stall_s * 1e3
+        entry = faults.arm(
+            "peer.server.frame", faults.stall(stall_s), match={"executor": 1}
+        )
+        try:
+            degraded = 0.0
+            for it in range(iterations):
+                dt = consume(make_reader())
+                degraded = max(degraded, total / dt / 1e9)
+                if report is not None:
+                    report("throttled", it, dt, total)
+            out["degraded_gbps"] = degraded
+            out["degraded_p99_ms"] = p99_ms()
+
+            # hedge delay: a fraction of the injected stall, so hedges fire
+            # well before the gray primary answers but never on healthy peers
+            hedge_ms = max(1, int(stall_s * 1e3 / 4))
+            hedged = 0.0
+            hedge_reader = None
+            for it in range(iterations):
+                hedge_reader = make_reader(hedge_ms=hedge_ms)
+                dt = consume(hedge_reader)
+                hedged = max(hedged, total / dt / 1e9)
+                if report is not None:
+                    report("hedged", it, dt, total)
+            out["hedged_gbps"] = hedged
+            out["hedged_p99_ms"] = p99_ms()
+            out["hedge_ms"] = hedge_ms
+            m = hedge_reader.metrics
+            out["hedges_issued"] = m.hedges_issued
+            out["hedge_wins"] = m.hedge_wins
+            out["hedge_losses"] = m.hedge_losses
+            out["fetch_timeouts"] = m.fetch_timeouts
+
+            # bit-equality OUTSIDE the clock: one unclocked hedged pass, every
+            # delivered block compared against the staged payload
+            got: List[bytes] = []
+            consume(make_reader(hedge_ms=hedge_ms), collect=got)
+            assert len(got) == num_blocks and all(b == payload for b in got), (
+                "hedged read diverged from the staged payload"
+            )
+            out["bit_identical"] = True
+        finally:
+            faults.disarm(entry)
+        return out
+    finally:
+        for t in ts:
+            t.close()
+
+
 def measure_tenants(
     num_apps: int = 8,
     num_blocks: int = 8,
@@ -1451,6 +1614,34 @@ def run_failover(args) -> None:
         f"{r['failovers']} failovers / {r['blocks_retried']} retried / "
         f"{r['fetch_timeouts']} timeouts, "
         f"p99 frame stall {r['rx_stall_p99_ms']:.2f} ms",
+        flush=True,
+    )
+
+
+def run_gray(args) -> None:
+    size = parse_size(args.block_size)
+
+    def report(phase, it, dt, tot):
+        print(
+            f"{phase} iter {it}: {args.num_blocks} x {size} B in "
+            f"{dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    r = measure_gray(args.num_blocks, size, args.iterations, report=report)
+    collapse = r["degraded_gbps"] / max(r["healthy_gbps"], 1e-9)
+    rescue = r["hedged_gbps"] / max(r["healthy_gbps"], 1e-9)
+    print(
+        f"gray: healthy {r['healthy_gbps']:.2f} GB/s (p99 stall "
+        f"{r['healthy_p99_ms']:.2f} ms); primary throttled to ~10% "
+        f"({r['frame_stall_ms']:.1f} ms/frame): hedging off "
+        f"{r['degraded_gbps']:.2f} GB/s ({collapse:.2f}x, p99 "
+        f"{r['degraded_p99_ms']:.2f} ms), hedging on ({r['hedge_ms']} ms) "
+        f"{r['hedged_gbps']:.2f} GB/s ({rescue:.2f}x, p99 "
+        f"{r['hedged_p99_ms']:.2f} ms), "
+        f"{r['hedges_issued']} hedges / {r['hedge_wins']} wins / "
+        f"{r['hedge_losses']} losses / {r['fetch_timeouts']} timeouts, "
+        f"bit-identical {r['bit_identical']}",
         flush=True,
     )
 
@@ -2577,6 +2768,8 @@ def main(argv=None) -> None:
         run_gather(args)
     elif args.mode == "write":
         run_write(args)
+    elif args.mode == "gray":
+        run_gray(args)
     elif args.mode == "skew":
         run_skew(args)
     elif args.mode == "ici":

@@ -39,8 +39,18 @@ DEFAULT_WORKERS = 8
 class Reactor:
     """Selector loop + bounded worker pool for frame-at-a-time serving."""
 
-    def __init__(self, workers: int = 0, name: str = "sparkucx-reactor") -> None:
+    def __init__(
+        self,
+        workers: int = 0,
+        name: str = "sparkucx-reactor",
+        accept_backlog: int = 0,
+    ) -> None:
         self.workers = int(workers) if workers and workers > 0 else DEFAULT_WORKERS
+        #: Load-shedding bound (``server.acceptBacklog``): with more than this
+        #: many resident connections, new accepts get a best-effort ServerBusy
+        #: frame and an immediate close instead of queueing unboundedly.
+        #: 0 = off (accept everything), the byte-identical default.
+        self.accept_backlog = int(accept_backlog)
         self._sel = selectors.DefaultSelector()
         self._pool = ThreadPoolExecutor(
             max_workers=self.workers, thread_name_prefix=f"{name}-worker"
@@ -55,6 +65,7 @@ class Reactor:
         self._listeners: List[socket.socket] = []  #: guarded by self._lock
         self._closed = False  #: guarded by self._lock
         self._frames_served = 0  #: worker-pool dispatches; guarded by self._lock
+        self._sheds = 0  #: connections shed over accept_backlog; guarded by self._lock
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
         self._thread.start()
@@ -107,6 +118,7 @@ class Reactor:
                 "connections": len(self._conns),
                 "workers": self.workers,
                 "frames_served": self._frames_served,
+                "sheds": self._sheds,
             }
 
     # -- internals ------------------------------------------------------
@@ -170,6 +182,14 @@ class Reactor:
                 return
             except OSError:
                 return
+            if self.accept_backlog > 0:
+                with self._lock:
+                    shed = len(self._conns) >= self.accept_backlog
+                    if shed:
+                        self._sheds += 1
+                if shed:
+                    self._shed(conn)
+                    continue
             try:
                 on_accept(conn)
             except Exception:
@@ -177,6 +197,27 @@ class Reactor:
                     conn.close()
                 except OSError:
                     pass
+
+    @staticmethod
+    def _shed(conn: socket.socket) -> None:
+        """Refuse an over-backlog connection with a typed busy reply.
+
+        Runs ON the loop thread, so it must never block: the ServerBusy frame
+        goes out best-effort on a non-blocking socket (20 bytes fits any sane
+        send buffer) and the connection closes either way.  Clients surface
+        the frame — or the bare reset — as a retryable condition.
+        """
+        from sparkucx_tpu.core.definitions import AmId, pack_frame
+
+        try:
+            conn.setblocking(False)
+            conn.send(pack_frame(AmId.SERVER_BUSY))
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _serve(self, conn: socket.socket, serve_once, on_close) -> None:
         keep = False

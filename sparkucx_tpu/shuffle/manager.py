@@ -147,6 +147,8 @@ class TpuShuffleManager:
             replica_of=replica_of,
             fetch_deadline_ms=self.conf.fetch_deadline_ms,
             fetch_backoff_ms=self.conf.fetch_backoff_ms,
+            fetch_hedge_ms=self.conf.fetch_hedge_ms,
+            fetch_hedge_max_ms=self.conf.fetch_hedge_max_ms,
             memory_budget=self.conf.reduce_memory_budget,
             spill_dir=self.conf.spill_dir,
             merge_combiners=merge_combiners,

@@ -56,6 +56,12 @@ class ShuffleReadMetrics:
     failovers: int = 0
     #: fetch windows or retry attempts abandoned at the fetch deadline
     fetch_timeouts: int = 0
+    #: duplicate fetches issued to replica holders for straggling blocks
+    hedges_issued: int = 0
+    #: hedged fetches that beat the straggling primary (replica bytes won)
+    hedge_wins: int = 0
+    #: hedged fetches the primary beat (hedge buffer quarantined)
+    hedge_losses: int = 0
 
 
 class BlockFetchResult:
@@ -204,6 +210,8 @@ class TpuShuffleReader:
         replica_of: Optional[Callable[[ExecutorId], Sequence[ExecutorId]]] = None,
         fetch_deadline_ms: int = 0,
         fetch_backoff_ms: int = 50,
+        fetch_hedge_ms: int = 0,
+        fetch_hedge_max_ms: int = 0,
     ) -> None:
         self.transport = transport
         self.executor_id = executor_id
@@ -241,6 +249,16 @@ class TpuShuffleReader:
         self.fetch_deadline_ms = max(0, fetch_deadline_ms)
         #: base for the jittered, doubling backoff between retry attempts
         self.fetch_backoff_ms = max(0, fetch_backoff_ms)
+        #: hedged-fetch floor (``fetch.hedgeMs``): with a window still
+        #: incomplete after max(floor, observed rx stall p99), a DUPLICATE
+        #: request for each straggling block goes to a replica holder; the
+        #: first completion wins bit-identically and the loser's buffer is
+        #: quarantined via ``_abandoned``.  0 = hedging off (the default).
+        self.fetch_hedge_ms = max(0, fetch_hedge_ms)
+        #: hedge-delay ceiling (``fetch.hedgeMaxMs``) clamping the p99-derived
+        #: delay, so one pathological stall sample cannot defer hedging
+        #: forever.  0 = no ceiling.
+        self.fetch_hedge_max_ms = max(0, fetch_hedge_max_ms)
         #: timed-out fetches whose result buffer may still be a recv-thread
         #: scatter target — kept alive until their request completes, then
         #: closed by _sweep_abandoned (single reader thread; no lock)
@@ -378,34 +396,181 @@ class TpuShuffleReader:
         if agg is None:
             return
         m = self.metrics
-        if m.failovers or m.blocks_retried or m.fetch_timeouts:
+        if (
+            m.failovers
+            or m.blocks_retried
+            or m.fetch_timeouts
+            or m.hedges_issued
+        ):
             agg.record_counters(
                 "read",
                 failovers=m.failovers,
                 blocks_retried=m.blocks_retried,
                 fetch_timeouts=m.fetch_timeouts,
+                hedges_issued=m.hedges_issued,
+                hedge_wins=m.hedge_wins,
+                hedge_losses=m.hedge_losses,
             )
+
+    def _hedge_delay_ns(self) -> int:
+        """Hedge delay for the current window: max(observed rx stall p99 over
+        all wire lanes, the ``fetch.hedgeMs`` floor), clamped to the
+        ``fetch.hedgeMaxMs`` ceiling.  0 = hedging off.  The p99 seeds from
+        ``wire_lane_stats`` so early windows (no samples yet) hedge at the
+        floor and later windows adapt to what this link actually delivers."""
+        if self.fetch_hedge_ms <= 0:
+            return 0
+        floor = self.fetch_hedge_ms * 1_000_000
+        delay = floor
+        lanes = getattr(self.transport, "wire_lane_stats", None)
+        if lanes is not None:
+            try:
+                for lane in lanes():
+                    delay = max(delay, int(lane.get("rx_stall_p99_ns", 0)))
+            except Exception:
+                delay = floor
+        if self.fetch_hedge_max_ms > 0:
+            delay = min(delay, max(self.fetch_hedge_max_ms * 1_000_000, floor))
+        return delay
+
+    @staticmethod
+    def _window_settled(requests, hedges) -> bool:
+        """A window is settled once every block's primary request OR its
+        hedge has completed — a stalled primary whose hedge already won must
+        not keep the window spinning toward the deadline."""
+        for i, (_, _, req) in enumerate(requests):
+            if req.completed():
+                continue
+            h = hedges.get(i)
+            if h is not None and h[1].completed():
+                continue
+            return False
+        return True
+
+    def _issue_hedges(self, requests, hedges) -> None:
+        """One duplicate fetch per straggling block, to a replica holder.
+
+        Replica selection walks ``replica_of(primary)`` skipping the primary
+        itself and (when the transport scores peers) any executor whose
+        circuit breaker rejects the probe.  Hedge buffers are allocated
+        OUTSIDE the credit gate on purpose: hedges exist to break stalls, and
+        gating them on credits held by the very window that is stalled would
+        deadlock; the overdraft is bounded by one buffer per straggling
+        block, and losers drain through the ``_abandoned`` quarantine."""
+        if self.replica_of is None:
+            return
+        allows = getattr(self.transport, "breaker_allows", None)
+        for i, (bid, _, req) in enumerate(requests):
+            if req.completed() or i in hedges:
+                continue
+            primary = self.sender_of(bid.map_id)
+            target: Optional[ExecutorId] = None
+            for e in self.replica_of(primary):
+                if e == primary:
+                    continue
+                if allows is not None and not allows(e):
+                    continue
+                target = e
+                break
+            if target is None:
+                continue
+            size = self.block_sizes(bid.map_id, bid.reduce_id)
+            hbuf = None
+            try:
+                hbuf = self._alloc_buf(size)
+                hreq = self.transport.fetch_block(
+                    target, bid.shuffle_id, bid.map_id, bid.reduce_id, hbuf
+                )
+            except (TransportError, OSError):
+                # dead replica or allocation under memory pressure: hedging
+                # is best-effort — the primary path still owns correctness
+                if hbuf is not None:
+                    hbuf.close()
+                continue
+            hedges[i] = (hbuf, hreq, target)
+            self.metrics.hedges_issued += 1
+            instant(
+                "fetch.hedge",
+                shuffle_id=bid.shuffle_id, map_id=bid.map_id,
+                reduce_id=bid.reduce_id, executor=target,
+            )
+
+    def _resolve_hedges(self, requests, hedges) -> None:
+        """First completion wins; the loser's buffer is quarantined (it may
+        still be a recv-scatter target) and swept once its request settles.
+        Ties — both completed successfully — go to the primary: the bytes are
+        bit-identical by the deterministic-refetch contract, and the hedge
+        buffer is the one safe to discard either way."""
+        record = getattr(self.transport, "record_peer_failure", None)
+        for i, (hbuf, hreq, target) in hedges.items():
+            bid, buf, req = requests[i]
+            primary_ok = (
+                req.completed()
+                and req.wait(0).status == OperationStatus.SUCCESS
+            )
+            hedge_won = False
+            if not primary_ok and hreq.completed():
+                hresult = hreq.wait(0)
+                if hresult.status == OperationStatus.SUCCESS:
+                    size = self.block_sizes(bid.map_id, bid.reduce_id)
+                    if int(hresult.stats.recv_size) != size:
+                        hbuf.close()
+                        raise TransportError(
+                            f"hedged fetch of {bid} from executor {target} "
+                            f"returned {hresult.stats.recv_size} B, expected "
+                            f"{size} B — replica diverges from primary"
+                        )
+                    hedge_won = True
+            if hedge_won:
+                # replica bytes win: quarantine the straggling primary fetch
+                # and charge the stall to the primary's health score — a
+                # consistently-hedged peer trips its breaker and later
+                # fetches route straight to the ring
+                self._abandoned.append((buf, req))
+                requests[i] = (bid, hbuf, hreq)
+                self.metrics.hedge_wins += 1
+                if record is not None:
+                    record(
+                        self.sender_of(bid.map_id),
+                        f"hedged fetch of {bid} lost to replica {target}",
+                    )
+                instant(
+                    "fetch.hedge_win",
+                    shuffle_id=bid.shuffle_id, map_id=bid.map_id,
+                    reduce_id=bid.reduce_id, executor=target,
+                )
+            else:
+                self._abandoned.append((hbuf, hreq))
+                self.metrics.hedge_losses += 1
+        hedges.clear()
 
     def _await_window(self, requests, num_blocks: int) -> None:
         t0 = time.monotonic_ns()
         deadline_ns = self.fetch_deadline_ms * 1_000_000
+        hedge_ns = self._hedge_delay_ns()
+        hedges: dict = {}  # request index -> (hedge_buf, hedge_req, executor)
+        hedged = False
         # wakeup park between polls when the transport supports it
         # (use_wakeup; GlobalWorkerRpcThread.scala:46-58) — a local fetch
         # completes on the first poll so the wait never fires there
         park = getattr(self.transport, "wait_for_activity", None)
-        while not all(req.completed() for _, _, req in requests):
-            if deadline_ns and time.monotonic_ns() - t0 > deadline_ns:
+        while not self._window_settled(requests, hedges):
+            now = time.monotonic_ns()
+            if deadline_ns and now - t0 > deadline_ns:
                 # hung peer: stop spinning, let _yield_window fail the
                 # incomplete fetches over to replicas — this bounds the
                 # fetch_wait charge per window to the deadline
                 self.metrics.fetch_timeouts += 1
                 break
+            if hedge_ns and not hedged and now - t0 > hedge_ns:
+                hedged = True
+                self._issue_hedges(requests, hedges)
             self.transport.progress()
-            if park is not None and not all(
-                req.completed() for _, _, req in requests
-            ):
+            if park is not None and not self._window_settled(requests, hedges):
                 park(0.002)
         self.metrics.fetch_wait_ns += time.monotonic_ns() - t0
+        if hedges:
+            self._resolve_hedges(requests, hedges)
 
     def _yield_window(self, requests, wctx=None) -> Iterator[BlockFetchResult]:
         prev: Optional[BlockFetchResult] = None
@@ -486,7 +651,18 @@ class TpuShuffleReader:
         Tenant admission rejections (UnknownTenantError /
         TenantQuotaExceededError) are NOT retried: every replica enforces the
         same registry budgets, so failing over would just re-pay the backoff
-        to hit the same wall — they propagate immediately."""
+        to hit the same wall — they propagate immediately.
+        ``ResourceExhaustedError`` (memory-pressure shed, the third arm of
+        the failure taxonomy) IS retried: it inherits the jittered doubling
+        backoff, which is exactly the back-off-and-retry contract the typed
+        error promises — a later attempt lands after the server's watermark
+        sweep freed room.
+
+        When the transport scores peers (``breaker_allows``), candidates
+        whose circuit breaker is open are skipped, so a gray-failing primary
+        routes straight to the replica ring without burning a full deadline
+        per attempt; if EVERY candidate's breaker rejects, the full list is
+        kept (an open breaker must delay, never strand, a block)."""
         if failed is not None and isinstance(
             failed.error, (TenantQuotaExceededError, UnknownTenantError)
         ):
@@ -499,11 +675,17 @@ class TpuShuffleReader:
         candidates: List[ExecutorId] = [primary]
         if self.replica_of is not None:
             candidates += [e for e in self.replica_of(primary) if e != primary]
+        allows = getattr(self.transport, "breaker_allows", None)
+        if allows is not None and len(candidates) > 1:
+            admitted = [e for e in candidates if allows(e)]
+            if admitted:
+                candidates = admitted
         deadline_ns = self.fetch_deadline_ms * 1_000_000
         # same wakeup park as the batch window loop above — the retry path
         # exists exactly for slow/straggling peers, where busy-spinning
         # progress() would burn the GIL against the recv thread
         park = getattr(self.transport, "wait_for_activity", None)
+        record = getattr(self.transport, "record_peer_failure", None)
         attempt = 0
         for executor in candidates:
             for _ in range(self.fetch_retries):
@@ -534,6 +716,15 @@ class TpuShuffleReader:
                     self.metrics.fetch_timeouts += 1
                     self._abandoned.append((buf, req))
                     buf = None  # never reuse a possibly-still-scattering buffer
+                    if record is not None:
+                        # a timeout the transport never saw as a frame error:
+                        # charge it to the peer's health score here so hung
+                        # (not dead) peers still trip their breaker
+                        record(
+                            executor,
+                            f"fetch of {bid} timed out after "
+                            f"{self.fetch_deadline_ms} ms",
+                        )
                     last_error = TransportError(
                         f"fetch of {bid} from executor {executor} timed out "
                         f"after {self.fetch_deadline_ms} ms"

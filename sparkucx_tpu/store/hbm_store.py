@@ -47,7 +47,12 @@ import numpy as np
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.definitions import MapperInfo
-from sparkucx_tpu.core.operation import BlockNotFoundError, TransportError
+from sparkucx_tpu.core.operation import (
+    BlockNotFoundError,
+    ResourceExhaustedError,
+    TransportError,
+)
+from sparkucx_tpu.testing import faults
 from sparkucx_tpu.utils.trace import span
 
 
@@ -242,6 +247,9 @@ class MapWriter:
         peer = st.owner_of(reduce_id)
         if not self._discard:
             padded = -(-self._written // st.alignment) * st.alignment
+            # watermark gate before taking the lock: a shed write fails typed
+            # (retryable ResourceExhaustedError) with nothing allocated
+            self._store.check_memory_pressure("close_partition", padded)
             with self._store._lock:
                 if st.device_mode:
                     raise TransportError(
@@ -323,6 +331,7 @@ class MapWriter:
                     f"single partition ({self.map_id},{reduce_id}) exceeds a "
                     f"whole region ({st.region_size} B) — raise stagingCapacity"
                 )
+            self._store.check_memory_pressure("write_partition_device", padded)
             with self._store._lock:
                 if st.device_mode is False:
                     raise TransportError(
@@ -418,6 +427,11 @@ class HbmBlockStore:
         #: store lock is released — implementations may call back into the
         #: store freely.
         self.on_seal: Optional[Callable[[int], None]] = None
+        # -- memory-pressure watermarks (gray-failure load shedding) -------
+        #: out-of-band soft-watermark eviction sweeps kicked so far
+        self._watermark_sweeps = 0  #: guarded by self._lock
+        #: single-flight latch: at most one sweep thread runs at a time
+        self._sweeping = False  #: guarded by self._lock
 
     @property
     def _spill_dir(self) -> Optional[str]:
@@ -542,6 +556,98 @@ class HbmBlockStore:
         if st is None:
             raise TransportError(f"unknown shuffle {shuffle_id}")
         return st
+
+    # -- memory-pressure watermarks (gray-failure load shedding) ----------
+
+    def _pressure_locked(self) -> int:
+        """Host bytes this store is holding live (caller holds self._lock):
+        every shuffle's staged bytes in RAM rounds plus the replica tier.
+        Disk-tier (memmap) rounds are excluded — they are exactly the bytes
+        the watermark machinery already shed."""
+        total = self._replica_bytes
+        for st in self._shuffles.values():
+            total += int(st.region_used.sum())
+            for snap, used in st.prev_rounds:
+                if not isinstance(snap, np.memmap):
+                    total += int(used.sum())
+        return total
+
+    def memory_pressure_bytes(self) -> int:
+        with self._lock:
+            return self._pressure_locked()
+
+    def _check_pressure_locked(self, site: str, nbytes: int) -> bool:
+        """Watermark gate body; caller holds ``self._lock``.  Raises the
+        typed RETRYABLE ``ResourceExhaustedError`` past the hard watermark;
+        returns True when the soft watermark is crossed — the caller MUST
+        call ``_kick_watermark_sweep()`` AFTER releasing the lock (the kick
+        takes the lock itself, and the sweep latch must never be reached
+        through a held-lock path).  The ``store.mem_pressure`` fault point
+        fires first either way, so chaos tests inject pressure without
+        configuring watermarks."""
+        faults.check("store.mem_pressure", site=site, nbytes=nbytes)
+        soft = self.conf.store_soft_watermark
+        hard = self.conf.store_hard_watermark
+        if soft <= 0 and hard <= 0:
+            return False
+        pressure = self._pressure_locked()
+        if hard > 0 and pressure + nbytes > hard:
+            raise ResourceExhaustedError(
+                requested=nbytes,
+                used=pressure,
+                watermark=hard,
+                detail=f"store hard watermark at {site} (executor {self.executor_id})",
+            )
+        return soft > 0 and pressure + nbytes > soft
+
+    def check_memory_pressure(self, site: str, nbytes: int = 0) -> None:
+        """Gate an allocation-bearing mutation against the watermarks
+        (``store.softWatermark`` / ``store.hardWatermark``); called BEFORE any
+        state changes, so a shed write leaves the store exactly as it was.
+
+        Soft watermark crossed: kick one out-of-band eviction sweep (demote
+        one round a tier down) and admit the write.  Hard watermark crossed:
+        raise the typed RETRYABLE ``ResourceExhaustedError`` — on the wire it
+        becomes ``SIZE_RESOURCE_EXHAUSTED`` and clients back off and retry.
+        Both knobs default 0 = off, the byte-identical store."""
+        with self._lock:
+            kick = self._check_pressure_locked(site, nbytes)
+        if kick:
+            self._kick_watermark_sweep()
+
+    def _kick_watermark_sweep(self) -> None:
+        """Single-flight out-of-band eviction sweep: demote ONE round a tier
+        down (the EvictionManager's documented demotion order), off-thread so
+        the writer that crossed the soft watermark never blocks on IO."""
+        ev = self.eviction
+        if ev is None:
+            return
+        with self._lock:
+            if self._sweeping:
+                return
+            self._sweeping = True
+            self._watermark_sweeps += 1
+
+        def _sweep() -> None:
+            try:
+                ev.run_epoch(max_demotions=1)
+            except Exception:
+                pass  # shedding pressure is best-effort; the hard gate holds
+            finally:
+                with self._lock:
+                    self._sweeping = False
+
+        threading.Thread(
+            target=_sweep, daemon=True, name=f"wm-sweep-e{self.executor_id}"
+        ).start()
+
+    def watermark_stats(self) -> Dict[str, int]:
+        """Watermark telemetry for the metrics registry (eviction family)."""
+        with self._lock:
+            return {
+                "watermark_sweeps": self._watermark_sweeps,
+                "pressure_bytes": self._pressure_locked(),
+            }
 
     def _rollover(self, st: _ShuffleState) -> None:
         """Snapshot the current staging epoch and start a fresh round (caller
@@ -986,34 +1092,47 @@ class HbmBlockStore:
         # span OUTSIDE the store lock: restage-on-fetch runs under a serve
         # thread's remote trace context, so the restage shows up as a child
         # of the reducer's window in the merged trace
-        with span("store.restage", shuffle_id=shuffle_id, round=round_idx), self._lock:
-            st = self._shuffles.get(shuffle_id)
-            if st is None or not (0 <= round_idx <= st.round):
-                return False
-            if self._tier_of(st, round_idx) != "disk":
-                return False
-            lane = st.alignment // 4
-            self._charge_tenant(st, self._round_nbytes(st, round_idx))  #: balanced by _release_tenant
-            if round_idx < len(st.prev_rounds):
-                mm, used = st.prev_rounds[round_idx]
-                arr = np.array(mm)
-                st.prev_rounds[round_idx] = (arr, used)
-                if st.sealed:
-                    st.sealed_payload[round_idx] = arr.view(np.int32).reshape(-1, lane)
-            elif st.device_mode:
-                mm = st.sealed_payload[round_idx]
-                arr = np.array(mm)
-                st.sealed_payload[round_idx] = arr
-            else:
-                mm = st.staging
-                arr = np.array(mm)
-                st.staging = arr
-                if st.sealed:
-                    st.sealed_payload[round_idx] = arr.view(np.int32).reshape(-1, lane)
-            path = getattr(mm, "filename", None)
-            if path:
-                self._unspill_file(st, str(path))
-            return True
+        kick = False
+        try:
+            with span("store.restage", shuffle_id=shuffle_id, round=round_idx), self._lock:
+                st = self._shuffles.get(shuffle_id)
+                if st is None or not (0 <= round_idx <= st.round):
+                    return False
+                if self._tier_of(st, round_idx) != "disk":
+                    return False
+                lane = st.alignment // 4
+                # watermark gate BEFORE the quota charge: a pressured store
+                # must not admit the very bytes its sweep is trying to shed.
+                # The soft-watermark kick is deferred past the lock release
+                # (try/finally) — the sweep latch is never reached through a
+                # held-lock path.
+                kick = self._check_pressure_locked(
+                    "restage_round", self._round_nbytes(st, round_idx)
+                )
+                self._charge_tenant(st, self._round_nbytes(st, round_idx))  #: balanced by _release_tenant
+                if round_idx < len(st.prev_rounds):
+                    mm, used = st.prev_rounds[round_idx]
+                    arr = np.array(mm)
+                    st.prev_rounds[round_idx] = (arr, used)
+                    if st.sealed:
+                        st.sealed_payload[round_idx] = arr.view(np.int32).reshape(-1, lane)
+                elif st.device_mode:
+                    mm = st.sealed_payload[round_idx]
+                    arr = np.array(mm)
+                    st.sealed_payload[round_idx] = arr
+                else:
+                    mm = st.staging
+                    arr = np.array(mm)
+                    st.staging = arr
+                    if st.sealed:
+                        st.sealed_payload[round_idx] = arr.view(np.int32).reshape(-1, lane)
+                path = getattr(mm, "filename", None)
+                if path:
+                    self._unspill_file(st, str(path))
+                return True
+        finally:
+            if kick:
+                self._kick_watermark_sweep()
 
     # -- read path (serve staged blocks) ----------------------------------
 
@@ -1179,6 +1298,9 @@ class HbmBlockStore:
         is the concatenated unpadded payloads in ``entries`` order; a repeated
         put for the same (shuffle, src, round) replaces the old copy (the
         replicator may re-push after a transient failure)."""
+        # a pressured receiver sheds replica installs (best-effort durability:
+        # the pushing neighbor accounts it as a failed push and moves on)
+        self.check_memory_pressure("put_replica", len(body))
         index: Dict[Tuple[int, int], Tuple[int, int]] = {}
         pos = 0
         for m, r, ln in entries:

@@ -32,6 +32,11 @@ replica.apply         server side, before installing a received replica round
 exchange.submit       collective plane (transport/tpu.py), before each round's
                       submit (ctx: ``shuffle_id``, ``round``) — the hook that
                       lets chaos tests kill an executor mid-superstep
+store.mem_pressure    store/hbm_store.py + memory/pool.py, before each
+                      allocation-bearing mutation (close_partition, device
+                      write, replica install, restage, pool growth) — arming
+                      ``fail(ResourceExhaustedError(...))`` models a host
+                      under memory pressure (ctx: ``site``, ``nbytes``)
 ====================  ==========================================================
 
 :func:`kill_executor` force-kills a loopback-cluster executor: its server
@@ -43,10 +48,13 @@ for SIGKILLing an executor process mid-superstep.
 from __future__ import annotations
 
 import contextlib
+import random
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
 
 #: Fast-path flag: every check/transform hook bails immediately when False.
 #: Written only under _lock; read racily by hooks (benign — worst case one
@@ -208,10 +216,41 @@ def garble(xor: int = 0xFF):
     """transform-action: corrupt every byte (XOR) of the passing data."""
 
     def _act(data, **_ctx):
-        out = bytearray(data)
-        for i in range(len(out)):
-            out[i] ^= xor
-        return out
+        # vectorized buffer XOR — MiB-scale chunks pass through chaos tests
+        # at memcpy speed instead of a per-byte Python loop
+        arr = np.frombuffer(bytes(data), dtype=np.uint8) ^ np.uint8(xor)
+        return bytearray(arr.tobytes())
+
+    return _act
+
+
+def throttle(bytes_per_sec: float):
+    """transform-action: pace the passing data to ``bytes_per_sec`` — the
+    gray-failure stand-in for a congested / degraded link.  Sleeps
+    ``len(data) / bytes_per_sec`` and returns the data unchanged, so the
+    peer is slow but every byte still arrives bit-identically."""
+
+    def _act(data, **_ctx):
+        n = len(data)
+        if n and bytes_per_sec > 0:
+            time.sleep(n / bytes_per_sec)
+        return data
+
+    return _act
+
+
+def flaky(p: float, seed: int = 0):
+    """check-action: raise ConnectionResetError with probability ``p`` per
+    call, from a private deterministic stream — the same ``seed`` replays the
+    same failure pattern, so flaky-peer chaos tests are reproducible."""
+    rng = random.Random(seed)
+    rng_lock = threading.Lock()
+
+    def _act(**_ctx):
+        with rng_lock:
+            roll = rng.random()
+        if roll < p:
+            raise ConnectionResetError(f"fault injected: flaky peer (p={p})")
 
     return _act
 
@@ -240,14 +279,31 @@ def kill_executor(transport) -> None:
     expose a ``chaos_kill`` hook instead of sockets: it closes the executor's
     store and reports the death to cluster membership, so the collective
     plane observes the loss the same way the wire plane observes a RST.
+
+    Idempotent: a second kill of the same transport is a no-op — real
+    processes only die once, and chaos tests that tear down in both the test
+    body and a finally block must not trip over the first kill's cleanup.
     """
+    if getattr(transport, "_chaos_killed", False):
+        return
+    try:
+        transport._chaos_killed = True
+    except AttributeError:
+        pass  # __slots__-style transports: kill proceeds, just not recorded
     recorder = getattr(transport, "recorder", None)
     if recorder is not None:
         # full bundle BEFORE the kill: no subsystem lock is held here, and
-        # the dying executor's last metrics view is the interesting one
-        recorder.capture(
-            "chaos_kill", executor=getattr(transport, "executor_id", None)
-        )
+        # the dying executor's last metrics view is the interesting one —
+        # including its final peer-health/breaker view, the postmortem's
+        # best clue about WHY chaos chose this executor
+        health_snapshot = getattr(transport, "health_snapshot", None)
+        context = {"executor": getattr(transport, "executor_id", None)}
+        if health_snapshot is not None:
+            try:
+                context["peer_health"] = health_snapshot()
+            except Exception:
+                pass
+        recorder.capture("chaos_kill", **context)
     chaos_kill = getattr(transport, "chaos_kill", None)
     if chaos_kill is not None:
         chaos_kill()

@@ -75,6 +75,7 @@ from sparkucx_tpu.core.operation import (
     OperationStats,
     OperationStatus,
     Request,
+    ResourceExhaustedError,
     TenantQuotaExceededError,
     TransportError,
     UnknownTenantError,
@@ -117,10 +118,15 @@ _APP = struct.Struct("<I")
 #: block-not-found (retryable through replica failover); -2/-3 are the
 #: tenant admission rejections, surfaced client-side as the typed
 #: UnknownTenantError / TenantQuotaExceededError which readers treat as
-#: NOT retryable (every replica enforces the same registry).
+#: NOT retryable (every replica enforces the same registry).  -4 is the
+#: gray-failure arm: the serving store hit its hard watermark
+#: (``store.hardWatermark``) mid-serve — surfaced as ResourceExhaustedError,
+#: which readers treat as RETRYABLE WITH BACKOFF (pressure is per-executor
+#: and transient; the soft-watermark sweep clears it).
 SIZE_NOT_FOUND = -1
 SIZE_UNKNOWN_TENANT = -2
 SIZE_QUOTA_EXCEEDED = -3
+SIZE_RESOURCE_EXHAUSTED = -4
 #: CRC32C trailer appended to chunk / ReplicaPut headers when
 #: ``spark.shuffle.tpu.wire.checksum`` is on.  Receivers detect it by header
 #: length — the knob never changes frame layout when off (golden frames).
@@ -513,9 +519,17 @@ class BlockServer:
         # the scalable plane for many-tenant fan-in.
         self._reactor: Optional[Reactor] = None
         self._threads: list = []
-        if self.conf.server_workers > 0 or self.conf.tenants_enabled:
+        if (
+            self.conf.server_workers > 0
+            or self.conf.tenants_enabled
+            or self.conf.server_accept_backlog > 0
+        ):
+            # server.acceptBacklog implies the reactor plane: shedding needs
+            # the one place that owns the resident-connection count
             self._reactor = Reactor(
-                self.conf.server_workers, name=f"blocksrv-{self.address[1]}"
+                self.conf.server_workers,
+                name=f"blocksrv-{self.address[1]}",
+                accept_backlog=self.conf.server_accept_backlog,
             )
             self._reactor.add_listener(self._srv, self._on_accept)
         else:
@@ -612,6 +626,11 @@ class BlockServer:
                 # longer has: a typed, addressed admission failure — NOT the
                 # retryable block-not-found
                 return SIZE_QUOTA_EXCEEDED
+            except ResourceExhaustedError:
+                # restage-on-fetch hit the store's hard watermark: this
+                # executor is under memory pressure RIGHT NOW, but the
+                # eviction sweep clears it — retryable with backoff
+                return SIZE_RESOURCE_EXHAUSTED
             except TransportError:
                 return None
         return None
@@ -928,7 +947,7 @@ class BlockServer:
         self, conn: socket.socket, state: _ConnState, am_id: AmId, header: bytes, body: bytes
     ) -> None:
         peer, send_lock = state.peer, state.send_lock
-        faults.check("peer.server.frame", peer=peer, am_id=int(am_id))
+        faults.check("peer.server.frame", peer=peer, am_id=int(am_id), executor=self.executor_id)
         if am_id == AmId.FETCH_BLOCK_REQ:
             self._serve_fetch_req(conn, state, header)
         elif am_id == AmId.WIRE_HELLO:
@@ -1003,19 +1022,31 @@ class BlockServer:
                 "replica.apply", shuffle_id=sid, src_executor=src, round_idx=rnd
             )
             if self.store is not None:
-                if trace_ctx is not None and TRACER.active:
-                    # parent the apply under the pusher's replica.push span
-                    with TRACER.executor_scope(self.executor_id):
-                        with TRACER.activate(TRACER.remote_context(*trace_ctx)):
-                            with TRACER.span(
-                                "server.replica_apply",
-                                shuffle_id=sid,
-                                src_executor=src,
-                                round=rnd,
-                            ):
-                                self.store.put_replica(sid, src, rnd, entries, body)
-                else:
-                    self.store.put_replica(sid, src, rnd, entries, body)
+                try:
+                    if trace_ctx is not None and TRACER.active:
+                        # parent the apply under the pusher's replica.push span
+                        with TRACER.executor_scope(self.executor_id):
+                            with TRACER.activate(TRACER.remote_context(*trace_ctx)):
+                                with TRACER.span(
+                                    "server.replica_apply",
+                                    shuffle_id=sid,
+                                    src_executor=src,
+                                    round=rnd,
+                                ):
+                                    self.store.put_replica(sid, src, rnd, entries, body)
+                    else:
+                        self.store.put_replica(sid, src, rnd, entries, body)
+                except ResourceExhaustedError as e:
+                    # store hard watermark: handled like a crc mismatch —
+                    # discard, no ack — so the pusher's replication_wait
+                    # names this successor stalled instead of the serving
+                    # connection dying under memory pressure
+                    logger.warning(
+                        "replica round (shuffle=%d, src=%d, round=%d) from "
+                        "peer %s shed under memory pressure (%s) — not acked",
+                        sid, src, rnd, peer, e,
+                    )
+                    return
             with send_lock:
                 conn.sendall(
                     pack_frame(AmId.REPLICA_ACK, pack_replica_ack(sid, src, rnd))
@@ -1348,6 +1379,16 @@ class _PeerConnection:
                 am_id, hlen, blen = unpack_frame_header(hdr)
                 if hlen + blen > _MAX_FRAME:
                     raise ValueError(f"frame too large from peer {self.peer}")
+                if am_id == AmId.SERVER_BUSY:
+                    # load shed: the server refused this connection over its
+                    # accept backlog and closes right after.  Die typed so
+                    # in-flight batches fail RETRYABLE (backoff + retry)
+                    # instead of with the generic connection-lost error.
+                    self.last_error = ResourceExhaustedError(
+                        detail=f"peer {self.peer} shed the connection "
+                        "(accept backlog full)"
+                    )
+                    break
                 header = self._recv_exact(hlen) if hlen else b""
                 if hlen and header is None:
                     break
@@ -1497,6 +1538,47 @@ class _StripeRx:
         self.received = 0  # chunk payload bytes landed across all lanes
 
 
+#: EWMA smoothing factor for per-peer fetch latency and error rate — heavy
+#: enough that a handful of samples move the score, light enough that one
+#: outlier does not trip anything by itself.
+_HEALTH_ALPHA = 0.25
+
+#: Circuit-breaker states (closed = healthy traffic flows; open = peer is
+#: sick, new fetches skip it for the replica ring; half-open = cooldown
+#: elapsed, exactly one probe request is in flight to test recovery).
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+class _PeerHealth:
+    """Per-executor health score + circuit breaker; every field is guarded by
+    the transport's ``_health_lock`` (a leaf lock: no calls out while held)."""
+
+    __slots__ = (
+        "latency_ewma_ns",
+        "error_ewma",
+        "consecutive_failures",
+        "state",
+        "opened_at_ns",
+        "probe_inflight",
+        "successes",
+        "failures",
+        "trips",
+    )
+
+    def __init__(self) -> None:
+        self.latency_ewma_ns = 0.0  # EWMA of observed fetch completion latency
+        self.error_ewma = 0.0  # EWMA of the error indicator (1=fail, 0=ok)
+        self.consecutive_failures = 0
+        self.state = BREAKER_CLOSED
+        self.opened_at_ns = 0
+        self.probe_inflight = False
+        self.successes = 0
+        self.failures = 0
+        self.trips = 0
+
+
 class PeerTransport(ShuffleTransport):
     """ShuffleTransport over TCP peers — the socket twin of the loopback
     transport, used by multi-process deployments and the Spark shim."""
@@ -1561,6 +1643,13 @@ class PeerTransport(ShuffleTransport):
         #: driver / loopback harness); peer-observed wire failures and rejoin
         #: announcements feed it.  None = membership-unaware (the default).
         self.membership = None
+        #: Gray-failure plane: per-executor health scores + circuit breakers.
+        #: Scoring (latency/error EWMAs) is always on — pure bookkeeping, no
+        #: behavior change; the breaker only trips when
+        #: ``breaker.failureThreshold`` > 0.  _health_lock is a LEAF lock:
+        #: nothing is called while it is held.
+        self._health: Dict[ExecutorId, _PeerHealth] = {}  #: guarded by self._health_lock
+        self._health_lock = threading.Lock()
         #: Multi-tenant identity of this executor's fetches: with
         #: ``conf.tenants_enabled`` and an ``app_id`` set, every
         #: FETCH_BLOCK_REQ carries the tenant header extension and its triples
@@ -1734,6 +1823,132 @@ class PeerTransport(ShuffleTransport):
         except Exception:
             return None
 
+    # -- gray-failure plane: peer health + circuit breakers ----------------
+
+    def _health_of(self, executor_id: ExecutorId) -> _PeerHealth:
+        """Caller holds self._health_lock."""
+        h = self._health.get(executor_id)
+        if h is None:
+            h = self._health[executor_id] = _PeerHealth()
+        return h
+
+    def record_peer_success(self, executor_id: ExecutorId, latency_ns: int = 0) -> None:
+        """A fetch against ``executor_id`` completed: fold the latency into
+        the EWMA, clear the failure streak, and close a half-open breaker
+        (the probe came back)."""
+        with self._health_lock:
+            h = self._health_of(executor_id)
+            h.successes += 1
+            h.consecutive_failures = 0
+            h.error_ewma += _HEALTH_ALPHA * (0.0 - h.error_ewma)
+            if latency_ns > 0:
+                if h.latency_ewma_ns == 0.0:
+                    h.latency_ewma_ns = float(latency_ns)
+                else:
+                    h.latency_ewma_ns += _HEALTH_ALPHA * (latency_ns - h.latency_ewma_ns)
+            if h.state != BREAKER_CLOSED:
+                h.state = BREAKER_CLOSED
+                h.probe_inflight = False
+
+    def record_peer_failure(self, executor_id: ExecutorId, reason: str = "") -> None:
+        """A fetch against ``executor_id`` failed at the wire level (send
+        failure, dead connection, timeout).  Trips the breaker open once the
+        failure streak reaches ``breaker.failureThreshold`` (0 = never); a
+        failed half-open probe re-opens with a fresh cooldown."""
+        threshold = self.conf.breaker_failure_threshold
+        with self._health_lock:
+            h = self._health_of(executor_id)
+            h.failures += 1
+            h.consecutive_failures += 1
+            h.error_ewma += _HEALTH_ALPHA * (1.0 - h.error_ewma)
+            if threshold <= 0:
+                return
+            if h.state == BREAKER_HALF_OPEN or (
+                h.state == BREAKER_CLOSED and h.consecutive_failures >= threshold
+            ):
+                if h.state != BREAKER_OPEN:
+                    h.trips += 1
+                h.state = BREAKER_OPEN
+                h.opened_at_ns = time.monotonic_ns()
+                h.probe_inflight = False
+        if threshold > 0 and reason:
+            logger.debug("peer %s health: %s", executor_id, reason)
+
+    def breaker_allows(self, executor_id: ExecutorId) -> bool:
+        """Gate a new fetch against ``executor_id``.  Closed (or breaker off)
+        admits; open rejects until ``breaker.cooldownMs`` elapses, then flips
+        half-open and admits EXACTLY ONE probe — further fetches are rejected
+        until the probe resolves through record_peer_success/_failure."""
+        if self.conf.breaker_failure_threshold <= 0:
+            return True
+        with self._health_lock:
+            h = self._health.get(executor_id)
+            if h is None or h.state == BREAKER_CLOSED:
+                return True
+            if h.state == BREAKER_OPEN:
+                cooldown_ns = self.conf.breaker_cooldown_ms * 1_000_000
+                if time.monotonic_ns() - h.opened_at_ns < cooldown_ns:
+                    return False
+                h.state = BREAKER_HALF_OPEN
+                h.probe_inflight = True
+                return True
+            # half-open: one probe at a time
+            if h.probe_inflight:
+                return False
+            h.probe_inflight = True
+            return True
+
+    def breaker_state(self, executor_id: ExecutorId) -> str:
+        with self._health_lock:
+            h = self._health.get(executor_id)
+            return h.state if h is not None else BREAKER_CLOSED
+
+    def health_snapshot(self) -> Dict[int, Dict[str, object]]:
+        """Per-executor health view for postmortems (kill_executor captures
+        this) and white-box tests."""
+        with self._health_lock:
+            return {
+                eid: {
+                    "state": h.state,
+                    "latency_ewma_ns": int(h.latency_ewma_ns),
+                    "error_ewma": round(h.error_ewma, 4),
+                    "consecutive_failures": h.consecutive_failures,
+                    "successes": h.successes,
+                    "failures": h.failures,
+                    "trips": h.trips,
+                }
+                for eid, h in self._health.items()
+            }
+
+    def _health_view(self) -> Dict[str, int]:
+        """Metrics-registry leg (family ``health``): fleet-level roll-up of
+        the per-peer scores — counts by breaker state plus cumulative
+        success/failure/trip counters."""
+        with self._health_lock:
+            if not self._health:
+                return {}
+            out = {
+                "peers": len(self._health),
+                "open": 0,
+                "half_open": 0,
+                "successes": 0,
+                "failures": 0,
+                "trips": 0,
+                "latency_ewma_ns_max": 0,
+            }
+            for h in self._health.values():
+                if h.state == BREAKER_OPEN:
+                    out["open"] += 1
+                elif h.state == BREAKER_HALF_OPEN:
+                    out["half_open"] += 1
+                out["successes"] += h.successes
+                out["failures"] += h.failures
+                out["trips"] += h.trips
+                out["latency_ewma_ns_max"] = max(
+                    out["latency_ewma_ns_max"], int(h.latency_ewma_ns)
+                )
+            return out
+
     def _register_metrics_providers(self) -> None:
         """Wire this transport's scattered telemetry surfaces into the one
         registry: op summaries, per-lane wire counters, replication and
@@ -1761,6 +1976,9 @@ class PeerTransport(ShuffleTransport):
         self.metrics.register(
             "reactor", counter_dict_provider("reactor", self._reactor_view)
         )
+        self.metrics.register(
+            "health", counter_dict_provider("health", self._health_view)
+        )
         self.metrics.register("obs", tracer_provider(TRACER))
 
     def _elastic_view(self) -> Dict[str, int]:
@@ -1776,7 +1994,13 @@ class PeerTransport(ShuffleTransport):
 
     def _eviction_view(self) -> Dict[str, int]:
         ev = getattr(self.store, "eviction", None)
-        return ev.eviction_stats() if ev is not None else {}
+        out = dict(ev.eviction_stats()) if ev is not None else {}
+        # watermark-sweep telemetry rides the eviction family: sweeps ARE
+        # out-of-band eviction epochs, just triggered by store.softWatermark
+        wm = getattr(self.store, "watermark_stats", None)
+        if wm is not None:
+            out.update(wm())
+        return out
 
     def _reactor_view(self) -> Dict[str, int]:
         srv = self.server
@@ -2125,6 +2349,7 @@ class PeerTransport(ShuffleTransport):
             tag = self._next_tag
             self._next_tag += 1
             self._inflight[tag] = (reqs, bufs, cbs, None)
+        conn = None
         try:
             conn = self._connection(executor_id)
             with self._tag_lock:
@@ -2165,10 +2390,18 @@ class PeerTransport(ShuffleTransport):
             )
             self._evict(executor_id)
             self.note_peer_failed(executor_id, f"fetch send failed: {e}")
+            self.record_peer_failure(executor_id, f"fetch send failed: {e}")
             with self._tag_lock:
                 self._inflight.pop(tag, None)
                 self._stripe_rx.pop(tag, None)
             err = e if isinstance(e, TransportError) else TransportError(str(e))
+            # A send can race the recv thread tearing the socket down after a
+            # typed death (ServerBusy shed, crc mismatch): the OSError here is
+            # just "fd closed" — surface the recv loop's killer instead, same
+            # contract as _fail_conn_inflight.
+            base = getattr(conn, "last_error", None) if conn is not None else None
+            if isinstance(base, (BlockCorruptError, ResourceExhaustedError)):
+                err = base
             for req, buf, cb in zip(reqs, bufs, cbs):
                 req.stats.mark_done()
                 result = OperationResult(OperationStatus.FAILURE, error=err, stats=req.stats)
@@ -2219,9 +2452,11 @@ class PeerTransport(ShuffleTransport):
             )
             # Surface the recv loop's typed killer when it carries more signal
             # than "connection lost" — a crc mismatch (BlockCorruptError) must
-            # reach the reducer as corruption, not as a generic peer death.
+            # reach the reducer as corruption, and a load-shed
+            # (ResourceExhaustedError) as retryable pressure, not as a
+            # generic peer death.
             base = getattr(conn, "last_error", None)
-            if isinstance(base, BlockCorruptError):
+            if isinstance(base, (BlockCorruptError, ResourceExhaustedError)):
                 err: TransportError = base
             else:
                 err = TransportError(f"peer connection lost ({peer}, fetch tag {tag})")
@@ -2259,6 +2494,9 @@ class PeerTransport(ShuffleTransport):
                 if not conn.alive:
                     why = getattr(conn, "last_error", None)
                     self.note_peer_failed(
+                        eid, f"peer connection died: {why if why is not None else 'EOF'}"
+                    )
+                    self.record_peer_failure(
                         eid, f"peer connection died: {why if why is not None else 'EOF'}"
                     )
         if zombies:
@@ -2366,6 +2604,13 @@ class PeerTransport(ShuffleTransport):
                         -1,
                         detail=f"peer {peer} could not stage the block within quota",
                     )
+                elif size == SIZE_RESOURCE_EXHAUSTED:
+                    # gray-failure arm: the peer is under memory pressure —
+                    # typed retryable, readers back off and retry (same or a
+                    # replica holder) instead of failing the job
+                    err = ResourceExhaustedError(
+                        detail=f"peer {peer} is under memory pressure serving this block"
+                    )
                 else:
                     err = TransportError("block not found on peer")
                 result = OperationResult(
@@ -2389,6 +2634,10 @@ class PeerTransport(ShuffleTransport):
                         pos += size
                     buf.size = size
                     req.stats.mark_done(recv_size=size)
+                    if from_executor is not None:
+                        # health scoring: a completed fetch is this peer's
+                        # success sample (latency folds into the EWMA)
+                        self.record_peer_success(from_executor, req.stats.elapsed_ns())
                     result = OperationResult(OperationStatus.SUCCESS, stats=req.stats, data=buf)
                     if self.stats_agg is not None:
                         self.stats_agg.record("fetch", req.stats)

@@ -100,6 +100,45 @@ class TestClusterMembership:
         snap = m.snapshot()
         assert snap == {"epoch": 1, "alive": [0, 1, 2], "dead": {3: "gone"}}
 
+    def test_liveness_observation_clears_pending_suspicion(self):
+        """A gray peer that recovers inside the debounce window must restart
+        suspicion from scratch: mark_alive on an already-alive executor pops
+        the pending suspect entry (no epoch bump), so the NEXT error opens a
+        fresh window instead of inheriting the stale first-error timestamp."""
+        m = ClusterMembership(range(3), suspect_after_ms=30)
+        assert not m.suspect(2, "first error")  # window opens
+        time.sleep(0.04)  # window would have expired...
+        assert not m.mark_alive(2)  # ...but the peer was seen alive
+        assert m.epoch == 0
+        assert not m.suspect(2, "fresh error")  # fresh window, absorbed again
+        assert m.is_alive(2)
+        time.sleep(0.04)
+        assert m.suspect(2, "persisted past the fresh window")
+        assert not m.is_alive(2)
+
+    def test_flapping_storm_bumps_epoch_once_per_real_transition(self):
+        """The flapping scenario: a storm of suspicions and liveness flaps
+        against one executor.  Debounce absorbs every error inside the
+        window; the epoch moves exactly once per REAL transition (one death,
+        one rejoin) no matter how many observations piled up, so gossiping
+        peers re-applying known facts can never start a re-broadcast storm."""
+        m = ClusterMembership(range(4), suspect_after_ms=25)
+        for _ in range(20):  # error storm inside one window: all absorbed
+            assert not m.suspect(2, "flap")
+        assert m.epoch == 0 and m.is_alive(2)
+        time.sleep(0.04)
+        assert m.suspect(2, "persisted")  # the one real death...
+        assert m.epoch == 1
+        for _ in range(10):  # ...re-applying it is a no-op (no re-broadcast)
+            assert not m.suspect(2, "echo")
+            assert not m.mark_dead(2, "echo")
+        assert m.epoch == 1
+        assert m.mark_alive(2)  # the one real rejoin
+        assert m.epoch == 2
+        for _ in range(10):
+            assert not m.mark_alive(2)
+        assert m.epoch == 2 and m.dead() == {}
+
 
 # ---------------------------------------------------------------------------
 # degraded_plan units

@@ -379,3 +379,62 @@ class TestDemotedRoundReplication:
                 assert ts[1].store.read_block(6, m, r) == data
         finally:
             _close_all(ts)
+
+
+# ---------------------------------------------------------------------------
+# gray-failure fault factories + chaos-kill postmortems
+# ---------------------------------------------------------------------------
+
+
+class TestGrayFactories:
+    def test_garble_matches_per_byte_xor(self):
+        """The vectorized garble must corrupt EXACTLY like the per-byte XOR it
+        replaced — chaos tests pin corrupted-frame bytes, so the fast path
+        cannot drift from the reference semantics."""
+        rng = np.random.default_rng(123)
+        data = rng.integers(0, 256, size=1 << 16, dtype=np.uint8).tobytes()
+        faults.arm("p", faults.garble(0x5A))
+        out = bytes(faults.transform("p", data))
+        assert out == bytes(b ^ 0x5A for b in data)
+
+    def test_throttle_paces_and_preserves_bytes(self):
+        faults.arm("p", faults.throttle(10_000))  # 10 kB/s
+        data = b"z" * 1000  # ~0.1 s at the armed rate
+        t0 = time.monotonic()
+        out = faults.transform("p", data)
+        assert time.monotonic() - t0 >= 0.08  # paced...
+        assert bytes(out) == data  # ...but every byte still bit-identical
+
+    def test_flaky_is_seed_deterministic(self):
+        def pattern(seed):
+            act = faults.flaky(0.5, seed=seed)
+            hits = []
+            for _ in range(64):
+                try:
+                    act()
+                    hits.append(False)
+                except ConnectionResetError:
+                    hits.append(True)
+            return hits
+
+        assert pattern(7) == pattern(7)  # same seed replays the same failures
+        assert any(pattern(7)) and not all(pattern(7))
+        assert pattern(7) != pattern(8)
+
+    def test_kill_executor_idempotent_with_health_postmortem(self):
+        """kill_executor captures the dying executor's peer-health/breaker
+        view into its postmortem bundle BEFORE the kill, and a second kill of
+        the same transport is a no-op (real processes die once)."""
+        ts = _cluster(2)
+        try:
+            ts[1].record_peer_failure(0, "synthetic pre-kill failure")
+            faults.kill_executor(ts[1])
+            pm = ts[1].recorder.last_postmortem
+            assert pm is not None and pm["reason"] == "chaos_kill"
+            assert pm["context"]["executor"] == 1
+            assert "failures" in pm["context"]["peer_health"]
+            seq = pm["seq"]
+            faults.kill_executor(ts[1])  # idempotent: no second bundle
+            assert ts[1].recorder.last_postmortem["seq"] == seq
+        finally:
+            _close_all(ts)
