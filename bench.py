@@ -668,6 +668,37 @@ def main():
         except Exception as e:
             RESULT["skew_error"] = f"{type(e).__name__}: {e}"[:200]
         try:
+            # Adaptive exchange planning (ops/planner.py): the telemetry-fed
+            # AdaptivePlanner re-planning per cell of a skew x payload-entropy
+            # x fault matrix vs every static (quota, codec) config held fixed
+            # across it.  The exchange leg is measured, the serve-plane legs
+            # are modeled from measured inputs (encode time/bytes, hedge vs a
+            # gray straggler); bit-equality of every chunked schedule against
+            # the single-shot reference is asserted inside measure_adaptive.
+            if budget_left() < 90:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            import jax
+
+            n_ad = min(8, jax.device_count())
+            from sparkucx_tpu.perf.benchmark import measure_adaptive
+
+            ad = measure_adaptive(n_ad, 512, max(2, REPEATS))
+            worst = max(ad["cells"], key=lambda c: c["distance_from_oracle"])
+            RESULT["adaptive"] = {
+                "executors": n_ad,
+                "cells": len(ad["cells"]),
+                "aggregate_adaptive_gbps": ad["aggregate_adaptive_gbps"],
+                "best_static": ad["best_static"],
+                "best_static_gbps": ad["best_static_gbps"],
+                "beats_every_static": ad["adaptive_beats_every_static"],
+                "worst_cell_distance": ad["worst_cell_distance"],
+                "worst_cell": f"alpha={worst['alpha']} entropy={worst['entropy']} "
+                              f"fault={worst['fault']}",
+                "bit_identical": all(c["bit_identical"] for c in ad["cells"]),
+            }
+        except Exception as e:
+            RESULT["adaptive_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
             # FAST-scheduled ring exchange (ops/ici_exchange.py) vs the stock
             # collective at the widest mesh this backend exposes, plus the
             # fused send side's single-launch check.  Bit equality between the

@@ -94,11 +94,6 @@ ALLOWLIST = {
     ("core/block.py", "private-access", "._mmap"),
     ("shuffle/daemon.py", "private-access", "._sendmsg_all"),
     ("transport/peer.py", "private-access", "._sendmsg_all"),
-    ("transport/tpu.py", "host-sync", "drain stage"),
-    ("transport/spmd.py", "host-sync", "drain stage"),
-    ("transport/spmd.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit'"),
-    ("transport/tpu.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit' (via '_assemble')"),
-    ("transport/tpu.py", "host-sync", "'np.asarray' in pipeline submit stage '_submit_quota'"),
     ("transport/tpu.py", "host-sync", "(via '_recover_and_rerun')"),
     ("store/hbm_store.py", "cache-hygiene", "'out_rows'"),
 }
@@ -316,6 +311,8 @@ OFF_PATH_DEFAULTS = {
     "keep_device_recv": False,
     "use_shm_staging": False,
     "slot_quota_rows": 0,
+    "planner_mode": "static",
+    "planner_optimize": False,
     "host_recv_mode": "array",
     "sanitize": False,
     "fetch_hedge_ms": 0,

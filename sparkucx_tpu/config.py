@@ -372,6 +372,28 @@ class TpuShuffleConf:
     #: the planner entirely — the unchunked path runs byte-for-byte as before.
     slot_quota_rows: int = 0
 
+    #: Exchange planner selection (ops/planner.py).  'static' (default) maps
+    #: the legacy knobs 1:1 onto an ExchangePlan — byte-identical outputs and
+    #: wire frames.  'adaptive' re-plans per shuffle per epoch from the
+    #: telemetry plane: quota/chunking from the sealed size matrices, hedge
+    #: delay from rx stall tails + peer health, codec from observed
+    #: compression ratios, streams from credit stalls, depth from drain-lane
+    #: occupancy.  Results stay bit-identical either way — plans only change
+    #: the schedule, never the bytes.
+    planner_mode: str = "static"
+    #: Run the plan-optimization passes (pow2 slot bucketing, chunk
+    #: coalescing, staging-footprint sub-round reordering per
+    #: arXiv:2112.01075) over static plans.  Off (default) keeps the legacy
+    #: schedule verbatim; adaptive plans always optimize.
+    planner_optimize: bool = False
+    #: Adaptive planner only: when the single-shot plan's predicted staging
+    #: padding fraction (from the sealed size matrices) exceeds this, switch
+    #: to a quota-chunked plan sized near the mean lane.
+    planner_target_padding: float = 0.5
+    #: Adaptive planner only: floor for a telemetry-derived slot quota, so
+    #: extreme skew cannot chunk a shuffle into thousands of tiny sub-rounds.
+    planner_min_quota_rows: int = 256
+
     # instrumentation
     collect_stats: bool = True
 
@@ -495,6 +517,10 @@ class TpuShuffleConf:
             ("server.workers", "server_workers", int),
             ("pipelineDepth", "pipeline_depth", int),
             ("slotQuotaRows", "slot_quota_rows", int),
+            ("planner.mode", "planner_mode", str),
+            ("planner.optimize", "planner_optimize", lambda v: str(v).lower() == "true"),
+            ("planner.targetPaddingFraction", "planner_target_padding", float),
+            ("planner.minQuotaRows", "planner_min_quota_rows", int),
             ("deviceStaging", "device_staging", lambda v: str(v).lower() == "true"),
             ("sanitize", "sanitize", lambda v: str(v).lower() == "true"),
             ("obs.traceContext", "obs_trace_context", lambda v: str(v).lower() == "true"),
@@ -534,6 +560,12 @@ class TpuShuffleConf:
             raise ValueError("pipeline_depth must be >= 1 (1 = serial engine)")
         if self.slot_quota_rows < 0:
             raise ValueError("slot_quota_rows must be >= 0 (0 = no quota)")
+        if self.planner_mode not in ("static", "adaptive"):
+            raise ValueError(f"unknown planner_mode {self.planner_mode!r}")
+        if not (0 <= self.planner_target_padding < 1):
+            raise ValueError("planner_target_padding must be in [0, 1)")
+        if self.planner_min_quota_rows < 1:
+            raise ValueError("planner_min_quota_rows must be >= 1")
         if self.wire_streams < 1:
             raise ValueError("wire_streams must be >= 1 (1 = single-lane wire)")
         if self.wire_chunk_bytes < 4096:

@@ -63,12 +63,47 @@ def quota_slot_rows(slot_rows: int, quota_rows: int) -> int:
 
 @dataclass(frozen=True)
 class ExchangePlan:
-    """One shuffle's sub-round schedule: ``chunks_per_round[r]`` quota-sized
-    sub-rounds cover staging round ``r``'s hottest lane.  ``slot_rows`` is the
-    quota-capped per-peer slot every sub-round stages (the compile bucket)."""
+    """One shuffle's declarative exchange schedule — THE exchange interface.
+
+    The geometry core is unchanged: ``chunks_per_round[r]`` quota-sized
+    sub-rounds cover staging round ``r``'s hottest lane, and ``slot_rows`` is
+    the per-peer slot every sub-round stages (the compile bucket).  Around it,
+    the plan now carries everything the unified executor
+    (transport/executor.py) interprets and the serve plane reads:
+
+    * ``single_shot`` — drain style.  True is the historical quota-off
+      engine: whole padded shards retained directly (supports donation of
+      device-sealed payloads and elastic degraded recovery).  False is the
+      chunked engine: each staging round's tight sub-round shards are
+      spliced back into the exact single-shot layout (bit-identical over the
+      valid prefix; no trailing padding kept).
+    * ``round_order`` — submission order over staging rounds (a permutation;
+      empty = natural order).  Produced by the staging-footprint reordering
+      pass (ops/planner.py, after arXiv:2112.01075); results are always
+      emitted back in natural round order.
+    * ``lowering`` — the collective tier (``conf.exchange_impl`` vocabulary:
+      'stock' | 'pallas' | 'auto'), interpreted by ``build_plan_exchange``.
+    * ``pipeline_depth`` — the superstep overlap window for this shuffle.
+    * ``streams`` / ``codec`` / ``quantize_mode`` + ``quantize_block`` /
+      ``hedge_ms`` — the serve/wire-plane tiers chosen for this shuffle's
+      traffic (fetch striping, page codec, lossy aggregation quantization,
+      hedged-fetch delay).  The collective executor never quantizes shuffle
+      bytes (payloads are exact); these fields parameterize the fetch path,
+      the aggregation plane, and the bench harness, and land in the per-
+      shuffle ``exchange.plan`` trace event.
+    """
 
     slot_rows: int
     chunks_per_round: Tuple[int, ...]
+    single_shot: bool = False
+    round_order: Tuple[int, ...] = ()
+    lowering: str = "stock"
+    pipeline_depth: int = 2
+    streams: int = 1
+    codec: str = "off"
+    quantize_mode: str = "off"
+    quantize_block: int = 128
+    hedge_ms: int = 0
 
     @property
     def num_subrounds(self) -> int:
@@ -84,6 +119,25 @@ class ExchangePlan:
                 out.append((rnd, chunk, nchunks))
         return out
 
+    def ordered_subrounds(self) -> List[Tuple[int, int, int]]:
+        """``subrounds()`` permuted by ``round_order``: whole staging rounds
+        are reordered as units (chunk order within a round is load-bearing —
+        the splice reassembles in chunk order), so the executor can submit
+        lighter rounds first while the drain still groups by round."""
+        if not self.round_order:
+            return self.subrounds()
+        if sorted(self.round_order) != list(range(len(self.chunks_per_round))):
+            raise ValueError(
+                f"round_order {self.round_order} is not a permutation of "
+                f"{len(self.chunks_per_round)} staging rounds"
+            )
+        out: List[Tuple[int, int, int]] = []
+        for rnd in self.round_order:
+            nchunks = self.chunks_per_round[rnd]
+            for chunk in range(nchunks):
+                out.append((rnd, chunk, nchunks))
+        return out
+
     def staged_rows(self, num_executors: int) -> int:
         """Total staged rows across the whole exchange (``n`` executors x
         ``n`` slots x ``slot_rows``, summed over sub-rounds) — the memory/wire
@@ -91,6 +145,24 @@ class ExchangePlan:
         times ``row_bytes`` is exactly the wire traffic."""
         n = num_executors
         return self.num_subrounds * n * n * self.slot_rows
+
+    def describe(self) -> dict:
+        """JSON-safe flat view for the per-shuffle ``exchange.plan`` trace
+        event and the flight recorder (every value a scalar or short list)."""
+        return {
+            "slot_rows": self.slot_rows,
+            "chunks_per_round": list(self.chunks_per_round),
+            "num_subrounds": self.num_subrounds,
+            "single_shot": self.single_shot,
+            "round_order": list(self.round_order),
+            "lowering": self.lowering,
+            "pipeline_depth": self.pipeline_depth,
+            "streams": self.streams,
+            "codec": self.codec,
+            "quantize_mode": self.quantize_mode,
+            "quantize_block": self.quantize_block,
+            "hedge_ms": self.hedge_ms,
+        }
 
 
 def plan_exchange(

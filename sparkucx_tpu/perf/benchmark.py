@@ -129,8 +129,8 @@ def _parse_args(argv):
         "mode",
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
-            "columnar", "groupby", "join", "write", "skew", "wire", "ici",
-            "failover", "elastic", "compress", "tenants", "obs", "gray",
+            "columnar", "groupby", "join", "write", "skew", "adaptive", "wire",
+            "ici", "failover", "elastic", "compress", "tenants", "obs", "gray",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -2069,6 +2069,322 @@ def run_skew(args) -> None:
     )
 
 
+def measure_adaptive(
+    executors: int = 8, max_peer_rows: int = 2048, iterations: int = 2,
+    link_gbps: float = 1.0, stall_ms: float = 40.0, report=None,
+) -> dict:
+    """Measurement core of the ``adaptive`` mode — the telemetry-fed
+    AdaptivePlanner (ops/planner.py) against every static configuration on a
+    skew x payload-entropy x fault cell matrix.
+
+    Per cell the EXCHANGE leg is measured (the same machinery as
+    ``measure_skew``: compiled collective over the loopback mesh, best-of-N
+    wall time, bit-equality of every chunked schedule's reassembled shards
+    against the single-shot reference), while the SERVE-plane legs are
+    modeled from measured inputs, because loopback has no real wire: codec
+    cost = measured ``encode_chunk`` time + shipped bytes / ``link_gbps``
+    (encoded bytes measured per cell payload), and the fault cell charges a
+    gray straggler of ``5 x stall_ms`` to any config that does not hedge,
+    vs ``hedge_ms + one peer-shard refetch`` for one that does (the
+    docs/PERF.md hedged-fetch measurements are the grounding for that shape).
+
+    Static candidates: quota arms {single-shot, the adaptive quota formula's
+    pick, 2x it} x codec {off, rle}, all with hedging off — the legacy knob
+    grid an operator would sweep by hand.  The adaptive arm builds real
+    ``PlanSignals`` per cell (observed compression ratio from the sample
+    encode; the fault cell's stall tail and degraded peer health) and
+    executes whatever plan ``AdaptivePlanner`` returns.  Reported per cell:
+    every arm's effective GB/s, the static oracle (best arm), the adaptive
+    arm's distance from it, and the plan fields it chose; aggregate = mean
+    GB/s over cells, adaptive vs each static config held fixed across the
+    matrix.  Shared by the CLI and bench.py."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.ops.compress import CompressSpec, encode_chunk
+    from sparkucx_tpu.ops.exchange import (
+        ExchangeSpec, bucket_send_rows, build_exchange, make_mesh,
+    )
+    from sparkucx_tpu.ops.planner import AdaptivePlanner, PlanContext, PlanSignals
+    from sparkucx_tpu.ops.skew import (
+        chunk_size_rows, plan_exchange, reassemble_round, slice_subround,
+    )
+
+    n = executors
+    row_bytes = 512
+    lane = row_bytes // 4
+    mesh = make_mesh(n)
+    sharding = NamedSharding(mesh, P("ex", None))
+    fns: dict = {}
+
+    def exchange_fn(rows):
+        fn = fns.get(rows)
+        if fn is None:
+            fn = fns[rows] = build_exchange(
+                mesh,
+                ExchangeSpec(num_executors=n, send_rows=rows, recv_rows=rows, lane=lane),
+            )
+        return fn
+
+    def prepare_arm(payloads, sizes, slot, quota):
+        """Build one quota arm's exchange leg: compiled schedule, warmed up,
+        reassembled tight shards for the bit-equality gate.  Returns a dict
+        with the replayable ``shot`` thunk (timed later, INTERLEAVED across
+        arms — back-to-back per-arm loops pick up correlated scheduler noise
+        on the loopback CPU mesh).  quota == 0 is the single-shot arm (one
+        chunk at the full slot)."""
+        plan = plan_exchange([int(sizes.max())], slot, quota)
+        q, nchunks = plan.slot_rows, plan.chunks_per_round[0]
+        fn = exchange_fn(n * q)
+        sub_size_mats = [
+            np.stack([chunk_size_rows(sizes[i], c, q) for i in range(n)])
+            for c in range(nchunks)
+        ]
+        size_mats = [jax.device_put(m, sharding) for m in sub_size_mats]
+        sub_payloads = [
+            np.concatenate([slice_subround(p, n, c, q) for p in payloads])
+            for c in range(nchunks)
+        ]
+
+        def shot():
+            outs = []
+            for c in range(nchunks):
+                recv, _ = fn(jax.device_put(sub_payloads[c], sharding), size_mats[c])
+                outs.append(recv)
+            jax.block_until_ready(outs[-1])
+            return outs
+
+        outs = shot()  # warmup/compile + the compared output
+        devices = list(mesh.devices.reshape(-1))
+        shards = []
+        for j in range(n):
+            sub_shards = [
+                np.asarray(
+                    next(s.data for s in o.addressable_shards if s.device == devices[j])
+                ).reshape(-1).view(np.uint8)
+                for o in outs
+            ]
+            shards.append(
+                bytes(reassemble_round(sub_shards, [m[:, j] for m in sub_size_mats], row_bytes))
+            )
+        return {
+            "shot": shot,
+            "shards": shards,
+            "staged": plan.staged_rows(n),
+            "best": float("inf"),
+        }
+
+    rle = CompressSpec(codec="rle", min_chunk_bytes=0)
+    straggler_s = 5.0 * stall_ms / 1e3  # gray tail: well past the p99 signal
+
+    def serve_time(raw_bytes, enc_bytes, enc_s, codec, hedge_ms, fault):
+        ship = enc_bytes if codec != "off" else raw_bytes
+        t = ship / (link_gbps * 1e9) + (enc_s if codec != "off" else 0.0)
+        if fault == "degraded":
+            if hedge_ms <= 0:
+                t += straggler_s
+            else:
+                t += min(straggler_s, hedge_ms / 1e3) + (
+                    raw_bytes / n / (link_gbps * 1e9)
+                )
+        return t
+
+    cells = []
+    rng = np.random.default_rng(3)
+    base = 512  # pow2 floor of the requested hottest lane, min 512
+    while base * 2 <= max_peer_rows:
+        base *= 2
+    for alpha in (0.0, 1.8):
+        # balanced cells stage padding-free at a pow2 hottest lane; skewed
+        # cells put the hottest lane just past the pow2 boundary — the
+        # geometry where chunking beats the single-shot round-up (the same
+        # regime the docs/PERF.md skew table pins)
+        hot = base if alpha == 0.0 else base * 5 // 4
+        sizes = zipf_size_matrix(n, hot, alpha)
+        slot = bucket_send_rows(int(sizes.max()) * n, n) // n
+        used_rows = int(sizes.sum())
+        useful = used_rows * row_bytes
+        # static quota candidates keep only DISTINCT footprints: a quota whose
+        # chunked schedule stages exactly the single-shot row count moves the
+        # same bytes in more launches — same config class, and its loopback
+        # delta is dispatch granularity (CPU cache effects), not plan quality
+        single_staged = plan_exchange([int(sizes.max())], slot, 0).staged_rows(n)
+        quotas = sorted(
+            q
+            for q in {0, max(256, slot // 4), max(256, slot // 2)}
+            if q == 0
+            or plan_exchange([int(sizes.max())], slot, q).staged_rows(n) < single_staged
+        )
+        for entropy in ("low", "high"):
+            # slot-layout staging payloads: zeros (RLE-collapsible) vs
+            # full-range random rows (incompressible — RLE ships raw)
+            payloads = []
+            for i in range(n):
+                p = np.zeros((n * slot, lane), dtype=np.int32)
+                if entropy == "high":
+                    for j in range(n):
+                        p[j * slot : j * slot + sizes[i, j]] = rng.integers(
+                            -(2**30), 2**30, size=(int(sizes[i, j]), lane), dtype=np.int32
+                        )
+                payloads.append(p)
+            # arms cached by REALIZED schedule (slot, chunks): distinct conf
+            # quotas that lower to the same sub-round schedule share one
+            # measurement, so identical schedules can't diverge by CPU noise
+            arm_cache: dict = {}
+
+            def arm(quota):
+                p = plan_exchange([int(sizes.max())], slot, quota)
+                key = (p.slot_rows, p.chunks_per_round[0])
+                if key not in arm_cache:
+                    arm_cache[key] = prepare_arm(payloads, sizes, slot, quota)
+                return arm_cache[key]
+
+            conf = TpuShuffleConf(
+                planner_mode="adaptive",
+                wire_compress_codec="rle",
+                fetch_hedge_ms=1,
+                fetch_hedge_max_ms=int(stall_ms * 4),
+            )
+
+            def plan_ctx(signals):
+                return PlanContext(
+                    num_executors=n,
+                    staging_slot_rows=slot,
+                    round_max_rows=(int(sizes.max()),),
+                    used_rows_total=used_rows,
+                    row_bytes=row_bytes,
+                    platform="cpu",
+                    signals=signals,
+                )
+
+            # the adaptive quota is geometry-only (SPMD lockstep discipline),
+            # so it is known before any fault cell: prepare its arm alongside
+            # the static candidates, then bit-equality-gate every schedule
+            neutral = AdaptivePlanner(conf).plan(plan_ctx(PlanSignals()))
+            ad_q = 0 if neutral.single_shot else neutral.slot_rows
+            ref = arm(0)["shards"]  # single-shot reference shards
+            for q in sorted(set(quotas) | {ad_q}):
+                shards = arm(q)["shards"]
+                for j in range(n):
+                    assert shards[j] == ref[j], (
+                        f"quota {q} diverged from single-shot on consumer {j}"
+                    )
+            # interleaved best-of timing: one pass times every arm once, so
+            # slow-drift scheduler noise hits all arms alike
+            for _ in range(max(2, iterations)):
+                for a in arm_cache.values():
+                    t0 = time.perf_counter()
+                    a["shot"]()
+                    a["best"] = min(a["best"], time.perf_counter() - t0)
+            # measured codec leg on the reference shards (what the serve
+            # plane would ship): encoded bytes + encode seconds
+            enc_bytes, t0 = 0, time.perf_counter()
+            for shard in ref:
+                _, enc = encode_chunk(rle, shard)
+                enc_bytes += len(enc) if enc is not None else len(shard)
+            enc_s = time.perf_counter() - t0
+            for fault in ("none", "degraded"):
+                statics = {}
+                for q in quotas:
+                    ex_s = arm(q)["best"]
+                    for codec in ("off", "rle"):
+                        name = f"{'single' if q == 0 else f'q{q}'}/{codec}"
+                        t = ex_s + serve_time(useful, enc_bytes, enc_s, codec, 0, fault)
+                        statics[name] = useful / t / 1e9
+                signals = PlanSignals(
+                    rx_stall_p99_ns=int(stall_ms * 1e6) if fault == "degraded" else 0,
+                    worst_peer_health=0.3 if fault == "degraded" else 1.0,
+                    compression_ratio=useful / max(enc_bytes, 1),
+                )
+                plan = AdaptivePlanner(conf).plan(plan_ctx(signals))
+                assert (0 if plan.single_shot else plan.slot_rows) == ad_q
+                ad_ex_s = arm(ad_q)["best"]
+                hedge = plan.hedge_ms if fault == "degraded" else 0
+                ad_t = ad_ex_s + serve_time(
+                    useful, enc_bytes, enc_s, plan.codec, hedge, fault
+                )
+                ad_gbps = useful / ad_t / 1e9
+                oracle_name, oracle_gbps = max(statics.items(), key=lambda kv: kv[1])
+                cell = {
+                    "alpha": alpha,
+                    "entropy": entropy,
+                    "fault": fault,
+                    "static_gbps": {k: round(v, 4) for k, v in statics.items()},
+                    "oracle": oracle_name,
+                    "oracle_gbps": round(oracle_gbps, 4),
+                    "adaptive_gbps": round(ad_gbps, 4),
+                    "distance_from_oracle": round(1.0 - ad_gbps / oracle_gbps, 4),
+                    "adaptive_choice": {
+                        "quota": ad_q,
+                        "codec": plan.codec,
+                        "hedge_ms": plan.hedge_ms,
+                        "subrounds": plan.num_subrounds,
+                    },
+                    "bit_identical": True,
+                }
+                cells.append(cell)
+                if report is not None:
+                    report(cell)
+    # aggregate: each static config held fixed across the whole matrix vs
+    # the adaptive planner re-planning per cell
+    static_names = sorted({k for c in cells for k in c["static_gbps"]})
+    agg_static = {
+        name: sum(c["static_gbps"].get(name, 0.0) for c in cells) / len(cells)
+        for name in static_names
+    }
+    agg_adaptive = sum(c["adaptive_gbps"] for c in cells) / len(cells)
+    best_static = max(agg_static.items(), key=lambda kv: kv[1])
+    return {
+        "executors": n,
+        "max_peer_rows": max_peer_rows,
+        "link_gbps_model": link_gbps,
+        "stall_ms_model": stall_ms,
+        "cells": cells,
+        "aggregate_static_gbps": {k: round(v, 4) for k, v in agg_static.items()},
+        "aggregate_adaptive_gbps": round(agg_adaptive, 4),
+        "best_static": best_static[0],
+        "best_static_gbps": round(best_static[1], 4),
+        "adaptive_beats_every_static": agg_adaptive >= best_static[1],
+        "worst_cell_distance": round(
+            max(c["distance_from_oracle"] for c in cells), 4
+        ),
+    }
+
+
+def run_adaptive(args) -> None:
+    size = parse_size(args.block_size)
+    max_peer_rows = max(512, size // 512)
+
+    def report(cell):
+        print(
+            f"cell alpha={cell['alpha']} entropy={cell['entropy']} "
+            f"fault={cell['fault']}: adaptive {cell['adaptive_gbps']:.3f} GB/s "
+            f"(chose quota={cell['adaptive_choice']['quota']} "
+            f"codec={cell['adaptive_choice']['codec']} "
+            f"hedge={cell['adaptive_choice']['hedge_ms']}ms) vs oracle "
+            f"{cell['oracle']} {cell['oracle_gbps']:.3f} GB/s "
+            f"(distance {cell['distance_from_oracle']:+.1%})",
+            flush=True,
+        )
+
+    r = measure_adaptive(
+        args.executors, max_peer_rows, args.iterations, report=report
+    )
+    print(
+        f"aggregate over {len(r['cells'])} cells: adaptive "
+        f"{r['aggregate_adaptive_gbps']:.3f} GB/s vs best static "
+        f"{r['best_static']} {r['best_static_gbps']:.3f} GB/s "
+        f"(beats every static: {r['adaptive_beats_every_static']}); "
+        f"worst cell distance {r['worst_cell_distance']:+.1%}; "
+        f"outputs bit-identical",
+        flush=True,
+    )
+
+
 def measure_ici(
     executors_list=(2, 4, 8), slot_rows: int = 1024, lane: int = 128,
     chunks_per_dest: int = 0, iterations: int = 5, report=None, stats=None,
@@ -2772,6 +3088,8 @@ def main(argv=None) -> None:
         run_gray(args)
     elif args.mode == "skew":
         run_skew(args)
+    elif args.mode == "adaptive":
+        run_adaptive(args)
     elif args.mode == "ici":
         run_ici(args)
     elif args.mode == "sort":

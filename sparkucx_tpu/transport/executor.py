@@ -1,0 +1,207 @@
+"""The unified plan executor — ONE engine interprets every ``ExchangePlan``.
+
+Before this module, the exchange engine existed four times: the single-shot
+and quota-capped loops in ``transport/tpu.py``, and their SPMD twins in
+``transport/spmd.py`` — each duplicating the sub-round walk, the drain-side
+chunk accounting, the occupancy telemetry, and (in the transports' builder
+methods) the stock/pallas/hierarchical/quantized variant dispatch.  Every
+capability multiplied that matrix.  Now the matrix is a *plan*
+(``ops/skew.ExchangePlan``): a planner (``ops/planner.py``) chooses the
+schedule, and :func:`execute_plan` interprets it, for both deployments.
+
+The split of responsibilities is deliberate:
+
+* This module owns everything *plan-shaped*: the sub-round submission order
+  (including the staging-footprint permutation, re-emitting results in
+  natural round order), the per-round chunk accumulation on the single drain
+  worker, final-chunk completion, the ``RoundPipeline`` wiring, and the
+  occupancy/bytes telemetry contract (intermediate chunks record zero rows;
+  a round's final chunk records the round's staging occupancy — exactly the
+  stat stream the retired engines produced).
+* The transports own everything *deployment-shaped*, passed in as closures:
+  how a sub-round's payload is assembled and dispatched (global-array
+  assembly vs per-process shards), how a chunk is materialized host-side,
+  and how a finished round's chunks splice into the receive state
+  (host_recv_mode arms, memmap spill, device retention, elastic probes).
+  Closures keep each transport's private state in its own module — the
+  whole-program private-access pass stays clean by construction.
+
+``single_shot`` plans (one chunk per round) run the historical quota-off
+engine through the same loop: the chunk IS the round, ``finish_round`` sees
+exactly one part, and the no-copy donation / elastic-recovery behavior lives
+in the transport's closures.  Bit-equality of both styles against the
+retired engines is pinned in tests/test_planner.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange
+from sparkucx_tpu.ops.skew import ExchangePlan
+from sparkucx_tpu.transport.pipeline import RoundPipeline
+from sparkucx_tpu.utils.stats import StatsAggregator
+
+#: every receive mode any deployment understands, in doc order
+HOST_RECV_MODES: Tuple[str, ...] = ("array", "memmap", "device")
+
+
+def validate_host_recv_mode(
+    mode: str,
+    *,
+    allowed: Sequence[str] = HOST_RECV_MODES,
+    where: str = "this transport",
+) -> str:
+    """THE ``host_recv_mode`` gate — called before any staging allocation.
+
+    Two distinct failures, same everywhere (the check used to be copy-pasted
+    per transport): an *unknown* mode is a typo (``ValueError`` naming the
+    full vocabulary), while a known mode a deployment cannot serve (the SPMD
+    executor releases its HBM shard after the collective, so ``'device'``
+    has nothing to serve fetches from) names the deployment and what it does
+    support."""
+    if mode not in HOST_RECV_MODES:
+        raise ValueError(f"unknown host_recv_mode {mode!r} (array|memmap|device)")
+    if mode not in allowed:
+        raise ValueError(
+            f"host_recv_mode {mode!r} is not supported by {where} "
+            f"({'|'.join(allowed)})"
+        )
+    return mode
+
+
+def build_plan_exchange(
+    mesh,
+    *,
+    num_executors: int,
+    send_rows: int,
+    lane: int,
+    axis_name: str,
+    impl: str,
+    num_slices: int = 1,
+    quantize=None,
+):
+    """THE lowering dispatch: one compiled exchange for a plan's geometry.
+
+    Subsumes the builder ladders that lived (twice, copy-pasted) in the
+    transports and the quantized-variant routing in ``ops/ici_exchange.py``:
+    ``impl`` is the *resolved* tier (``resolve_exchange_impl`` over the
+    plan's ``lowering`` field), ``num_slices > 1`` selects the two-phase
+    ICI+DCN route, and a ``QuantizeSpec`` routes to the lossy aggregation
+    exchange.  Callers keep their own compile caches (and their cache keys —
+    the bucketing discipline the cache-hygiene pass audits); this function
+    is the single place a key miss turns into a lowering."""
+    spec = ExchangeSpec(
+        num_executors=num_executors,
+        send_rows=send_rows,
+        recv_rows=send_rows,  # worst case: all regions full
+        lane=lane,
+        axis_name=axis_name,
+        impl="auto",
+    )
+    if quantize is not None:
+        from sparkucx_tpu.ops.ici_exchange import build_quantized_exchange
+
+        # The quantized exchange is inherently the scheduled ring; ``impl``
+        # (stock|pallas) does not map onto its ICI lowering vocabulary
+        # (auto|dma|xla|interpret) — let it resolve per platform.
+        return build_quantized_exchange(mesh, spec, quantize)
+    if num_slices > 1:
+        # multi-slice: two-phase ICI+DCN route over the same devices,
+        # slice-major (ops/hierarchy.py)
+        from sparkucx_tpu.ops.hierarchy import (
+            build_hierarchical_exchange,
+            make_hierarchical_mesh,
+        )
+
+        hmesh = make_hierarchical_mesh(
+            num_slices,
+            num_executors // num_slices,
+            devices=list(mesh.devices.reshape(-1)),
+        )
+        if impl == "pallas":
+            from sparkucx_tpu.ops.ici_exchange import (
+                DEFAULT_CHUNKS_PER_DEST,
+                build_ici_exchange,
+            )
+
+            return build_ici_exchange(
+                hmesh, spec.resolve_impl(), chunks_per_dest=DEFAULT_CHUNKS_PER_DEST
+            )
+        return build_hierarchical_exchange(hmesh, spec.resolve_impl())
+    if impl == "pallas":
+        # FAST-scheduled ring exchange (ops/ici_exchange.py): bit-identical
+        # results, remote-DMA kernel on TPU, scheduled permutes elsewhere
+        from sparkucx_tpu.ops.ici_exchange import (
+            DEFAULT_CHUNKS_PER_DEST,
+            build_ici_exchange,
+        )
+
+        return build_ici_exchange(mesh, spec, chunks_per_dest=DEFAULT_CHUNKS_PER_DEST)
+    return build_exchange(mesh, spec)
+
+
+def execute_plan(
+    plan: ExchangePlan,
+    *,
+    submit: Callable[[int, int, int], Any],
+    drain_chunk: Callable[[int, int, int, Any], Any],
+    finish_round: Callable[[int, int, List[Any]], Any],
+    result_bytes: Callable[[Any], int],
+    occupancy: Callable[[Any], Tuple[int, int]],
+    stats: Optional[StatsAggregator] = None,
+    name: str = "exchange.pipeline",
+    interrupt: Optional[Callable[[], Optional[BaseException]]] = None,
+) -> List[Any]:
+    """Interpret one plan: submit every sub-round through the depth-bounded
+    ``RoundPipeline``, accumulate each staging round's drained chunks, and
+    return one ``finish_round`` result per staging round in NATURAL round
+    order (whatever ``plan.round_order`` the optimizer chose — the
+    permutation is a submission-side schedule, never an observable layout).
+
+    * ``submit(rnd, chunk, nchunks)`` — assemble + dispatch one sub-round's
+      collective, return the drain ticket.  Runs on the caller's thread in
+      plan order; poll your abort conditions here (or pass ``interrupt``).
+    * ``drain_chunk(rnd, chunk, nchunks, ticket)`` — materialize one
+      sub-round host-side; the returned part is queued for its round.
+    * ``finish_round(rnd, nchunks, parts)`` — splice a round's parts (chunk
+      order) into the round result the transport's receive state keeps.
+
+    Telemetry contract (the retired engines', verbatim): every sub-round is
+    one ``<name>.submit``/``<name>.drain`` op pair; a drain that completes a
+    round records ``occupancy(result)`` rows and ``result_bytes(result)``,
+    an intermediate chunk records zeros.  Single-shot plans therefore record
+    per-round occupancy exactly like the historical engine — every chunk is
+    final."""
+    subs = plan.ordered_subrounds()
+    # a round's drained parts so far, chunk order: appended and consumed ONLY
+    # by the pipeline's single in-order drain worker, so no lock is needed
+    # (closure-local, single-thread access by construction)
+    pending: Dict[int, List[Any]] = {}
+
+    def _submit(i: int):
+        rnd, chunk, nchunks = subs[i]
+        return submit(rnd, chunk, nchunks)
+
+    def _drain(i: int, ticket):
+        rnd, chunk, nchunks = subs[i]
+        parts = pending.setdefault(rnd, [])
+        parts.append(drain_chunk(rnd, chunk, nchunks, ticket))
+        if len(parts) < nchunks:
+            return None
+        del pending[rnd]
+        return rnd, finish_round(rnd, nchunks, parts)
+
+    pipe = RoundPipeline(
+        max(1, int(plan.pipeline_depth)),
+        _submit,
+        _drain,
+        name=name,
+        stats=stats,
+        result_bytes=lambda r: 0 if r is None else int(result_bytes(r[1])),
+        result_rows=lambda r: (0, 0) if r is None else occupancy(r[1]),
+        interrupt=interrupt,
+    )
+    done = [r for r in pipe.run(len(subs)) if r is not None]
+    done.sort(key=lambda t: t[0])
+    return [result for _, result in done]

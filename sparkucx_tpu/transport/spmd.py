@@ -33,25 +33,23 @@ from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.core.definitions import MapperInfo
 from sparkucx_tpu.core.operation import ExecutorLostError, TransportError
 from sparkucx_tpu.core.transport import ExecutorId
-from sparkucx_tpu.ops.exchange import (
-    ExchangeSpec,
-    bucket_send_rows,
-    build_exchange,
-    rebucket_slots,
-)
+from sparkucx_tpu.ops.exchange import bucket_send_rows
+from sparkucx_tpu.ops.planner import PlanContext, PlanSignals, make_planner
 from sparkucx_tpu.ops.skew import (
     chunk_size_rows,
-    plan_exchange,
-    quota_slot_rows,
     reassemble_round,
     slice_subround,
 )
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
+from sparkucx_tpu.transport.executor import (
+    build_plan_exchange,
+    execute_plan,
+    validate_host_recv_mode,
+)
 from sparkucx_tpu.transport.peer import PeerTransport
-from sparkucx_tpu.transport.pipeline import RoundPipeline
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.stats import StatsAggregator
-from sparkucx_tpu.utils.trace import TRACER, merge_events
+from sparkucx_tpu.utils.trace import TRACER, instant, merge_events
 
 logger = get_logger("transport.spmd")
 
@@ -135,14 +133,19 @@ class SpmdShuffleExecutor:
         self._spill_lock = threading.Lock()
         #: per-stage pipeline timings (same occupancy view as the cluster's)
         self.stats = StatsAggregator()
-        if self.conf.host_recv_mode not in ("array", "memmap"):
-            # fail at construction, not after round 0's collective has run on
-            # every host: 'device' needs retained HBM shards this executor
-            # releases after the collective; anything else is a typo
-            raise ValueError(
-                f"host_recv_mode {self.conf.host_recv_mode!r} is not supported "
-                "by the SPMD executor (array|memmap)"
-            )
+        #: the exchange planner (ops/planner.py) — the collective-schedule
+        #: fields of its plans derive only from all-gathered quantities, so
+        #: every process stays in lockstep whatever the local telemetry says
+        self.planner = make_planner(self.conf)
+        # ONE host_recv_mode gate (transport/executor.py): fail at
+        # construction, not after round 0's collective has run on every host
+        # — 'device' needs retained HBM shards this executor releases after
+        # the collective; anything else is a typo.
+        validate_host_recv_mode(
+            self.conf.host_recv_mode,
+            allowed=("array", "memmap"),
+            where="the SPMD executor",
+        )
 
     # -- control plane -----------------------------------------------------
 
@@ -253,225 +256,89 @@ class SpmdShuffleExecutor:
             )
         self._await_commits(shuffle_id)
         rounds = self.store.seal(shuffle_id)
-        if self.conf.slot_quota_rows > 0:
-            # Skew-aware path (ops/skew.py): quota-capped slots, hot lanes
-            # chunked across extra pipelined sub-rounds.  Separate engine so
-            # quota-off keeps this single-shot path byte-for-byte.
-            self._run_exchange_quota(shuffle_id, rounds)
-            return
-        n = self.num_executors
-        ax = self.conf.mesh_axis_name
-        send_rows, lane = int(rounds[0][0].shape[0]), int(rounds[0][0].shape[1])
-        # Capacity bucketing (same discipline as the cluster's _exchange_fn):
-        # varying-size shuffles share one compiled exchange per power-of-two
-        # slot bucket; payloads relocate into the bucketed slot layout below.
-        bucketed = bucket_send_rows(send_rows, n)
-        fn = self._exchange_fn_for(bucketed, lane)
-
-        data_sharding = NamedSharding(self.mesh, P(ax, None))
-        sizes_sharding = NamedSharding(self.mesh, P(ax, None))
-
-        # Agree on the global round count (spill rounds may differ per host):
-        # a one-int all_gather, served by the same mesh the payload uses.
-        my_rounds = np.array([[len(rounds)]], dtype=np.int32)
-        rc_shard = jax.device_put(my_rounds, self.device)
-        rc = jax.make_array_from_single_device_arrays(
-            (n, 1), sizes_sharding, [rc_shard]
-        )
-        num_rounds = int(np.max(jax.jit(lambda x: jnp.max(x), out_shardings=None)(rc)))
-
-        def _submit(rnd):
-            """Assemble + H2D + collective dispatch for one round (all JAX
-            async dispatch — SPMD order is preserved because every process
-            submits rounds in the same order, whatever the depth)."""
-            if rnd < len(rounds):
-                payload, sizes = rounds[rnd]
-                if isinstance(payload, jax.Array):
-                    # Sealed straight onto the device (device staging or the
-                    # single-round host seal): relocate slots on-device, no
-                    # host round trip; device_put is then a no-op pin.
-                    payload = rebucket_slots(payload, n, bucketed, xp=jnp)
-                else:
-                    payload = rebucket_slots(np.asarray(payload), n, bucketed)
-            else:
-                payload = np.zeros((bucketed, lane), dtype=np.int32)
-                sizes = np.zeros(n, dtype=np.int32)
-            local_payload = jax.device_put(payload, self.device)
-            local_sizes = jax.device_put(sizes[None, :].astype(np.int32), self.device)
-            data = jax.make_array_from_single_device_arrays(
-                (n * bucketed, lane), data_sharding, [local_payload]
-            )
-            size_mat = jax.make_array_from_single_device_arrays(
-                (n, n), sizes_sharding, [local_sizes]
-            )
-            recv, rs = fn(data, size_mat)
-            my_recv = next(
-                s.data for s in recv.addressable_shards if s.device == self.device
-            )
-            my_rs = next(
-                s.data for s in rs.addressable_shards if s.device == self.device
-            )
-            # start D2H of this process's shard while later rounds run
-            my_recv.copy_to_host_async()
-            my_rs.copy_to_host_async()
-            return my_recv, my_rs
-
-        def _drain(rnd, ticket):
-            """Host-side completion: materialize this process's shard and
-            apply host_recv_mode (memmap spill runs on the drain worker)."""
-            my_recv, my_rs = ticket
-            shard = self._host_shard(
-                shuffle_id, rnd, np.asarray(my_recv).reshape(-1).view(np.uint8)
-            )
-            return shard, np.asarray(my_rs).reshape(-1)
-
-        depth = max(1, int(self.conf.pipeline_depth))
-        pipe = RoundPipeline(
-            depth, _submit, _drain, name="exchange.pipeline", stats=self.stats,
-            result_bytes=lambda r: int(r[1].sum()) * self.conf.block_alignment,
-            # per-round staging occupancy of this process's shard (the slot
-            # padding conf.slot_quota_rows exists to shrink)
-            result_rows=lambda r: (int(r[1].sum()), bucketed - int(r[1].sum())),
-        )
-        results = pipe.run(num_rounds)
-        recv_shards = [shard for shard, _ in results]
-        recv_sizes_rows = [sizes for _, sizes in results]
-        for sizes in recv_sizes_rows:
-            active = int(np.count_nonzero(sizes))
-            self.stats.record_rows("exchange.lanes", active, sizes.size - active)
-        self._recv[shuffle_id] = (recv_shards, recv_sizes_rows)
-        logger.info(
-            "exchange done: shuffle=%d rounds=%d depth=%d",
-            shuffle_id, num_rounds, depth,
-        )
-
-    def _exchange_fn_for(self, bucketed_rows: int, lane: int):
-        """Compiled-exchange cache lookup, keyed on the bucketed slot layout.
-
-        ``bucketed_rows`` is re-bucketed here (``bucket_send_rows`` is a fixed
-        point on pow2-slot multiples, so callers that already bucketed — the
-        default path's ``bucket_send_rows``, the quota path's
-        ``quota_slot_rows * n`` — pass through unchanged) so a raw staging
-        size can never become a compile-cache key."""
-        n = self.num_executors
-        bucketed_rows = bucket_send_rows(bucketed_rows, n)
-        from sparkucx_tpu.ops.ici_exchange import resolve_exchange_impl
-
-        impl = resolve_exchange_impl(
-            self.conf.exchange_impl,
-            self.mesh.devices.reshape(-1)[0].platform,
-            n,
-        )
-        key = (bucketed_rows, lane, self.conf.num_slices, impl)
-        fn = self._exchange_fns.get(key)
-        if fn is None:
-            spec = ExchangeSpec(
-                num_executors=n, send_rows=bucketed_rows, recv_rows=bucketed_rows,
-                lane=lane, axis_name=self.conf.mesh_axis_name,
-            )
-            if self.conf.num_slices > 1:
-                # multi-slice multi-host: the two-phase ICI+DCN route over the
-                # same global devices, slice-major (ops/hierarchy.py)
-                from sparkucx_tpu.ops.hierarchy import (
-                    build_hierarchical_exchange,
-                    make_hierarchical_mesh,
-                )
-
-                hmesh = make_hierarchical_mesh(
-                    self.conf.num_slices,
-                    n // self.conf.num_slices,
-                    devices=list(self.mesh.devices.reshape(-1)),
-                )
-                if impl == "pallas":
-                    from sparkucx_tpu.ops.ici_exchange import (
-                        DEFAULT_CHUNKS_PER_DEST,
-                        build_ici_exchange,
-                    )
-
-                    fn = build_ici_exchange(
-                        hmesh, spec.resolve_impl(),
-                        chunks_per_dest=DEFAULT_CHUNKS_PER_DEST,
-                    )
-                else:
-                    fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
-            elif impl == "pallas":
-                # FAST-scheduled ring exchange (ops/ici_exchange.py):
-                # bit-identical, remote-DMA on TPU, scheduled permutes here
-                from sparkucx_tpu.ops.ici_exchange import (
-                    DEFAULT_CHUNKS_PER_DEST,
-                    build_ici_exchange,
-                )
-
-                fn = build_ici_exchange(
-                    self.mesh, spec, chunks_per_dest=DEFAULT_CHUNKS_PER_DEST
-                )
-            else:
-                fn = build_exchange(self.mesh, spec)
-            self._exchange_fns[key] = fn
-        return fn
-
-    def _run_exchange_quota(self, shuffle_id: int, rounds) -> None:
-        """Quota-capped exchange (conf.slot_quota_rows > 0), SPMD flavor.
-
-        Every process derives the SAME sub-round plan — the per-round hottest
-        lane is all-gathered over the mesh (a tiny int collective, like the
-        round-count agreement) before planning, so the collective schedule
-        stays in lockstep.  The drain worker splices each staging round's
-        chunks back into the exact tight sender-major shard the single-shot
-        path produces (bit-equality pinned in tests/test_skew.py)."""
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         n = self.num_executors
         ax = self.conf.mesh_axis_name
         send_rows, lane = int(rounds[0][0].shape[0]), int(rounds[0][0].shape[1])
         staging_slot = send_rows // n
-        q = quota_slot_rows(staging_slot, self.conf.slot_quota_rows)
-        bucketed = q * n
-        fn = self._exchange_fn_for(bucketed, lane)
 
         data_sharding = NamedSharding(self.mesh, P(ax, None))
         sizes_sharding = NamedSharding(self.mesh, P(ax, None))
 
-        # Agree on the global round count, then on each round's hottest lane
-        # (max used rows over all senders/destinations): two tiny int
-        # all-gathers so every process plans the identical sub-round schedule.
+        # Agree on the plan inputs cluster-wide (spill rounds and skew may
+        # differ per host, but every process must derive the IDENTICAL
+        # collective schedule): a one-int round-count all_gather, then one
+        # (n, rounds + 1) gather carrying each process's per-round hottest
+        # lane and its used-row total — the geometry the planner's
+        # collective-schedule decisions are a pure function of.  Local
+        # telemetry (PlanSignals) only steers serve-plane fields that never
+        # enter a collective.
         my_rounds = np.array([[len(rounds)]], dtype=np.int32)
         rc = jax.make_array_from_single_device_arrays(
             (n, 1), sizes_sharding, [jax.device_put(my_rounds, self.device)]
         )
         num_rounds = int(np.max(jax.jit(lambda x: jnp.max(x), out_shardings=None)(rc)))
-        local_maxes = np.zeros((1, num_rounds), dtype=np.int32)
+        local = np.zeros((1, num_rounds + 1), dtype=np.int32)
         for rnd in range(min(len(rounds), num_rounds)):
-            local_maxes[0, rnd] = int(np.max(rounds[rnd][1], initial=0))
+            local[0, rnd] = int(np.max(rounds[rnd][1], initial=0))
+        local[0, num_rounds] = sum(int(np.sum(r[1])) for r in rounds)
         mx = jax.make_array_from_single_device_arrays(
-            (n, num_rounds), sizes_sharding, [jax.device_put(local_maxes, self.device)]
+            (n, num_rounds + 1), sizes_sharding, [jax.device_put(local, self.device)]
         )
-        gm = jax.jit(lambda x: jnp.max(x, axis=0), out_shardings=None)(mx)
-        plan = plan_exchange(
-            [int(gm[rnd]) for rnd in range(num_rounds)],
-            staging_slot,
-            self.conf.slot_quota_rows,
+        maxes, total = jax.jit(
+            lambda x: (jnp.max(x[:, :-1], axis=0), jnp.sum(x[:, -1])),
+            out_shardings=None,
+        )(mx)
+        ctx = PlanContext(
+            num_executors=n,
+            staging_slot_rows=staging_slot,
+            round_max_rows=tuple(int(v) for v in np.asarray(maxes)),
+            used_rows_total=int(total),
+            row_bytes=self.conf.block_alignment,
+            platform=self.mesh.devices.reshape(-1)[0].platform,
+            signals=PlanSignals.from_registry(self.peer.metrics),
         )
-        subs = plan.subrounds()
+        plan = self.planner.plan(ctx)
+        instant(
+            "exchange.plan",
+            shuffle_id=shuffle_id,
+            planner=type(self.planner).__name__,
+            **plan.describe(),
+            **{f"signal_{k}": v for k, v in ctx.signals.describe().items()},
+        )
+        q = plan.slot_rows
+        # Capacity bucketing (same discipline as the cluster's _exchange_fn):
+        # varying-size shuffles share one compiled exchange per power-of-two
+        # slot bucket; payloads relocate into the bucketed slot layout below.
+        bucketed = q * n
+        fn = self._exchange_fn_for(bucketed, lane, plan.lowering)
 
-        def _submit_quota(sub_idx):
-            """One sub-round's assemble + H2D + collective dispatch: slice the
-            chunk window out of every peer slot (all processes submit the same
-            sub-round order, whatever the depth)."""
-            rnd, chunk, _ = subs[sub_idx]
+        def _submit(rnd, chunk, nchunks):
+            """One sub-round's assemble + H2D + collective dispatch (all JAX
+            async dispatch — SPMD order is preserved because every process
+            submits the same plan's sub-rounds in the same order, whatever
+            the depth)."""
             if rnd < len(rounds):
                 payload, sizes = rounds[rnd]
                 sub_sizes = chunk_size_rows(sizes, chunk, q)
-                xp = jnp if isinstance(payload, jax.Array) else np
-                piece = slice_subround(payload, n, chunk, q, xp=xp)
+                if isinstance(payload, jax.Array):
+                    # Sealed straight onto the device (device staging or the
+                    # single-round host seal): relocate/slice on-device, no
+                    # host round trip; device_put is then a no-op pin.  A
+                    # single-shot plan whose bucket equals the staging slot
+                    # donates the sealed payload as-is (historical fast path).
+                    piece = (
+                        payload
+                        if plan.single_shot and q == staging_slot
+                        else slice_subround(payload, n, chunk, q, xp=jnp)
+                    )
+                else:
+                    piece = slice_subround(np.asarray(payload), n, chunk, q)
             else:
                 piece = np.zeros((bucketed, lane), dtype=np.int32)
                 sub_sizes = np.zeros(n, dtype=np.int32)
             local_payload = jax.device_put(piece, self.device)
             local_sizes = jax.device_put(
-                np.reshape(sub_sizes, (1, n)).astype(np.int32), self.device
+                np.reshape(np.asarray(sub_sizes), (1, n)).astype(np.int32), self.device
             )
             data = jax.make_array_from_single_device_arrays(
                 (n * bucketed, lane), data_sharding, [local_payload]
@@ -486,31 +353,30 @@ class SpmdShuffleExecutor:
             my_rs = next(
                 s.data for s in rs.addressable_shards if s.device == self.device
             )
+            # start D2H of this process's shard while later sub-rounds run
             my_recv.copy_to_host_async()
             my_rs.copy_to_host_async()
             return my_recv, my_rs
 
-        # this staging round's drained sub-rounds, oldest first: appended and
-        # consumed ONLY by the pipeline's single in-order drain worker, so no
-        # lock is needed (closure-local, single-thread access by construction)
-        pending = []
-
-        def _drain_quota(sub_idx, ticket):
-            """Materialize one sub-round's shard; on a staging round's FINAL
-            chunk, splice the chunks back into the single-shot layout, apply
-            host_recv_mode, and emit the round's result (None otherwise)."""
-            rnd, chunk, nchunks = subs[sub_idx]
+        def _drain_chunk(rnd, chunk, nchunks, ticket):
+            """Materialize one sub-round's shard host-side (drain worker)."""
             my_recv, my_rs = ticket
-            pending.append(
-                (
-                    np.asarray(my_recv).reshape(-1).view(np.uint8),
-                    np.asarray(my_rs).reshape(-1),
-                )
+            return (
+                np.asarray(my_recv).reshape(-1).view(np.uint8),
+                np.asarray(my_rs).reshape(-1),
             )
-            if chunk < nchunks - 1:
-                return None
-            parts = list(pending)  # exactly this round's sub-rounds, in order
-            pending.clear()
+
+        def _finish_round(rnd, nchunks, parts):
+            """Emit one staging round's receive state: single-shot rounds
+            keep their whole padded shard (historical layout); chunked rounds
+            splice back into the exact single-shot layout (bit-equality
+            pinned in tests/test_skew.py).  host_recv_mode applies here, on
+            the drain worker — memmap spill stays off the submit thread."""
+            if plan.single_shot:
+                raw, sizes = parts[0]
+                shard = self._host_shard(shuffle_id, rnd, raw)
+                used = int(sizes.sum())
+                return shard, sizes, (used, bucketed - used)
             sub_sizes = [s for _, s in parts]
             logical = np.sum(sub_sizes, axis=0).astype(np.int32)
             assembled = reassemble_round(
@@ -520,16 +386,17 @@ class SpmdShuffleExecutor:
             used = int(logical.sum())
             return shard, logical, (used, nchunks * bucketed - used)
 
-        depth = max(1, int(self.conf.pipeline_depth))
-        pipe = RoundPipeline(
-            depth, _submit_quota, _drain_quota, name="exchange.pipeline",
+        results = execute_plan(
+            plan,
+            submit=_submit,
+            drain_chunk=_drain_chunk,
+            finish_round=_finish_round,
+            result_bytes=lambda r: int(r[1].sum()) * self.conf.block_alignment,
+            # per-round staging occupancy of this process's shard (the slot
+            # padding the planner's quota/chunking exists to shrink)
+            occupancy=lambda r: r[2],
             stats=self.stats,
-            result_bytes=lambda r: (
-                0 if r is None else int(r[1].sum()) * self.conf.block_alignment
-            ),
-            result_rows=lambda r: (0, 0) if r is None else r[2],
         )
-        results = [r for r in pipe.run(len(subs)) if r is not None]
         recv_shards = [shard for shard, _, _ in results]
         recv_sizes_rows = [sizes for _, sizes, _ in results]
         for sizes in recv_sizes_rows:
@@ -537,10 +404,44 @@ class SpmdShuffleExecutor:
             self.stats.record_rows("exchange.lanes", active, sizes.size - active)
         self._recv[shuffle_id] = (recv_shards, recv_sizes_rows)
         logger.info(
-            "exchange done (quota): shuffle=%d rounds=%d subrounds=%d "
-            "quota_slot=%d depth=%d",
-            shuffle_id, num_rounds, len(subs), q, depth,
+            "exchange done: shuffle=%d rounds=%d subrounds=%d slot=%d depth=%d "
+            "single_shot=%s",
+            shuffle_id, num_rounds, plan.num_subrounds, q,
+            plan.pipeline_depth, plan.single_shot,
         )
+
+    def _exchange_fn_for(self, bucketed_rows: int, lane: int, lowering=None):
+        """Compiled-exchange cache lookup, keyed on the bucketed slot layout.
+
+        ``bucketed_rows`` is re-bucketed here (``bucket_send_rows`` is a fixed
+        point on pow2-slot multiples, so plans — whose ``slot_rows`` are
+        already pow2-bucketed — pass through unchanged) so a raw staging size
+        can never become a compile-cache key.  The lowering itself lives in
+        ``transport/executor.build_plan_exchange`` — this method owns only
+        the cache."""
+        n = self.num_executors
+        bucketed_rows = bucket_send_rows(bucketed_rows, n)
+        from sparkucx_tpu.ops.ici_exchange import resolve_exchange_impl
+
+        impl = resolve_exchange_impl(
+            lowering or self.conf.exchange_impl,
+            self.mesh.devices.reshape(-1)[0].platform,
+            n,
+        )
+        key = (bucketed_rows, lane, self.conf.num_slices, impl)
+        fn = self._exchange_fns.get(key)
+        if fn is None:
+            fn = build_plan_exchange(
+                self.mesh,
+                num_executors=n,
+                send_rows=bucketed_rows,
+                lane=lane,
+                axis_name=self.conf.mesh_axis_name,
+                impl=impl,
+                num_slices=self.conf.num_slices,
+            )
+            self._exchange_fns[key] = fn
+        return fn
 
     # -- post-exchange reads ----------------------------------------------
 

@@ -58,24 +58,26 @@ from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.parallel.membership import ClusterMembership
 from sparkucx_tpu.parallel.mesh import surviving_submesh
 from sparkucx_tpu.ops.exchange import (
-    ExchangeSpec,
     bucket_send_rows,
-    build_exchange,
     make_mesh,
     rebucket_slots,
 )
+from sparkucx_tpu.ops.planner import PlanContext, PlanSignals, make_planner
 from sparkucx_tpu.ops.skew import (
     chunk_size_rows,
     pad_rows_pow2,
     piece_slices,
-    plan_exchange,
     reassemble_round,
     slice_subround,
 )
 from sparkucx_tpu.shuffle.resolver import degraded_plan, ring_neighbors
 from sparkucx_tpu.store.hbm_store import HbmBlockStore, default_peer_ranges
 from sparkucx_tpu.testing import faults
-from sparkucx_tpu.transport.pipeline import RoundPipeline
+from sparkucx_tpu.transport.executor import (
+    build_plan_exchange,
+    execute_plan,
+    validate_host_recv_mode,
+)
 from sparkucx_tpu.obs.metrics import (
     MetricsRegistry,
     counter_dict_provider,
@@ -155,6 +157,9 @@ class TpuShuffleCluster:
         self.transports: List[TpuShuffleTransport] = [
             TpuShuffleTransport(self, eid, device=devices[eid]) for eid in range(self.num_executors)
         ]
+        #: the exchange planner (ops/planner.py): conf.planner_mode selects
+        #: the legacy-1:1 static mapping or the telemetry-fed adaptive one
+        self.planner = make_planner(self.conf)
         self._meta: Dict[int, _ShuffleMeta] = {}  #: guarded by self._lock
         self._exchange_cache: Dict[Tuple[int, int, str], Callable] = {}  #: guarded by self._lock
         self._lock = threading.RLock()
@@ -318,17 +323,19 @@ class TpuShuffleCluster:
     def row_bytes(self) -> int:
         return self.conf.block_alignment
 
-    def _exchange_fn(self, send_rows: int):
+    def _exchange_fn(self, send_rows: int, lowering: Optional[str] = None):
         # Capacity bucketing: round the per-peer slot up to the next power of
         # two so shuffles of varying staging size share one compiled
         # executable per bucket (the caller relocates payloads into the
-        # bucketed slot layout — rebucket_slots; padding rows carry zero sizes
-        # and never cross the wire under the ragged lowering).
+        # bucketed slot layout; padding rows carry zero sizes and never cross
+        # the wire under the ragged lowering).  ``lowering`` is the plan's
+        # collective tier (defaults to the conf knob); a key miss lowers
+        # through the shared build_plan_exchange dispatch.
         send_rows = bucket_send_rows(send_rows, self.num_executors)
         from sparkucx_tpu.ops.ici_exchange import resolve_exchange_impl
 
         impl = resolve_exchange_impl(
-            self.conf.exchange_impl,
+            lowering or self.conf.exchange_impl,
             self.mesh.devices.reshape(-1)[0].platform,
             self.num_executors,
         )
@@ -339,53 +346,15 @@ class TpuShuffleCluster:
         with self._lock:
             fn = self._exchange_cache.get(key)
             if fn is None:
-                spec = ExchangeSpec(
+                fn = build_plan_exchange(
+                    self.mesh,
                     num_executors=self.num_executors,
                     send_rows=send_rows,
-                    recv_rows=send_rows,  # worst case: all regions full
                     lane=self.row_bytes // 4,
                     axis_name=self.conf.mesh_axis_name,
-                    impl="auto",
+                    impl=impl,
+                    num_slices=self.conf.num_slices,
                 )
-                if self.conf.num_slices > 1:
-                    # multi-slice: two-phase ICI+DCN route over the same
-                    # devices, slice-major (ops/hierarchy.py)
-                    from sparkucx_tpu.ops.hierarchy import (
-                        build_hierarchical_exchange,
-                        make_hierarchical_mesh,
-                    )
-
-                    hmesh = make_hierarchical_mesh(
-                        self.conf.num_slices,
-                        self.num_executors // self.conf.num_slices,
-                        devices=list(self.mesh.devices.reshape(-1)),
-                    )
-                    if impl == "pallas":
-                        from sparkucx_tpu.ops.ici_exchange import (
-                            DEFAULT_CHUNKS_PER_DEST,
-                            build_ici_exchange,
-                        )
-
-                        fn = build_ici_exchange(
-                            hmesh, spec.resolve_impl(),
-                            chunks_per_dest=DEFAULT_CHUNKS_PER_DEST,
-                        )
-                    else:
-                        fn = build_hierarchical_exchange(hmesh, spec.resolve_impl())
-                elif impl == "pallas":
-                    # FAST-scheduled ring exchange (ops/ici_exchange.py):
-                    # bit-identical results, remote-DMA kernel on TPU,
-                    # scheduled permutes elsewhere
-                    from sparkucx_tpu.ops.ici_exchange import (
-                        DEFAULT_CHUNKS_PER_DEST,
-                        build_ici_exchange,
-                    )
-
-                    fn = build_ici_exchange(
-                        self.mesh, spec, chunks_per_dest=DEFAULT_CHUNKS_PER_DEST
-                    )
-                else:
-                    fn = build_exchange(self.mesh, spec)
                 self._exchange_cache[key] = fn
         return fn
 
@@ -406,9 +375,10 @@ class TpuShuffleCluster:
                 f"exchange before all maps committed ({committed}/{meta.num_mappers})"
             )
 
-        mode = self.conf.host_recv_mode
-        if mode not in ("array", "memmap", "device"):
-            raise ValueError(f"unknown host_recv_mode {mode!r} (array|memmap|device)")
+        # ONE host_recv_mode gate (transport/executor.py) — an unknown mode
+        # is rejected here, before any staging allocation, with the same
+        # vocabulary and error text as the SPMD executor's gate.
+        mode = validate_host_recv_mode(self.conf.host_recv_mode)
         if mode == "device" and not self.conf.keep_device_recv:
             raise TransportError(
                 "host_recv_mode='device' serves fetches from the HBM shards — "
@@ -432,19 +402,52 @@ class TpuShuffleCluster:
                         f"expected {(send_rows, lane)} — mismatched staging "
                         "geometry (stagingCapacity/blockAlignment) across executors"
                     )
-        if self.conf.slot_quota_rows > 0:
-            # Skew-aware path (ops/skew.py): cap every peer slot at the quota
-            # and chunk hotter lanes across extra pipelined sub-rounds.  Kept
-            # as a separate engine so quota-off preserves this single-shot
-            # path — including its donation of sealed payloads — byte-for-byte.
-            self._run_exchange_quota(meta, sealed, mode)
-            return
+        import jax.numpy as jnp
+
+        n = self.num_executors
+        staging_slot = send_rows // n
+        # Plan context from the sealed size matrices (metadata-before-data:
+        # the planner never sees payload bytes), plus the local telemetry
+        # snapshot for the serve-plane decisions and the plan span.
+        round_maxes = tuple(
+            max(
+                (int(np.max(s[rnd][1], initial=0)) for s in sealed if rnd < len(s)),
+                default=0,
+            )
+            for rnd in range(num_rounds)
+        )
+        used_total = sum(int(np.sum(sr[1])) for s in sealed for sr in s)
+        signals = PlanSignals.from_registry(self.metrics)
+        ctx = PlanContext(
+            num_executors=n,
+            staging_slot_rows=staging_slot,
+            round_max_rows=round_maxes,
+            used_rows_total=used_total,
+            row_bytes=self.row_bytes,
+            platform=self.mesh.devices.reshape(-1)[0].platform,
+            signals=signals,
+        )
+        plan = self.planner.plan(ctx)
+        instant(
+            "exchange.plan",
+            shuffle_id=shuffle_id,
+            planner=type(self.planner).__name__,
+            **plan.describe(),
+            **{f"signal_{k}": v for k, v in signals.describe().items()},
+        )
+
+        q = plan.slot_rows
+        bucketed = q * n  # staged rows per executor (n slots x the plan slot)
+        fn = self._exchange_fn(bucketed, plan.lowering)
+
         # Elastic prep: snapshot the membership epoch the plan was built
         # against, and (when replication is on) copy each executor's sealed
         # rounds to its ring successors so a mid-superstep death is
-        # recoverable.  Both are no-ops with the knobs at their defaults.
+        # recoverable.  Degraded recovery covers single-shot plans only (the
+        # historical quota-off engine); chunked plans fail fast with a typed
+        # error, exactly like the retired quota engine.
         epoch0 = self.membership.epoch
-        if self.conf.elastic and self.conf.replication_factor >= 1:
+        if plan.single_shot and self.conf.elastic and self.conf.replication_factor >= 1:
             with span("exchange.replicate", shuffle_id=shuffle_id):
                 self._replicate_sealed(shuffle_id)
 
@@ -453,196 +456,20 @@ class TpuShuffleCluster:
                 return _MeshChanged(epoch0, self.membership.snapshot())
             return None
 
-        fn = self._exchange_fn(send_rows)
-        bucketed = bucket_send_rows(send_rows, self.num_executors)
-
-        ax = self.conf.mesh_axis_name
-        n = self.num_executors
-        data_sharding = NamedSharding(self.mesh, P(ax, None))
-        devices = list(self.mesh.devices.reshape(-1))
-        keep_device = self.conf.keep_device_recv
-
-        def _assemble(rnd):
-            """Stage round ``rnd``: gather per-executor payloads (zero
-            contribution for executors with fewer spill rounds), relocate into
-            the bucketed slot layout, and start the H2D transfer (async)."""
-            payloads, size_rows = [], []
-            for s in sealed:
-                if rnd < len(s):
-                    payloads.append(s[rnd][0])
-                    size_rows.append(s[rnd][1])
-                else:  # executor had fewer spill rounds: empty contribution
-                    payloads.append(None)
-                    size_rows.append(np.zeros(n, dtype=np.int32))
-            if all(isinstance(p, jax.Array) for p in payloads):
-                # Shards were sealed straight onto their executors' devices —
-                # assemble the global array without any host round-trip (the
-                # slot relocation, if the bucket grew, runs on each device).
-                if bucketed != send_rows:
-                    import jax.numpy as jnp
-
-                    payloads = [rebucket_slots(p, n, bucketed, xp=jnp) for p in payloads]
-                data = jax.make_array_from_single_device_arrays(
-                    (n * bucketed, lane), data_sharding, payloads
-                )
-            else:
-                host = np.zeros((n * bucketed, lane), dtype=np.int32)
-                for i, p in enumerate(payloads):
-                    if p is not None:
-                        host[i * bucketed : (i + 1) * bucketed] = rebucket_slots(
-                            np.asarray(p), n, bucketed
-                        )
-                data = jax.device_put(host, data_sharding)
-            size_mat = jax.device_put(
-                np.stack(size_rows).astype(np.int32), NamedSharding(self.mesh, P(ax, None))
-            )
-            return data, size_mat
-
-        def _submit(rnd):
-            """H2D + collective dispatch + async D2H kick-off for one round.
-            Everything here is JAX async dispatch: round rnd's collective is
-            still in flight when round rnd+1 assembles."""
-            faults.check("exchange.submit", shuffle_id=shuffle_id, round=rnd)
-            exc = _mesh_changed()
-            if exc is not None:
-                raise exc
-            data, size_mat = _assemble(rnd)
-            with span("exchange.collective", shuffle_id=shuffle_id, round=rnd, rows=bucketed):
-                recv, recv_sizes = fn(data, size_mat)
-            # Pin the per-device shard objects HERE (addressable_shards builds
-            # fresh wrappers per call — reusing these keeps the async-copy
-            # cache) and start their D2H now, while later rounds keep the
-            # device busy; the drain's np.asarray then observes completion
-            # instead of initiating the copy.
-            shard_by_device = {s.device: s.data for s in recv.addressable_shards}
-            if mode != "device":
-                for a in shard_by_device.values():
-                    a.copy_to_host_async()
-            recv_sizes.copy_to_host_async()
-            return recv, recv_sizes, shard_by_device
-
-        def _drain(rnd, ticket):
-            """Complete one round host-side (drain-worker thread at depth>1)."""
-            recv, recv_sizes, shard_by_device = ticket
-            sizes_host = np.asarray(recv_sizes)
-            if mode == "device":
-                # No host copy at all: fetches slice the retained HBM shard
-                # and D2H only the requested block (locate_received_block).
-                jax.block_until_ready(recv)
-                shards = None
-            elif mode == "memmap":
-                # One D2H per shard, streamed straight into a disk-backed
-                # mapping; the round's RAM is released once pages flush, so
-                # host RSS stays bounded by ~one in-flight window however many
-                # rounds the shuffle spills.
-                with span("exchange.d2h_memmap", shuffle_id=shuffle_id, round=rnd):
-                    shards = self._memmap_round(
-                        meta,
-                        rnd,
-                        (
-                            np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
-                            for j in range(n)
-                        ),
-                    )
-            else:
-                # One D2H per executor shard; fetches then slice host memory.
-                with span("exchange.d2h", shuffle_id=shuffle_id, round=rnd):
-                    shards = [
-                        np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
-                        for j in range(n)
-                    ]
-            dev_shards = (
-                [shard_by_device[devices[j]] for j in range(n)] if keep_device else None
-            )
-            return shards, sizes_host, dev_shards
-
-        depth = max(1, int(self.conf.pipeline_depth))
-        pipe = RoundPipeline(
-            depth,
-            _submit,
-            _drain,
-            name="exchange.pipeline",
-            stats=self.stats,
-            result_bytes=lambda r: int(r[1].sum()) * self.row_bytes,
-            # staging occupancy per round: used rows vs. the slot padding the
-            # skew planner (conf.slot_quota_rows) exists to shrink
-            result_rows=lambda r: (
-                int(r[1].sum()),
-                n * bucketed - int(r[1].sum()),
-            ),
-            interrupt=_mesh_changed,
-        )
-        try:
-            results = pipe.run(num_rounds)
-        except _MeshChanged:
-            # An executor died under this exchange: abort the stale full-mesh
-            # plan and re-run degraded on the surviving pow2 bucket (or raise
-            # a typed ExecutorLostError when recovery is impossible).
-            with span("exchange.recover", shuffle_id=shuffle_id):
-                self._recover_and_rerun(meta, sealed, mode)
-            return
-
-        meta.recv_shards, meta.recv_sizes = [], []
-        for shards, sizes_host, dev_shards in results:
-            if shards is not None:
-                meta.recv_shards.append(shards)
-            meta.recv_sizes.append(sizes_host)
-            active = int(np.count_nonzero(sizes_host))
-            self.stats.record_rows("exchange.lanes", active, sizes_host.size - active)
-            if dev_shards is not None:
-                if meta.recv_device is None:
-                    meta.recv_device = []
-                meta.recv_device.append(dev_shards)
-        if mode == "device":
-            meta.recv_shards = None  # explicit no-host-copy marker
-        meta.exchanged = True
-
-    def _run_exchange_quota(self, meta, sealed, mode: str) -> None:
-        """Quota-capped exchange engine (conf.slot_quota_rows > 0).
-
-        Plans sub-rounds from the sealed size matrices (ops/skew.plan_exchange):
-        every sub-round stages the quota-capped pow2 slot, hot lanes chunk
-        across consecutive sub-rounds riding the same RoundPipeline overlap,
-        and the drain worker splices each staging round's chunks back into the
-        exact tight sender-major buffer the single-shot exchange produces
-        (bit-equality pinned in tests/test_skew.py).  The compiled-exchange
-        cache is keyed on the quota bucket, so skewed and uniform shuffles
-        whose caps land in one bucket share executables."""
-        import jax.numpy as jnp
-
-        shuffle_id = meta.shuffle_id
-        n = self.num_executors
-        num_rounds = max(len(s) for s in sealed)
-        first_payload = sealed[0][0][0]
-        send_rows, lane = int(first_payload.shape[0]), int(first_payload.shape[1])
-        staging_slot = send_rows // n
-        # cluster-wide hottest (sender, destination) lane per staging round
-        round_maxes = [
-            max(
-                (int(np.max(s[rnd][1], initial=0)) for s in sealed if rnd < len(s)),
-                default=0,
-            )
-            for rnd in range(num_rounds)
-        ]
-        plan = plan_exchange(round_maxes, staging_slot, self.conf.slot_quota_rows)
-        epoch0 = self.membership.epoch
-        q = plan.slot_rows
-        bucketed = q * n
-        fn = self._exchange_fn(bucketed)  # pow2 slot: bucketing fixed point
-        subs = plan.subrounds()
-
         ax = self.conf.mesh_axis_name
         data_sharding = NamedSharding(self.mesh, P(ax, None))
         devices = list(self.mesh.devices.reshape(-1))
         keep_device = self.conf.keep_device_recv
 
-        def _submit_quota(sub_idx):
-            """One sub-round's H2D + collective dispatch + async D2H kick-off
-            — the quota twin of _submit, slicing chunk windows out of every
-            peer slot instead of relocating whole slots."""
-            rnd, chunk, _ = subs[sub_idx]
+        def _submit(rnd, chunk, nchunks):
+            """One sub-round's assemble + H2D + collective dispatch + async
+            D2H kick-off.  Everything here is JAX async dispatch: this
+            sub-round's collective is still in flight when the next one
+            assembles."""
             faults.check("exchange.submit", shuffle_id=shuffle_id, round=rnd)
             if self.membership.epoch != epoch0:
+                if plan.single_shot:
+                    raise _MeshChanged(epoch0, self.membership.snapshot())
                 snap = self.membership.snapshot()
                 dead = sorted(snap["dead"])
                 raise ExecutorLostError(
@@ -662,8 +489,15 @@ class TpuShuffleCluster:
                     size_rows.append(np.zeros(n, dtype=np.int32))
             sub_sizes = np.stack([chunk_size_rows(sr, chunk, q) for sr in size_rows])
             if all(isinstance(p, jax.Array) for p in payloads):
-                # device-sealed rounds: slice each chunk window on its device
-                pieces = [slice_subround(p, n, chunk, q, xp=jnp) for p in payloads]
+                # Shards were sealed straight onto their executors' devices —
+                # assemble the global array without any host round-trip.
+                if plan.single_shot and q == staging_slot:
+                    # bucket == staging slot: donate the sealed payloads as-is
+                    # (the historical single-shot no-copy fast path)
+                    pieces = payloads
+                else:
+                    # slot relocation / chunk-window slice on each device
+                    pieces = [slice_subround(p, n, chunk, q, xp=jnp) for p in payloads]
                 data = jax.make_array_from_single_device_arrays(
                     (n * bucketed, lane), data_sharding, pieces
                 )
@@ -672,7 +506,7 @@ class TpuShuffleCluster:
                 for i, p in enumerate(payloads):
                     if p is not None:
                         # mixed host/device rounds pay one D2H here, same as
-                        # the default assemble (allowlisted host-sync cost)
+                        # the historical assemble (allowlisted host-sync cost)
                         arr = np.asarray(p) if isinstance(p, jax.Array) else p
                         host[i * bucketed : (i + 1) * bucketed] = slice_subround(
                             arr, n, chunk, q
@@ -683,12 +517,14 @@ class TpuShuffleCluster:
             )
             with span(
                 "exchange.collective",
-                shuffle_id=shuffle_id,
-                round=rnd,
-                chunk=chunk,
-                rows=bucketed,
+                shuffle_id=shuffle_id, round=rnd, chunk=chunk, rows=bucketed,
             ):
                 recv, recv_sizes = fn(data, size_mat)
+            # Pin the per-device shard objects HERE (addressable_shards builds
+            # fresh wrappers per call — reusing these keeps the async-copy
+            # cache) and start their D2H now, while later sub-rounds keep the
+            # device busy; the drain's np.asarray then observes completion
+            # instead of initiating the copy.
             shard_by_device = {s.device: s.data for s in recv.addressable_shards}
             if mode != "device":
                 for a in shard_by_device.values():
@@ -696,22 +532,35 @@ class TpuShuffleCluster:
             recv_sizes.copy_to_host_async()
             return recv, recv_sizes, shard_by_device
 
-        # this staging round's drained sub-rounds, oldest first: appended and
-        # consumed ONLY by the pipeline's single in-order drain worker, so no
-        # lock is needed (closure-local, single-thread access by construction)
-        pending = []
-
-        def _drain_quota(sub_idx, ticket):
-            """Complete one sub-round host-side; on a staging round's FINAL
-            chunk, splice the accumulated chunks back into the single-shot
-            receive layout and emit the round's result (None otherwise)."""
-            rnd, chunk, nchunks = subs[sub_idx]
+        def _drain_chunk(rnd, chunk, nchunks, ticket):
+            """Complete one sub-round host-side (drain-worker thread at
+            depth > 1).  Single-shot rounds materialize their whole receive
+            state here — including the streamed memmap spill — so host RSS
+            keeps the historical one-in-flight-window bound."""
             recv, recv_sizes, shard_by_device = ticket
             sizes_host = np.asarray(recv_sizes)
             if mode == "device":
+                # No host copy at all: fetches slice the retained HBM shard
+                # and D2H only the requested block (locate_received_block).
                 jax.block_until_ready(recv)
                 host_parts = None
+            elif plan.single_shot and mode == "memmap":
+                # One D2H per shard, streamed straight into a disk-backed
+                # mapping; the round's RAM is released once pages flush, so
+                # host RSS stays bounded by ~one in-flight window however many
+                # rounds the shuffle spills.
+                with span("exchange.d2h_memmap", shuffle_id=shuffle_id, round=rnd):
+                    host_parts = self._memmap_round(
+                        meta,
+                        rnd,
+                        (
+                            np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
+                            for j in range(n)
+                        ),
+                    )
             else:
+                # One D2H per executor shard; fetches (or the round splice)
+                # then slice host memory.
                 with span("exchange.d2h", shuffle_id=shuffle_id, round=rnd, chunk=chunk):
                     host_parts = [
                         np.asarray(shard_by_device[devices[j]]).reshape(-1).view(np.uint8)
@@ -720,12 +569,18 @@ class TpuShuffleCluster:
             dev_parts = (
                 [shard_by_device[devices[j]] for j in range(n)] if keep_device else None
             )
-            pending.append((sizes_host, host_parts, dev_parts))
-            if chunk < nchunks - 1:
-                return None
-            # final chunk: pending holds exactly this round's sub-rounds
-            parts = list(pending)
-            pending.clear()
+            return sizes_host, host_parts, dev_parts
+
+        def _finish_round(rnd, nchunks, parts):
+            """Emit one staging round's receive state: a single-shot round
+            passes its only chunk through (whole padded shards, the
+            historical layout); a chunked round splices its sub-round shards
+            back into the exact single-shot layout (bit-equality pinned in
+            tests/test_skew.py and tests/test_planner.py)."""
+            if plan.single_shot:
+                sizes_host, shards, dev_shards = parts[0]
+                used = int(sizes_host.sum())
+                return shards, sizes_host, dev_shards, (used, n * bucketed - used)
             sub_size_mats = [p[0] for p in parts]
             logical = np.sum(sub_size_mats, axis=0).astype(np.int32)
             shards = dev_shards = None
@@ -758,28 +613,36 @@ class TpuShuffleCluster:
                         dshard = jnp.zeros((1, lane), dtype=parts[0][2][j].dtype)
                     dev_shards.append(dshard)
             used = int(logical.sum())
-            staged = nchunks * n * bucketed
-            return shards, logical, dev_shards, (used, staged - used)
+            return shards, logical, dev_shards, (used, nchunks * n * bucketed - used)
 
-        depth = max(1, int(self.conf.pipeline_depth))
-        pipe = RoundPipeline(
-            depth,
-            _submit_quota,
-            _drain_quota,
-            name="exchange.pipeline",
-            stats=self.stats,
-            result_bytes=lambda r: 0 if r is None else int(r[1].sum()) * self.row_bytes,
-            result_rows=lambda r: (0, 0) if r is None else r[3],
-        )
-        results = [r for r in pipe.run(len(subs)) if r is not None]
+        try:
+            results = execute_plan(
+                plan,
+                submit=_submit,
+                drain_chunk=_drain_chunk,
+                finish_round=_finish_round,
+                result_bytes=lambda r: int(r[1].sum()) * self.row_bytes,
+                # staging occupancy per round: used rows vs. the slot padding
+                # the planner's quota/chunking decisions exist to shrink
+                occupancy=lambda r: r[3],
+                stats=self.stats,
+                interrupt=_mesh_changed if plan.single_shot else None,
+            )
+        except _MeshChanged:
+            # An executor died under this exchange: abort the stale full-mesh
+            # plan and re-run degraded on the surviving pow2 bucket (or raise
+            # a typed ExecutorLostError when recovery is impossible).
+            with span("exchange.recover", shuffle_id=shuffle_id):
+                self._recover_and_rerun(meta, sealed, mode)
+            return
 
         meta.recv_shards, meta.recv_sizes = [], []
-        for shards, logical, dev_shards, _occ in results:
+        for shards, sizes_host, dev_shards, _occ in results:
             if shards is not None:
                 meta.recv_shards.append(shards)
-            meta.recv_sizes.append(logical)
-            active = int(np.count_nonzero(logical))
-            self.stats.record_rows("exchange.lanes", active, logical.size - active)
+            meta.recv_sizes.append(sizes_host)
+            active = int(np.count_nonzero(sizes_host))
+            self.stats.record_rows("exchange.lanes", active, sizes_host.size - active)
             if dev_shards is not None:
                 if meta.recv_device is None:
                     meta.recv_device = []
@@ -1038,25 +901,14 @@ class TpuShuffleCluster:
         with self._lock:
             fn = self._exchange_cache.get(key)
             if fn is None:
-                spec = ExchangeSpec(
+                fn = build_plan_exchange(
+                    submesh,
                     num_executors=m,
                     send_rows=send_rows,
-                    recv_rows=send_rows,
                     lane=self.row_bytes // 4,
                     axis_name=self.conf.mesh_axis_name,
-                    impl="auto",
+                    impl=impl,
                 )
-                if impl == "pallas":
-                    from sparkucx_tpu.ops.ici_exchange import (
-                        DEFAULT_CHUNKS_PER_DEST,
-                        build_ici_exchange,
-                    )
-
-                    fn = build_ici_exchange(
-                        submesh, spec, chunks_per_dest=DEFAULT_CHUNKS_PER_DEST
-                    )
-                else:
-                    fn = build_exchange(submesh, spec)
                 self._exchange_cache[key] = fn
         return fn, submesh
 

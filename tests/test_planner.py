@@ -1,0 +1,816 @@
+"""Plan-driven exchange: planners, optimization passes, golden equivalence.
+
+Three layers of pinning, mirroring the plan stack's layering:
+
+* **Planner mapping** (pure host) — ``StaticPlanner`` maps the legacy conf
+  knobs (``spark.shuffle.tpu.slotQuotaRows`` & co.) 1:1 onto an
+  ``ExchangePlan``; the default conf's plan is the golden serve-plane tuple
+  (codec off, one stream, no hedge) that leaves wire framing byte-identical
+  to the pre-plan engines.  ``AdaptivePlanner`` layers deterministic
+  telemetry rules on top (``spark.shuffle.tpu.planner.mode`` /
+  ``spark.shuffle.tpu.planner.optimize`` /
+  ``spark.shuffle.tpu.planner.targetPaddingFraction`` /
+  ``spark.shuffle.tpu.planner.minQuotaRows``) — and its COLLECTIVE schedule
+  must be a pure function of the agreed geometry, never local telemetry
+  (the SPMD lockstep invariant).
+* **Optimization passes** — pure plan->plan rewrites preserve coverage
+  (chunks x slot still covers every round's hottest lane) so bytes never
+  change; only schedule geometry does.
+* **Transport bit-equality** — a plan-driven cluster run (optimize on,
+  adaptive mode, pallas lowering, each host_recv_mode) must reproduce the
+  default run's receive state byte for byte, and ``build_plan_exchange``
+  must lower to the exact compiled exchanges the per-variant builders
+  produce (stock / pallas / quantized).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
+from sparkucx_tpu.core.operation import OperationStatus
+from sparkucx_tpu.obs.metrics import MetricSample
+from sparkucx_tpu.ops.planner import (
+    DEFAULT_PASSES,
+    AdaptivePlanner,
+    PlanContext,
+    PlanSignals,
+    StaticPlanner,
+    make_planner,
+    optimize_plan,
+    pass_coalesce_chunks,
+    pass_pow2_bucket,
+    pass_reorder_rounds,
+)
+from sparkucx_tpu.ops.skew import ExchangePlan, plan_exchange, quota_slot_rows
+from sparkucx_tpu.transport.executor import (
+    HOST_RECV_MODES,
+    build_plan_exchange,
+    validate_host_recv_mode,
+)
+from sparkucx_tpu.transport.tpu import TpuShuffleCluster
+from sparkucx_tpu.utils.trace import TRACER
+
+N_EXEC = 4
+
+
+def _ctx(
+    slot=100,
+    maxes=(70, 10),
+    used=0,
+    n=N_EXEC,
+    signals=PlanSignals(),
+    platform="cpu",
+):
+    return PlanContext(
+        num_executors=n,
+        staging_slot_rows=slot,
+        round_max_rows=tuple(maxes),
+        used_rows_total=used,
+        row_bytes=128,
+        platform=platform,
+        signals=signals,
+    )
+
+
+# ----------------------------------------------------------------------
+# StaticPlanner: legacy conf knobs -> plan, 1:1
+
+
+class TestStaticPlannerMapping:
+    def test_default_conf_single_shot_golden(self):
+        """The default conf's plan IS the historical engine: pow2 slot
+        bucket, one chunk per round, single-shot drain, and the serve-plane
+        fields that keep wire frames byte-identical (codec off, one stream,
+        no hedge, no quantization)."""
+        conf = TpuShuffleConf()
+        plan = StaticPlanner(conf).plan(_ctx(slot=100, maxes=(70, 10)))
+        assert plan.slot_rows == quota_slot_rows(100, 0) == 128
+        assert plan.chunks_per_round == (1, 1)
+        assert plan.single_shot is True
+        assert plan.round_order == ()
+        # serve-plane golden tuple (the wire-framing pin)
+        assert (plan.streams, plan.codec, plan.hedge_ms) == (1, "off", 0)
+        assert (plan.quantize_mode, plan.quantize_block) == ("off", 128)
+        # every remaining field copies its conf knob verbatim
+        assert plan.lowering == conf.exchange_impl
+        assert plan.pipeline_depth == conf.pipeline_depth
+
+    def test_quota_conf_maps_to_plan_exchange(self):
+        conf = TpuShuffleConf(slot_quota_rows=32)
+        maxes = (100, 0, 5)
+        plan = StaticPlanner(conf).plan(_ctx(slot=128, maxes=maxes))
+        base = plan_exchange(maxes, 128, 32)
+        assert (plan.slot_rows, plan.chunks_per_round) == (
+            base.slot_rows,
+            base.chunks_per_round,
+        )
+        assert plan.single_shot is False
+        assert plan.round_order == ()  # optimize is off by default
+
+    def test_quota_above_slot_single_launch_geometry(self):
+        conf = TpuShuffleConf(slot_quota_rows=1 << 20)
+        plan = StaticPlanner(conf).plan(_ctx(slot=100, maxes=(70, 10)))
+        assert plan.slot_rows == 128
+        assert plan.chunks_per_round == (1, 1)
+
+    def test_no_rounds_still_plans_one(self):
+        plan = StaticPlanner(TpuShuffleConf()).plan(_ctx(maxes=()))
+        assert plan.chunks_per_round == (1,)
+        assert plan.single_shot is True
+
+    def test_serve_plane_knobs_copied_verbatim(self):
+        conf = TpuShuffleConf(
+            wire_streams=4,
+            wire_compress_codec="rle",
+            quantize_mode="int8",
+            quantize_block_size=64,
+            fetch_hedge_ms=7,
+            pipeline_depth=3,
+            exchange_impl="pallas",
+        )
+        plan = StaticPlanner(conf).plan(_ctx())
+        assert plan.streams == 4
+        assert plan.codec == "rle"
+        assert (plan.quantize_mode, plan.quantize_block) == ("int8", 64)
+        assert plan.hedge_ms == 7
+        assert plan.pipeline_depth == 3
+        assert plan.lowering == "pallas"
+
+    def test_optimize_on_reorders_rounds(self):
+        conf = TpuShuffleConf(slot_quota_rows=16, planner_optimize=True)
+        plan = StaticPlanner(conf).plan(_ctx(slot=64, maxes=(48, 1)))
+        # round 1 (1 chunk) is lighter than round 0 (3 chunks): submits first
+        assert plan.chunks_per_round == (3, 1)
+        assert plan.round_order == (1, 0)
+
+
+# ----------------------------------------------------------------------
+# conf knobs: spark-key parsing + validation + planner dispatch
+
+
+class TestPlannerConfKnobs:
+    def test_spark_keys_parse(self):
+        conf = TpuShuffleConf.from_spark_conf(
+            {
+                "spark.shuffle.tpu.planner.mode": "adaptive",
+                "spark.shuffle.tpu.planner.optimize": "true",
+                "spark.shuffle.tpu.planner.targetPaddingFraction": "0.25",
+                "spark.shuffle.tpu.planner.minQuotaRows": "128",
+            }
+        )
+        assert conf.planner_mode == "adaptive"
+        assert conf.planner_optimize is True
+        assert conf.planner_target_padding == 0.25
+        assert conf.planner_min_quota_rows == 128
+
+    def test_defaults_are_off_path(self):
+        conf = TpuShuffleConf()
+        assert conf.planner_mode == "static"
+        assert conf.planner_optimize is False
+
+    def test_validate_rejects_unknown_mode(self):
+        with pytest.raises(ValueError, match="planner_mode"):
+            TpuShuffleConf(planner_mode="bogus").validate()
+
+    def test_validate_rejects_bad_padding_target(self):
+        with pytest.raises(ValueError, match="planner_target_padding"):
+            TpuShuffleConf(planner_target_padding=1.5).validate()
+
+    def test_validate_rejects_bad_min_quota(self):
+        with pytest.raises(ValueError, match="planner_min_quota_rows"):
+            TpuShuffleConf(planner_min_quota_rows=0).validate()
+
+    def test_make_planner_dispatch(self):
+        assert isinstance(make_planner(TpuShuffleConf()), StaticPlanner)
+        assert isinstance(
+            make_planner(TpuShuffleConf(planner_mode="adaptive")), AdaptivePlanner
+        )
+
+
+# ----------------------------------------------------------------------
+# optimization passes: coverage-preserving geometry rewrites
+
+
+class TestOptimizationPasses:
+    def test_pow2_bucket_rebuckets_hand_built_plan(self):
+        plan = ExchangePlan(slot_rows=100, chunks_per_round=(2,))
+        out = pass_pow2_bucket(plan, _ctx(slot=100, maxes=(200,)))
+        assert out.slot_rows == 128
+        # coverage preserved: chunks x slot still covers the implied need
+        assert out.chunks_per_round[0] * out.slot_rows >= 200
+
+    def test_pow2_bucket_fixed_point_on_plan_exchange(self):
+        ctx = _ctx(slot=128, maxes=(100, 5))
+        plan = plan_exchange(ctx.round_max_rows, 128, 32)
+        assert pass_pow2_bucket(plan, ctx) is plan
+
+    def test_coalesce_collapses_even_chunks(self):
+        """4 chunks of 16 covering 60 rows: same 64 staged rows as 2x32 or
+        1x64, so coalescing walks all the way up to one launch."""
+        ctx = _ctx(slot=64, maxes=(60,))
+        plan = plan_exchange(ctx.round_max_rows, 64, 16)
+        assert plan.chunks_per_round == (4,)
+        out = pass_coalesce_chunks(plan, ctx)
+        assert (out.slot_rows, out.chunks_per_round) == (64, (1,))
+        assert out.staged_rows(N_EXEC) == plan.staged_rows(N_EXEC)
+
+    def test_coalesce_keeps_odd_chunks(self):
+        """3 chunks of 16 covering 48 rows: doubling to 2x32 would stage 64
+        rows (more padding), so the smaller slot is kept."""
+        ctx = _ctx(slot=64, maxes=(48,))
+        plan = plan_exchange(ctx.round_max_rows, 64, 16)
+        assert plan.chunks_per_round == (3,)
+        out = pass_coalesce_chunks(plan, ctx)
+        assert (out.slot_rows, out.chunks_per_round) == (16, (3,))
+
+    def test_coalesce_skips_single_shot(self):
+        plan = ExchangePlan(slot_rows=16, chunks_per_round=(1,), single_shot=True)
+        assert pass_coalesce_chunks(plan, _ctx(slot=16, maxes=(16,))) is plan
+
+    def test_reorder_ascending_footprint(self):
+        plan = ExchangePlan(slot_rows=16, chunks_per_round=(3, 1, 2))
+        out = pass_reorder_rounds(plan, _ctx(maxes=(48, 16, 32)))
+        assert out.round_order == (1, 2, 0)
+        # whole rounds move as units; chunk order within a round is kept
+        assert out.ordered_subrounds() == [
+            (1, 0, 1),
+            (2, 0, 2),
+            (2, 1, 2),
+            (0, 0, 3),
+            (0, 1, 3),
+            (0, 2, 3),
+        ]
+
+    def test_reorder_natural_order_untouched(self):
+        plan = ExchangePlan(slot_rows=16, chunks_per_round=(1, 2))
+        out = pass_reorder_rounds(plan, _ctx(maxes=(16, 32)))
+        assert out.round_order == ()
+
+    def test_bad_round_order_rejected(self):
+        plan = ExchangePlan(
+            slot_rows=16, chunks_per_round=(1, 1), round_order=(0, 0)
+        )
+        with pytest.raises(ValueError, match="permutation"):
+            plan.ordered_subrounds()
+
+    def test_optimize_plan_preserves_coverage(self, rng):
+        """Property gate over the whole pipeline: after every pass, each
+        round's chunks x slot still covers that round's hottest lane."""
+        for _ in range(25):
+            nrounds = int(rng.integers(1, 5))
+            maxes = tuple(int(m) for m in rng.integers(0, 500, size=nrounds))
+            slot = int(rng.integers(1, 400))
+            quota = int(rng.integers(1, 400))
+            ctx = _ctx(slot=slot, maxes=maxes)
+            plan = plan_exchange(maxes, slot, quota)
+            out = optimize_plan(plan, ctx)
+            for r, m in enumerate(maxes):
+                assert out.chunks_per_round[r] * out.slot_rows >= m
+            # slot stays a pow2 compile bucket
+            assert out.slot_rows & (out.slot_rows - 1) == 0
+            if out.round_order:
+                assert sorted(out.round_order) == list(range(nrounds))
+
+    def test_default_pass_order(self):
+        assert DEFAULT_PASSES == (
+            pass_pow2_bucket,
+            pass_coalesce_chunks,
+            pass_reorder_rounds,
+        )
+
+
+# ----------------------------------------------------------------------
+# PlanSignals: registry snapshot -> planner inputs
+
+
+class _FakeRegistry:
+    def __init__(self, samples):
+        self._samples = samples
+
+    def snapshot(self):
+        return list(self._samples)
+
+
+class TestPlanSignals:
+    def test_from_registry_distills_families(self):
+        drain = (("kind", "exchange.pipeline.drain"),)
+        submit = (("kind", "exchange.pipeline.submit"),)
+        reg = _FakeRegistry(
+            [
+                MetricSample("ops", "used_rows_total", 50.0, drain),
+                MetricSample("ops", "padded_rows_total", 50.0, drain),
+                MetricSample("ops", "total_ns_total", 2e9, drain),
+                MetricSample("ops", "total_ns_total", 1e9, submit),
+                MetricSample("wire", "rx_stall_p99_ns", 7e6),
+                MetricSample("wire", "credit_stall_ns", 2e6),
+                MetricSample("wire", "peer_health", 0.9),
+                MetricSample("wire", "peer_health", 0.4),
+                MetricSample("wire", "breaker_open", 1.0),
+                MetricSample("compress", "raw_bytes", 100.0),
+                MetricSample("compress", "encoded_bytes", 50.0),
+            ]
+        )
+        sig = PlanSignals.from_registry(reg)
+        assert sig.padding_fraction == pytest.approx(0.5)
+        assert sig.drain_occupancy == pytest.approx(2.0)
+        assert sig.rx_stall_p99_ns == 7_000_000
+        assert sig.credit_stall_ns == 2_000_000
+        assert sig.worst_peer_health == pytest.approx(0.4)  # min across peers
+        assert sig.breakers_open == 1
+        assert sig.compression_ratio == pytest.approx(2.0)
+
+    def test_empty_registry_is_cold_cluster(self):
+        sig = PlanSignals.from_registry(_FakeRegistry([]))
+        assert sig == PlanSignals()
+
+    def test_describe_is_flat_and_json_safe(self):
+        d = PlanSignals().describe()
+        assert all(isinstance(v, (int, float)) for v in d.values())
+
+
+# ----------------------------------------------------------------------
+# AdaptivePlanner: deterministic telemetry rules
+
+
+class TestAdaptivePlannerQuota:
+    # n=4 executors, hot lane 300 rows in a 4096-row slot: the single-shot
+    # plan stages mostly padding, so the planner should chunk.
+    def _skewed(self, **kw):
+        # used ~ one hot lane per sender; padding >> default 0.5 target
+        return _ctx(slot=3000, maxes=(300,), used=4 * 400, **kw)
+
+    def test_low_padding_stays_single_shot(self):
+        ctx = _ctx(slot=3000, maxes=(4000,), used=4 * 4 * 4096)
+        plan = AdaptivePlanner(TpuShuffleConf()).plan(ctx)
+        assert plan.single_shot is True
+
+    def test_high_padding_picks_staged_minimizing_quota(self):
+        """pow2 search over [256, 4096]: staged(256)=512, staged(512)=512,
+        staged(1024)=1024 ... — ties break toward the LARGER quota (fewer
+        launches for the same footprint), so 512 wins."""
+        plan = AdaptivePlanner(TpuShuffleConf()).plan(self._skewed())
+        assert plan.single_shot is False
+        assert plan.slot_rows == 512
+        assert plan.chunks_per_round == (1,)
+
+    def test_min_quota_floor_respected(self):
+        conf = TpuShuffleConf(planner_min_quota_rows=1024)
+        plan = AdaptivePlanner(conf).plan(self._skewed())
+        assert plan.single_shot is False
+        assert plan.slot_rows == 1024
+
+    def test_floor_above_slot_means_single_shot(self):
+        """A floor past the slot leaves only q == slot in the search — the
+        plan must stay single-shot (chunking cannot shrink the footprint)."""
+        conf = TpuShuffleConf(planner_min_quota_rows=1 << 20)
+        plan = AdaptivePlanner(conf).plan(self._skewed())
+        assert plan.single_shot is True
+        assert plan.slot_rows == 4096
+
+    def test_padding_target_knob_gates_chunking(self):
+        conf = TpuShuffleConf(planner_target_padding=0.99)
+        plan = AdaptivePlanner(conf).plan(self._skewed())
+        assert plan.single_shot is True
+
+    def test_forced_static_quota_wins(self):
+        """slotQuotaRows > 0 pins the collective schedule; the adaptive
+        layer must not second-guess it (only optimize geometry-safely)."""
+        conf = TpuShuffleConf(slot_quota_rows=16)
+        ctx = _ctx(slot=64, maxes=(48,), used=10)
+        plan = AdaptivePlanner(conf).plan(ctx)
+        static = StaticPlanner(conf).plan(ctx)
+        assert (plan.slot_rows, plan.chunks_per_round) == (
+            static.slot_rows,
+            static.chunks_per_round,
+        )
+
+    def test_lockstep_schedule_ignores_signals(self):
+        """THE SPMD invariant: two hosts with the same agreed geometry but
+        wildly different local telemetry derive the identical collective
+        schedule (only serve-plane fields may diverge)."""
+        hot = PlanSignals(
+            padding_fraction=0.99,
+            drain_occupancy=3.0,
+            rx_stall_p99_ns=10**9,
+            credit_stall_ns=10**9,
+            worst_peer_health=0.0,
+            breakers_open=3,
+            compression_ratio=1.0,
+        )
+        conf = TpuShuffleConf(fetch_hedge_ms=1, fetch_hedge_max_ms=100)
+        a = AdaptivePlanner(conf).plan(self._skewed())
+        b = AdaptivePlanner(conf).plan(self._skewed(signals=hot))
+        collective = lambda p: (
+            p.slot_rows,
+            p.chunks_per_round,
+            p.single_shot,
+            p.round_order,
+            p.lowering,
+        )
+        assert collective(a) == collective(b)
+
+
+class TestAdaptivePlannerServePlane:
+    def test_hedge_stretches_on_degraded_stall_tail(self):
+        conf = TpuShuffleConf(fetch_hedge_ms=5, fetch_hedge_max_ms=50)
+        sig = PlanSignals(worst_peer_health=0.3, rx_stall_p99_ns=int(10e6))
+        plan = AdaptivePlanner(conf).plan(_ctx(signals=sig))
+        assert plan.hedge_ms == 20  # 2x the 10ms p99 stall
+
+    def test_hedge_clamped_to_max(self):
+        conf = TpuShuffleConf(fetch_hedge_ms=5, fetch_hedge_max_ms=50)
+        sig = PlanSignals(breakers_open=1, rx_stall_p99_ns=int(40e6))
+        plan = AdaptivePlanner(conf).plan(_ctx(signals=sig))
+        assert plan.hedge_ms == 50  # 80ms ask, clamped
+
+    def test_healthy_peers_keep_conf_hedge(self):
+        conf = TpuShuffleConf(fetch_hedge_ms=5, fetch_hedge_max_ms=50)
+        sig = PlanSignals(rx_stall_p99_ns=int(40e6))  # stall but healthy
+        plan = AdaptivePlanner(conf).plan(_ctx(signals=sig))
+        assert plan.hedge_ms == 5
+
+    def test_incompressible_traffic_drops_codec(self):
+        conf = TpuShuffleConf(wire_compress_codec="rle")
+        sig = PlanSignals(compression_ratio=1.01)
+        plan = AdaptivePlanner(conf).plan(_ctx(signals=sig))
+        assert plan.codec == "off"
+
+    def test_compressible_traffic_keeps_codec(self):
+        conf = TpuShuffleConf(wire_compress_codec="rle")
+        sig = PlanSignals(compression_ratio=2.0)
+        plan = AdaptivePlanner(conf).plan(_ctx(signals=sig))
+        assert plan.codec == "rle"
+
+    def test_credit_stall_doubles_streams_capped(self):
+        sig = PlanSignals(credit_stall_ns=int(5e6))
+        plan = AdaptivePlanner(TpuShuffleConf(wire_streams=4)).plan(
+            _ctx(signals=sig)
+        )
+        assert plan.streams == 8
+        plan = AdaptivePlanner(TpuShuffleConf(wire_streams=8)).plan(
+            _ctx(signals=sig)
+        )
+        assert plan.streams == 8  # cap
+
+    def test_drain_bottleneck_deepens_pipeline_capped(self):
+        sig = PlanSignals(drain_occupancy=1.5)
+        plan = AdaptivePlanner(TpuShuffleConf()).plan(_ctx(signals=sig))
+        assert plan.pipeline_depth == 3  # default 2 + 1
+        plan = AdaptivePlanner(TpuShuffleConf(pipeline_depth=4)).plan(
+            _ctx(signals=sig)
+        )
+        assert plan.pipeline_depth == 4  # cap
+
+
+# ----------------------------------------------------------------------
+# host_recv_mode gate: ONE validation, identical everywhere
+
+
+class TestHostRecvModeGate:
+    def test_vocabulary_pin(self):
+        assert HOST_RECV_MODES == ("array", "memmap", "device")
+
+    def test_unknown_mode_names_full_vocabulary(self):
+        with pytest.raises(
+            ValueError, match=r"unknown host_recv_mode 'bogus' \(array\|memmap\|device\)"
+        ):
+            validate_host_recv_mode("bogus")
+
+    def test_unsupported_mode_names_deployment(self):
+        with pytest.raises(
+            ValueError,
+            match=r"host_recv_mode 'device' is not supported by the SPMD executor",
+        ):
+            validate_host_recv_mode(
+                "device", allowed=("array", "memmap"), where="the SPMD executor"
+            )
+
+    def test_cluster_rejects_unknown_mode_before_staging(self):
+        """The loopback cluster routes through the same gate, before any
+        staging allocation — the error fires on run_exchange, not mid-drain."""
+        conf = _conf(0)
+        cluster = TpuShuffleCluster(
+            dataclasses.replace(conf, host_recv_mode="bogus"),
+            num_executors=N_EXEC,
+        )
+        _write_skewed(cluster, 0, N_EXEC, 4)
+        with pytest.raises(ValueError, match="unknown host_recv_mode"):
+            cluster.run_exchange(0)
+
+
+# ----------------------------------------------------------------------
+# build_plan_exchange: THE lowering dispatch == the per-variant builders
+
+_needs4 = pytest.mark.skipif(
+    jax.device_count() < 4, reason="needs a 4-device mesh (conftest forces 8)"
+)
+
+
+@_needs4
+class TestBuildPlanExchange:
+    N, SLOT, LANE = 4, 8, 8
+
+    def _mesh(self):
+        from sparkucx_tpu.ops.exchange import make_mesh
+
+        return make_mesh(self.N)
+
+    def _case(self, rng):
+        n, slot = self.N, self.SLOT
+        data = rng.integers(
+            -100, 100, size=(n * n * slot, self.LANE), dtype=np.int32
+        )
+        sizes = rng.integers(0, slot + 1, size=(n, n)).astype(np.int32)
+        return data, sizes
+
+    def _run(self, fn, mesh, data, sizes):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P("ex", None))
+        recv, rs = fn(
+            jax.device_put(data, sharding), jax.device_put(sizes, sharding)
+        )
+        return np.asarray(recv), np.asarray(rs)
+
+    def _plan_fn(self, mesh, impl, quantize=None):
+        return build_plan_exchange(
+            mesh,
+            num_executors=self.N,
+            send_rows=self.N * self.SLOT,
+            lane=self.LANE,
+            axis_name="ex",
+            impl=impl,
+            quantize=quantize,
+        )
+
+    def test_stock_matches_build_exchange(self, rng):
+        from sparkucx_tpu.ops.exchange import ExchangeSpec, build_exchange
+
+        mesh = self._mesh()
+        data, sizes = self._case(rng)
+        spec = ExchangeSpec(
+            num_executors=self.N,
+            send_rows=self.N * self.SLOT,
+            recv_rows=self.N * self.SLOT,
+            lane=self.LANE,
+        )
+        recv_ref, rs_ref = self._run(
+            build_exchange(mesh, spec), mesh, data.copy(), sizes
+        )
+        recv, rs = self._run(self._plan_fn(mesh, "stock"), mesh, data.copy(), sizes)
+        np.testing.assert_array_equal(rs, rs_ref)
+        assert recv.tobytes() == recv_ref.tobytes()
+
+    def test_pallas_tier_bit_identical_to_stock(self, rng):
+        mesh = self._mesh()
+        data, sizes = self._case(rng)
+        recv_ref, rs_ref = self._run(
+            self._plan_fn(mesh, "stock"), mesh, data.copy(), sizes
+        )
+        recv, rs = self._run(self._plan_fn(mesh, "pallas"), mesh, data.copy(), sizes)
+        np.testing.assert_array_equal(rs, rs_ref)
+        assert recv.tobytes() == recv_ref.tobytes()
+
+    def test_quantized_route_matches_direct_builder(self, rng):
+        from sparkucx_tpu.ops.compress import QuantizeSpec
+        from sparkucx_tpu.ops.exchange import ExchangeSpec
+        from sparkucx_tpu.ops.ici_exchange import build_quantized_exchange
+
+        mesh = self._mesh()
+        q = QuantizeSpec(mode="int8", block_size=8)
+        data = np.random.default_rng(3).normal(
+            scale=5.0, size=(self.N * self.N * self.SLOT, self.LANE)
+        ).astype(np.float32)
+        sizes = np.random.default_rng(4).integers(
+            0, self.SLOT + 1, size=(self.N, self.N)
+        ).astype(np.int32)
+        spec = ExchangeSpec(
+            num_executors=self.N,
+            send_rows=self.N * self.SLOT,
+            recv_rows=self.N * self.SLOT,
+            lane=self.LANE,
+        )
+        recv_ref, rs_ref = self._run(
+            build_quantized_exchange(mesh, spec, q),
+            mesh,
+            data.copy(),
+            sizes,
+        )
+        recv, rs = self._run(
+            self._plan_fn(mesh, "stock", quantize=q), mesh, data.copy(), sizes
+        )
+        np.testing.assert_array_equal(rs, rs_ref)
+        assert recv.tobytes() == recv_ref.tobytes()
+
+
+# ----------------------------------------------------------------------
+# transport golden equivalence: plan-driven runs vs the default engine
+# (same seeded writes as tests/test_skew.py — byte-for-byte receive state)
+
+
+def _buf(n):
+    return MemoryBlock(np.zeros(n, dtype=np.uint8), size=n)
+
+
+def _write_skewed(cluster, shuffle_id, M, R, seed=77):
+    meta = cluster.create_shuffle(shuffle_id, M, R)
+    rng = np.random.default_rng(seed)
+    oracle = {}
+    for m in range(M):
+        t = cluster.transport(meta.map_owner[m])
+        w = t.store.map_writer(shuffle_id, m)
+        for r in range(R):
+            size = int(rng.integers(2000, 3000)) if r == 0 else int(rng.integers(1, 300))
+            payload = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+            oracle[(m, r)] = payload
+            w.write_partition(r, payload)
+        t.commit_block(w.commit().pack())
+    return meta, oracle
+
+
+def _fetch_all(cluster, meta, shuffle_id, M, R, oracle):
+    for r in range(R):
+        consumer = meta.owner_of_reduce(r)
+        t = cluster.transport(consumer)
+        bufs = [_buf(8192) for _ in range(M)]
+        reqs = t.fetch_blocks_by_block_ids(
+            consumer,
+            [ShuffleBlockId(shuffle_id, m, r) for m in range(M)],
+            bufs,
+            [None] * M,
+        )
+        for m in range(M):
+            res = reqs[m].wait(5)
+            assert res.status == OperationStatus.SUCCESS, str(res.error)
+            assert bufs[m].host_view()[: bufs[m].size].tobytes() == oracle[(m, r)]
+
+
+def _conf(quota, mode="array", **kw):
+    return TpuShuffleConf(
+        staging_capacity_per_executor=N_EXEC * 4096,
+        block_alignment=128,
+        num_executors=N_EXEC,
+        host_recv_mode=mode,
+        slot_quota_rows=quota,
+        **kw,
+    )
+
+
+def _exchange(conf, M=3 * N_EXEC, R=8):
+    cluster = TpuShuffleCluster(conf, num_executors=N_EXEC)
+    meta, oracle = _write_skewed(cluster, 0, M, R)
+    cluster.run_exchange(0)
+    return cluster, meta, oracle
+
+
+def _assert_prefix_equal(meta, base_meta):
+    """Every consumer's shard is byte-equal to the default run's receive
+    buffer over the valid prefix (tight chunked shards vs padded single-shot
+    shards — same bytes where it matters)."""
+    assert len(meta.recv_sizes) == len(base_meta.recv_sizes)
+    for rnd in range(len(base_meta.recv_sizes)):
+        np.testing.assert_array_equal(
+            meta.recv_sizes[rnd], base_meta.recv_sizes[rnd]
+        )
+        for j in range(N_EXEC):
+            used = int(base_meta.recv_sizes[rnd][j].sum()) * 128
+            got = bytes(meta.recv_shards[rnd][j][: max(used, 0)].reshape(-1))
+            want = bytes(base_meta.recv_shards[rnd][j][:used])
+            assert got == want
+
+
+class TestClusterGoldenEquivalence:
+    def test_optimize_on_single_shot_bit_identical(self):
+        base_cluster, base_meta, oracle = _exchange(_conf(0))
+        cluster, meta, _ = _exchange(_conf(0, planner_optimize=True))
+        _assert_prefix_equal(meta, base_meta)
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    def test_optimize_on_quota_bit_identical(self):
+        """The reorder pass permutes sub-round SUBMISSION on the quota path;
+        results must still land in natural round order, byte-identical."""
+        base_cluster, base_meta, oracle = _exchange(_conf(8))
+        cluster, meta, _ = _exchange(_conf(8, planner_optimize=True))
+        assert len(base_meta.recv_sizes) > 1, "should spill multiple rounds"
+        for rnd in range(len(base_meta.recv_sizes)):
+            np.testing.assert_array_equal(
+                meta.recv_sizes[rnd], base_meta.recv_sizes[rnd]
+            )
+            for j in range(N_EXEC):
+                assert bytes(meta.recv_shards[rnd][j]) == bytes(
+                    base_meta.recv_shards[rnd][j]
+                )
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    @pytest.mark.parametrize("mode", ["array", "memmap", "device"])
+    def test_adaptive_bit_identical_each_recv_mode(self, mode, tmp_path):
+        """The adaptive planner re-plans from geometry (no telemetry yet on
+        a fresh cluster) and chunks the padded skew away — the bytes served
+        to every consumer must not move, in any host_recv_mode."""
+        base_cluster, base_meta, oracle = _exchange(_conf(0))
+        kw = {"planner_mode": "adaptive", "planner_min_quota_rows": 8}
+        if mode == "memmap":
+            kw["spill_dir"] = str(tmp_path)
+        if mode == "device":
+            kw["keep_device_recv"] = True
+        cluster, meta, _ = _exchange(_conf(0, mode=mode, **kw))
+        if mode == "device":
+            assert meta.recv_shards is None  # no host copy, fetch from HBM
+        else:
+            _assert_prefix_equal(meta, base_meta)
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    def test_adaptive_actually_chunked(self):
+        """Guard against the adaptive path silently degenerating into the
+        static single-shot plan: on this skew (hot lane ~24 rows, mostly
+        1-3 row lanes in a 32-row slot) predicted padding clears the 0.5
+        target and the quota search must fire — visible as tight shards and
+        drain-side padding telemetry."""
+        cluster, meta, _ = _exchange(
+            _conf(0, planner_mode="adaptive", planner_min_quota_rows=8)
+        )
+        tight = [
+            meta.recv_shards[rnd][j].nbytes
+            == int(meta.recv_sizes[rnd][j].sum()) * 128
+            for rnd in range(len(meta.recv_sizes))
+            for j in range(N_EXEC)
+        ]
+        assert all(tight), "adaptive plan should drain tight chunked shards"
+        drain = cluster.stats.summary("exchange.pipeline.drain")
+        assert drain.used_rows > 0
+
+    def test_pallas_lowering_bit_identical(self):
+        base_cluster, base_meta, oracle = _exchange(_conf(0))
+        cluster, meta, _ = _exchange(_conf(0, exchange_impl="pallas"))
+        _assert_prefix_equal(meta, base_meta)
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    def test_pallas_quota_bit_identical(self):
+        base_cluster, base_meta, oracle = _exchange(_conf(8))
+        cluster, meta, _ = _exchange(_conf(8, exchange_impl="pallas"))
+        for rnd in range(len(base_meta.recv_sizes)):
+            np.testing.assert_array_equal(
+                meta.recv_sizes[rnd], base_meta.recv_sizes[rnd]
+            )
+            for j in range(N_EXEC):
+                assert bytes(meta.recv_shards[rnd][j]) == bytes(
+                    base_meta.recv_shards[rnd][j]
+                )
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    def test_compressed_wire_adaptive_serves_exact_bytes(self):
+        """Serve-plane codec under an adaptive plan: pages ride the wire
+        RLE-encoded, consumers still read the exact oracle bytes."""
+        cluster, meta, oracle = _exchange(
+            _conf(
+                0,
+                planner_mode="adaptive",
+                planner_min_quota_rows=8,
+                wire_compress_codec="rle",
+            )
+        )
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+    def test_quantized_conf_rides_plan(self):
+        """Quantization knobs land on the plan (serve/aggregation plane);
+        the collective executor never quantizes shuffle bytes — fetches
+        still serve the exact oracle."""
+        cluster, meta, oracle = _exchange(
+            _conf(8, quantize_mode="int8", quantize_block_size=64)
+        )
+        _fetch_all(cluster, meta, 0, 3 * N_EXEC, 8, oracle)
+
+
+class TestExchangePlanSpan:
+    def test_plan_traced_per_shuffle(self):
+        """Every exchange emits one ``exchange.plan`` instant carrying the
+        full plan describe() plus the signal snapshot it was justified by."""
+        prev_enabled, prev_recording = TRACER.enabled, TRACER.recording
+        TRACER.clear()
+        TRACER.enable()
+        try:
+            _exchange(_conf(0, planner_mode="adaptive", planner_min_quota_rows=8))
+            evs = [e for e in TRACER.events if e["name"] == "exchange.plan"]
+            assert evs, "exchange.plan instant missing"
+            args = evs[0]["args"]
+            assert args["planner"] == "AdaptivePlanner"
+            assert args["shuffle_id"] == 0
+            for key in (
+                "slot_rows",
+                "chunks_per_round",
+                "single_shot",
+                "lowering",
+                "codec",
+                "hedge_ms",
+                "signal_padding_fraction",
+                "signal_worst_peer_health",
+                "signal_compression_ratio",
+            ):
+                assert key in args, key
+        finally:
+            TRACER.enabled, TRACER.recording = prev_enabled, prev_recording
+            TRACER.clear()
