@@ -723,6 +723,26 @@ def main():
             }
         except Exception as e:
             RESULT["ici_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            # compute-in-exchange: the receive-side fused combine vs the
+            # unfused exchange-then-fold reference.  Bit equality is asserted
+            # inside measure_combine; the drain ratio is the O(rows) landed
+            # grid over the O(groups) accumulator each device drains instead.
+            if budget_left() < 90:
+                raise TimeoutError(f"skipped: {budget_left():.0f}s of deadline left")
+            from sparkucx_tpu.perf.benchmark import measure_combine
+
+            cb = measure_combine(8, 1024, 128, iterations=REPEATS)
+            RESULT["combine"] = {
+                "executors": cb["executors"],
+                "fused_gbps": round(cb["fused_gbps"], 3),
+                "unfused_gbps": round(cb["unfused_gbps"], 3),
+                "drain_ratio": round(cb["drain"]["ratio"], 1),
+                "lowering": cb["lowering"],
+                "fused_single_launch": cb["launches"] == 1,
+            }
+        except Exception as e:
+            RESULT["combine_error"] = f"{type(e).__name__}: {e}"[:200]
 
     emit_once()
 

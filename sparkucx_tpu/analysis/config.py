@@ -135,6 +135,9 @@ DONATING_BUILDERS = {
     "build_fused_ici_exchange": (4,),
     "build_quantized_exchange": (0,),  # tier-b twin of build_ici_exchange
     "build_quantized_fused_exchange": (4,),  # tier-b twin: staging donated
+    # fused combine fn(data, sizes, accv, accc): the running accumulator is
+    # consumed and re-emitted in place across quota sub-rounds
+    "build_combine_exchange": (2, 3),
     "_exchange_fn": (0,),  # TpuShuffleCluster cache front-end for build_exchange
 }
 
@@ -326,6 +329,7 @@ OFF_PATH_DEFAULTS = {
     "obs_metrics_port": 0,
     "obs_ring_capacity": 8192,
     "obs_postmortem_dir": "",
+    "exchange_fused_combine": False,
 }
 
 # ----------------------------------------------------------------------

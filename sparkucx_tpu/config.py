@@ -333,6 +333,16 @@ class TpuShuffleConf:
     #: tests/test_ici_exchange.py), or 'auto' (pallas on multi-chip TPU
     #: meshes, stock everywhere else).
     exchange_impl: str = "stock"
+    #: Receive-side compute-in-exchange for partial grouped aggregations
+    #: (ops/combine.py + ops/relational.py): fold each landed exchange window
+    #: into a fixed per-group accumulator inside the collective instead of
+    #: staging it — O(groups) post-exchange memory and drain bytes instead of
+    #: O(rows), and one fused kernel launch under the Pallas DMA lowering.
+    #: Default off = the unfused path, byte-identical to every prior release.
+    #: The planner picks the tier ('dense' when the key domain is
+    #: dense-representable and the accumulator undercuts recv staging,
+    #: 'sorted' bounded merge otherwise); raw block exchanges ignore the knob.
+    exchange_fused_combine: bool = False
     #: Map-side partial aggregation below the exchange for GROUP BY jobs —
     #: Spark's HashAggregateExec(partial) under the ShuffleExchange, on by
     #: default exactly as in Spark.  Consumed by ``AggregateSpec.from_conf``
@@ -505,6 +515,7 @@ class TpuShuffleConf:
             ("keepDeviceRecv", "keep_device_recv", lambda v: str(v).lower() == "true"),
             ("gatherImpl", "gather_impl", str),
             ("exchange.impl", "exchange_impl", str),
+            ("exchange.fusedCombine", "exchange_fused_combine", lambda v: str(v).lower() == "true"),
             ("partialAggregation", "partial_aggregation", lambda v: str(v).lower() == "true"),
             ("hostRecvMode", "host_recv_mode", str),
             ("spillToDisk", "spill_to_disk", lambda v: str(v).lower() == "true"),

@@ -6,6 +6,7 @@ compilation serves every batch.  See each module's docstring for the reference
 behavior it reproduces.
 """
 
+from sparkucx_tpu.ops.combine import CombineSpec
 from sparkucx_tpu.ops.columnar import (
     ColumnarSpec,
     build_columnar_shuffle,
@@ -47,6 +48,7 @@ from sparkucx_tpu.ops.relational import (
     plan_join_capacities,
     run_grouped_aggregate,
     run_hash_join,
+    run_plan_grouped_aggregate,
 )
 from sparkucx_tpu.ops.sort import (
     SortSpec,

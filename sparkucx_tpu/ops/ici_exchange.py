@@ -697,6 +697,176 @@ def build_quantized_exchange(
     return fn
 
 
+# ----------------------------------------------------------------------------
+# Fused-combine lowering + builder (receive-side compute-in-exchange)
+# ----------------------------------------------------------------------------
+
+
+def _combine_axis_grid_xla(ax, dim: int, slot_rows: int, sched: RingSchedule, flat, me, cspec):
+    """Scheduled-permute fold: one ppermute per item, but every landed window
+    goes straight into the dense accumulator — the sender-major grid is never
+    materialized, so even this tier's post-exchange memory is O(groups).
+
+    Fold order is the canonical one every lowering shares (own slot, then
+    schedule items in step order) — bit-equality across tiers for exact
+    dtypes rests on it."""
+    from sparkucx_tpu.ops.combine import acc_init, combine_window
+
+    lane = flat.shape[1]
+    accv, accc = acc_init(cspec)
+    own = jax.lax.dynamic_slice(flat, (me * slot_rows, 0), (slot_rows, lane))
+    accv, accc = combine_window(cspec, own, accv, accc)
+    w = slot_rows // sched.chunks
+    for step in sched.steps:
+        for item in step:
+            d = item.offset
+            send_row = ((me + d) % dim) * slot_rows + item.chunk * w
+            window = jax.lax.dynamic_slice(flat, (send_row, 0), (w, lane))
+            got = jax.lax.ppermute(
+                window, ax, [(i, (i + d) % dim) for i in range(dim)]
+            )
+            accv, accc = combine_window(cspec, got, accv, accc)
+    return accv, accc
+
+
+def combine_axis_grid(
+    ax, dim, slot_rows, sched, flat, me, cspec, lowering, mesh_axes=None
+):
+    """Dispatch one fused-combine exchange phase to its lowering tier and
+    return the ``(acc_vals, acc_counts)`` accumulator pair (identity-seeded —
+    callers merge running accumulators via ``merge_accumulators``).  Also the
+    shard-body entry point for ops/relational.py's fused aggregate, which
+    runs its own shard_map."""
+    lowering = resolve_schedule_lowering(lowering, sched.kind)
+    if lowering == "xla":
+        return _combine_axis_grid_xla(ax, dim, slot_rows, sched, flat, me, cspec)
+    from sparkucx_tpu.ops.combine import acc_init, combine_window
+    from sparkucx_tpu.ops.pallas_kernels import ring_combine_grid
+
+    _grid, accv, accc = ring_combine_grid(
+        ax,
+        dim,
+        slot_rows,
+        slot_rows // sched.chunks,
+        sched.raw_steps(),
+        functools.partial(combine_window, cspec),
+        functools.partial(acc_init, cspec),
+        cspec.num_groups,
+        cspec.width,
+        flat,
+        mesh_axes=mesh_axes,
+        interpret=(lowering == "interpret"),
+    )
+    # the landed grid stays on device and unread — the accumulator IS the
+    # receive side; XLA drops the unused output buffer from the drain
+    return accv, accc
+
+
+def _combine_prep(mesh: Mesh, spec, cspec, lowering: str, chunks_per_dest, schedule):
+    """Shared validation + schedule resolution for the fused-combine builder
+    (flat meshes only — the combinable payload rides one ring)."""
+    if set(mesh.axis_names) == {"dcn", "ici"}:
+        raise ValueError("combine exchange supports flat meshes only")
+    if spec.num_executors != mesh.devices.size:
+        raise ValueError(
+            f"spec.num_executors={spec.num_executors} != mesh size {mesh.devices.size}"
+        )
+    cspec.validate()
+    if spec.lane != cspec.row_width:
+        raise ValueError(
+            f"spec.lane={spec.lane} != combine row width {cspec.row_width} "
+            f"(key + payload + count)"
+        )
+    platform = mesh.devices.reshape(-1)[0].platform
+    resolved = spec.resolve_impl(platform=platform)
+    resolved.validate()
+    if resolved.num_executors == 1:
+        raise ValueError("combine ici exchange needs num_executors > 1")
+    low = resolve_ici_lowering(lowering, platform)
+    if schedule is None:
+        ids = device_slice_ids(mesh.devices.reshape(-1))
+        kind = "ici" if ids is None or len(set(ids)) == 1 else "dcn"
+        chunks = schedule_chunks(resolved.slot_rows, chunks_per_dest)
+        schedule = ring_schedule(resolved.num_executors, chunks, kind=kind)
+    if not isinstance(schedule, RingSchedule):
+        raise ValueError("flat mesh needs a RingSchedule")
+    if resolved.slot_rows % schedule.chunks:
+        raise ValueError(
+            f"chunks {schedule.chunks} must divide slot_rows {resolved.slot_rows}"
+        )
+    low = resolve_schedule_lowering(low, schedule.kind)
+    return platform, resolved, low, schedule
+
+
+def build_combine_exchange(
+    mesh: Mesh,
+    spec,
+    cspec,
+    *,
+    chunks_per_dest: int = 1,
+    lowering: str = "auto",
+    schedule=None,
+):
+    """Compile the fused-combine exchange: ``fn(data, size_matrix, acc_vals,
+    acc_counts) -> (acc_vals, acc_counts, recv_sizes)`` — the scheduled ring
+    with the receive side REPLACED by the dense per-group fold
+    (ops/combine.py): landed windows are dequantized and combined as they
+    arrive, never compacted into a recv buffer.
+
+    * ``data``: ``(n * send_rows, lane)`` slot-layout partial-aggregate
+      staging, rows in the combine layout ``[key | payload | count]``
+      (``cspec.row_width`` lanes, enforced against ``spec.lane``).
+    * ``acc_vals`` ``(n * num_groups, width)`` / ``acc_counts``
+      ``(n * num_groups, 1)`` — the RUNNING accumulator, merged with this
+      exchange's fold and returned.  Both are donated (argnums 2, 3): quota
+      sub-rounds thread one accumulator through every call in place instead
+      of staging O(rows) per sub-round.  Seed fresh rounds with
+      ``ops/combine.acc_init`` under shard_map (or tile its host values).
+    * ``recv_sizes``: the usual ``(n, n)`` receive-size metadata — row
+      accounting is unchanged, only the payload drain shrinks to O(groups).
+
+    ``lowering`` follows ``build_ici_exchange``: 'dma' is ONE fused kernel
+    launch (pallas_kernels.ring_combine_grid) on TPU, 'xla' the scheduled
+    permutes with per-window folds, 'interpret' the kernel body under the
+    Pallas interpreter (CI).  Bit-equality across tiers for exact dtypes is
+    pinned by tests/test_fused_combine.py.  Flat meshes only."""
+    from sparkucx_tpu.ops.combine import merge_accumulators
+
+    platform, resolved, low, schedule = _combine_prep(
+        mesh, spec, cspec, lowering, chunks_per_dest, schedule
+    )
+    n, slot = resolved.num_executors, resolved.slot_rows
+
+    def body(data, size_row, accv, accc):
+        me, sizes = gather_size_matrix(resolved, size_row)
+        recv_sizes = sizes[:, me]
+        av, ac = combine_axis_grid(
+            resolved.axis_name, n, slot, schedule, data, me, cspec, low
+        )
+        accv, accc = merge_accumulators(cspec, (accv, accc), (av, ac))
+        return accv, accc, recv_sizes[None, :]
+
+    pspec = P(resolved.axis_name, None)
+    shard = shard_map(
+        body, mesh=mesh, in_specs=(pspec,) * 4, out_specs=(pspec,) * 3,
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, pspec)
+    # the running accumulator is consumed and re-emitted with identical
+    # shape/sharding every call — donate so sub-round chaining is in place
+    fn = jax.jit(
+        shard,
+        in_shardings=(sharding,) * 4,
+        out_shardings=(sharding,) * 3,
+        donate_argnums=(2, 3),
+    )
+    fn.spec = resolved
+    fn.schedule = schedule
+    fn.lowering = low
+    fn.cspec = cspec
+    return fn
+
+
 def build_quantized_fused_exchange(
     mesh: Mesh,
     spec,

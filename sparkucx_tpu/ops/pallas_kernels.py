@@ -508,7 +508,7 @@ def _ring_device_id(mesh_axes, axis_name):
 
 def _ring_exchange_steps(
     num_devices, slot_rows, window_rows, steps, me, dev_id, data_ref, out_ref,
-    send_sem, recv_sem,
+    send_sem, recv_sem, on_step=None,
 ):
     """Shared schedule walk: remote-copy every (offset, chunk) window.
 
@@ -517,7 +517,12 @@ def _ring_exchange_steps(
     schedule is SPMD-symmetric, so each step's ``wait()`` pairs my outgoing
     descriptor with the incoming copy of the same (offset, chunk) from
     ``me-d`` — same window size, same semaphore index, both directions of the
-    ring in flight at once."""
+    ring in flight at once.
+
+    ``on_step(step)`` — optional superstep epilogue, called after the step's
+    waits: every window the step delivered is landed in ``out_ref`` and may
+    be consumed before the next step's copies start (the fused-combine
+    kernel's receive-side fold, ``ring_combine_grid``)."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -543,6 +548,8 @@ def _ring_exchange_steps(
             copies.append(copy)
         for copy in copies:
             copy.wait()
+        if on_step is not None:
+            on_step(step)
 
 
 def _ring_barrier(num_devices, offsets, me, dev_id):
@@ -642,6 +649,151 @@ def ring_exchange_grid(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=tpu_compiler_params(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interpret,
+    )(data)
+
+
+def ring_combine_grid(
+    axis_name: str,
+    num_devices: int,
+    slot_rows: int,
+    window_rows: int,
+    steps,
+    combine_fn,
+    acc_init_fn,
+    num_groups: int,
+    acc_width: int,
+    data,
+    *,
+    mesh_axes=None,
+    interpret: bool = False,
+    collective_id: int = 15,
+):
+    """Fused receive side: scheduled ring exchange + per-superstep combine
+    fold, ONE kernel — the compute-in-exchange tier (ops/combine.py).
+
+    Same wire schedule as :func:`ring_exchange_grid`; the difference is what
+    happens to a landed window.  After each superstep's waits, every window
+    the step delivered (the ``(offset, chunk)`` region from sender
+    ``me - offset``) is DMA'd into VMEM and folded into a dense per-group
+    accumulator held in VMEM for the whole schedule — landed rows are
+    consumed the moment they arrive instead of surviving as O(rows) recv
+    staging, and the post-exchange drain is the O(groups) accumulator.
+
+    * ``combine_fn(window, acc_vals, acc_counts) -> (acc_vals, acc_counts)``
+      — the fold (``ops/combine.combine_window`` closed over its spec);
+      plain traced jnp over static shapes, so this module stays free of the
+      combine dataclasses exactly as it stays free of the schedule ones.
+    * ``acc_init_fn() -> (acc_vals (G, w), acc_counts (G, 1))`` — the fold
+      identities.
+    * returns ``(grid, acc_vals, acc_counts)``: the sender-major landed grid
+      (callers keep it on device or discard it — it never drains) plus the
+      accumulator pair.
+
+    Window fold order is canonical — own slot first, then schedule items in
+    step order — and shared with the scheduled-XLA walk
+    (ops/ici_exchange.py), so exact dtypes are bit-identical across
+    lowerings.  ``interpret=True`` runs the same body under the Pallas
+    interpreter (CI's tier; the barrier is skipped as in
+    :func:`ring_exchange_grid`).
+    """
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if mesh_axes is None:
+        mesh_axes = ((axis_name, num_devices),)
+    mesh_axes = tuple((str(n), int(s)) for n, s in mesh_axes)
+    if dict(mesh_axes)[axis_name] != num_devices:
+        raise ValueError(
+            f"ring axis {axis_name!r} has size {dict(mesh_axes)[axis_name]} in "
+            f"mesh_axes, expected num_devices={num_devices}"
+        )
+    steps = tuple(tuple(step) for step in steps)
+    offsets = sorted({offset for step in steps for offset, _, _ in step})
+    lane = int(data.shape[1])
+
+    def kernel(
+        data_ref, grid_ref, accv_ref, accc_ref,
+        send_sem, recv_sem, local_sem, accv_vmem, accc_vmem, win_vmem,
+    ):
+        me = jax.lax.axis_index(axis_name)
+        dev_id = _ring_device_id(mesh_axes, axis_name)
+        if not interpret:  # interpret discharge is synchronous; the barrier
+            _ring_barrier(num_devices, offsets, me, dev_id)  # is TPU-only
+        av0, ac0 = acc_init_fn()
+        accv_vmem[...] = av0
+        accc_vmem[...] = ac0
+
+        def fold(row0, rows):
+            # land the window in VMEM, fold it, keep the acc resident
+            cp = pltpu.make_async_copy(
+                grid_ref.at[pl.ds(row0, rows)],
+                win_vmem.at[pl.ds(0, rows)],
+                local_sem,
+            )
+            cp.start()
+            cp.wait()
+            av, ac = combine_fn(win_vmem[0:rows], accv_vmem[...], accc_vmem[...])
+            accv_vmem[...] = av
+            accc_vmem[...] = ac
+
+        # own slot never crosses a link: one local HBM->HBM DMA, folded first
+        # (the canonical order every lowering shares)
+        local = pltpu.make_async_copy(
+            data_ref.at[pl.ds(me * slot_rows, slot_rows)],
+            grid_ref.at[pl.ds(me * slot_rows, slot_rows)],
+            local_sem,
+        )
+        local.start()
+        local.wait()
+        fold(me * slot_rows, slot_rows)
+
+        def epilogue(step):
+            # every window this superstep delivered: sender me-d's chunk
+            for offset, chunk, _direction in step:
+                src = jax.lax.rem(me - offset + num_devices, num_devices)
+                fold(src * slot_rows + chunk * window_rows, window_rows)
+
+        _ring_exchange_steps(
+            num_devices, slot_rows, window_rows, steps, me, dev_id,
+            data_ref, grid_ref, send_sem, recv_sem, on_step=epilogue,
+        )
+        # drain the O(groups) accumulator to HBM — the only receive-side
+        # bytes that leave the kernel
+        for vmem, out in ((accv_vmem, accv_ref), (accc_vmem, accc_ref)):
+            flush = pltpu.make_async_copy(
+                vmem.at[pl.ds(0, num_groups)],
+                out.at[pl.ds(0, num_groups)],
+                local_sem,
+            )
+            flush.start()
+            flush.wait()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((num_devices * slot_rows, lane), data.dtype),
+            jax.ShapeDtypeStruct((num_groups, acc_width), data.dtype),
+            jax.ShapeDtypeStruct((num_groups, 1), jnp.int32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((num_groups, acc_width), data.dtype),
+            pltpu.VMEM((num_groups, 1), jnp.int32),
+            pltpu.VMEM((slot_rows, lane), data.dtype),
         ],
         compiler_params=tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id

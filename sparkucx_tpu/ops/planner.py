@@ -165,12 +165,36 @@ class PlanContext:
     used_rows_total: int = 0
     row_bytes: int = 128
     platform: str = "cpu"
+    #: the shuffle carries a partial grouped aggregation (an ``AggregateSpec``
+    #: with ``partial=True``) — the only traffic whose landed rows are
+    #: combinable inside the exchange.  Static spec geometry, identical on
+    #: every SPMD process by construction.
+    agg_partial: bool = False
+    #: dense key-domain size (groups) when the aggregation keys are
+    #: dense-representable, else 0 (forces the sorted fallback)
+    agg_groups: int = 0
+    #: aggregate payload lanes (value columns; key/count lanes excluded)
+    agg_width: int = 0
+    #: bytes per aggregate value-lane element
+    agg_itemsize: int = 4
     #: local telemetry — serve-plane decisions only (see module docstring)
     signals: PlanSignals = PlanSignals()
 
     @property
     def num_rounds(self) -> int:
         return len(self.round_max_rows)
+
+    @property
+    def recv_staging_bytes(self) -> int:
+        """Bytes one receiver's sender-major grid stages per sub-round — what
+        the dense combine accumulator must undercut to be worth fusing."""
+        return self.num_executors * self.staging_slot_rows * self.row_bytes
+
+    @property
+    def combine_acc_bytes(self) -> int:
+        """Bytes of the dense per-group accumulator (``agg_width`` value
+        lanes plus one int32 count lane per group)."""
+        return self.agg_groups * (self.agg_width * self.agg_itemsize + 4)
 
     def predicted_padding(self, slot_rows: int) -> float:
         """Padding fraction the single-shot plan would stage at ``slot_rows``
@@ -189,6 +213,25 @@ class PlanContext:
         """Mean used rows per (sender, dest) lane across the shuffle."""
         lanes = self.num_executors * self.num_executors * max(self.num_rounds, 1)
         return self.used_rows_total / lanes if lanes else 0.0
+
+
+def _combine_tier(conf, ctx: PlanContext, *, dense_only: bool = False) -> str:
+    """The ``combine`` plan field: receive-side compute-in-exchange tier.
+
+    Derived from conf plus all-gathered spec geometry ONLY (``agg_*`` fields
+    are static properties of the cluster-wide ``AggregateSpec``), so every
+    SPMD process lands on the same tier — the fused combine changes the
+    collective's output shape, which must agree in lockstep.  ``dense`` needs
+    a dense-representable key domain whose accumulator undercuts the recv
+    staging it replaces; otherwise the static planner honors the knob with
+    the bounded ``sorted`` fallback while the adaptive planner
+    (``dense_only=True``) declines — fusing without the O(groups) memory win
+    is pure dispatch-tax speculation it cannot justify from geometry."""
+    if not (getattr(conf, "exchange_fused_combine", False) and ctx.agg_partial):
+        return "off"
+    if ctx.agg_groups > 0 and ctx.combine_acc_bytes < ctx.recv_staging_bytes:
+        return "dense"
+    return "off" if dense_only else "sorted"
 
 
 # ----------------------------------------------------------------------
@@ -322,6 +365,7 @@ class StaticPlanner:
             quantize_mode=conf.quantize_mode,
             quantize_block=conf.quantize_block_size,
             hedge_ms=conf.fetch_hedge_ms,
+            combine=_combine_tier(conf, ctx),
         )
         if getattr(conf, "planner_optimize", False):
             plan = optimize_plan(plan, ctx)
@@ -345,6 +389,9 @@ class AdaptivePlanner:
       returning the full slot means chunking cannot shrink the footprint
       (hottest lane already at a pow2 boundary) and the plan stays
       single-shot.
+    * combine — keep the receive-side fused combine only when the dense
+      accumulator's predicted bytes undercut the recv staging it replaces
+      (spec geometry — agreed cluster-wide); never the sorted fallback.
     * hedge delay — with degraded peers (health EWMA < 0.5 or an open
       breaker) and an observed stall tail, hedge at ~2x the p99 stall,
       clamped to [conf.fetch_hedge_ms, conf.fetch_hedge_max_ms].
@@ -398,6 +445,14 @@ class AdaptivePlanner:
                         single_shot=False,
                         round_order=(),
                     )
+        if plan.combine != "off":
+            # adaptive keeps the fusion only when the dense accumulator is a
+            # predicted memory win (all-gathered geometry — lockstep-safe);
+            # the sorted fallback's dispatch-tax bet is left to the static
+            # knob mapping
+            plan = dataclasses.replace(
+                plan, combine=_combine_tier(conf, ctx, dense_only=True)
+            )
         # -- serve plane: local telemetry is safe here ---------------------
         degraded = sig.worst_peer_health < 0.5 or sig.breakers_open > 0
         if degraded and sig.rx_stall_p99_ns > 0:

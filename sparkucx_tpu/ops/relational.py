@@ -190,6 +190,28 @@ class AggregateSpec:
     #: ``QuantizeSpec.error_bound`` per block of ``quantize_block_size``.
     quantize_mode: str = "off"
     quantize_block_size: int = 128
+    #: Receive-side COMPUTE-IN-EXCHANGE tier (ops/combine.py, conf
+    #: ``exchange.fusedCombine``): 'off' | 'auto' | 'dense' | 'sorted'.
+    #: 'dense' folds every landed exchange window into a fixed per-group
+    #: accumulator as it arrives — post-exchange memory and drain bytes drop
+    #: from O(rows) to O(groups), and the Pallas lowering runs the whole
+    #: scheduled ring as ONE kernel launch.  It requires ``partial=True``
+    #: (the windows are partial-aggregate rows) and every key to lie inside
+    #: ``[0, combine_groups)``.  'sorted' is the high-cardinality fallback:
+    #: a bounded per-superstep sort/merge into a (recv_capacity) accumulator —
+    #: still O(recv_capacity) post-exchange, never the full landed grid.
+    #: 'auto' resolves via :meth:`resolve_combine` (dense iff the accumulator
+    #: undercuts the slot grid the exchange would otherwise drain);
+    #: :func:`run_grouped_aggregate` fills ``combine_groups`` from the actual
+    #: key domain first.  Exact dtypes are bit-identical to the unfused path
+    #: (tests/test_fused_combine.py pins it); quantized payloads stay inside
+    #: the per-row ``QuantizeSpec.error_bound``.
+    combine: str = "off"
+    #: dense key-domain size (pow2-bucketed — a compile-cache key dimension)
+    combine_groups: int = 0
+    #: ICI lowering of the fused exchange ('auto' | 'dma' | 'xla' |
+    #: 'interpret' — ops/ici_exchange.resolve_ici_lowering vocabulary)
+    combine_lowering: str = "auto"
 
     @property
     def width(self) -> int:
@@ -218,6 +240,11 @@ class AggregateSpec:
         explicit_quantize = "quantize_mode" in kwargs
         kwargs.setdefault("quantize_mode", conf.quantize_mode)
         kwargs.setdefault("quantize_block_size", conf.quantize_block_size)
+        explicit_combine = "combine" in kwargs
+        kwargs.setdefault(
+            "combine",
+            "auto" if getattr(conf, "exchange_fused_combine", False) else "off",
+        )
         spec = cls(**kwargs)
         if (
             not explicit_quantize
@@ -230,7 +257,46 @@ class AggregateSpec:
             # (non-partial, integer dtypes — exactness is the contract there)
             # silently keep the stock path instead of failing validate()
             spec = replace(spec, quantize_mode="off")
+        if (
+            not explicit_combine
+            and spec.combine != "off"
+            and (not spec.partial or spec.num_executors < 2)
+        ):
+            # same discipline as the quantize knob: the fused combine folds
+            # PARTIAL rows across an exchange, so non-partial plans (incl.
+            # count_distinct, which forces partial=False above) and
+            # single-executor meshes keep the stock path silently
+            spec = replace(spec, combine="off")
         return spec
+
+    def resolve_combine(self) -> "AggregateSpec":
+        """Resolve ``combine='auto'`` to a concrete tier: 'dense' when the
+        per-group accumulator undercuts the fused slot grid the exchange
+        would otherwise drain (the planner's ``_combine_tier`` rule, made
+        spec-local for direct builder users), else the bounded 'sorted'
+        fallback.  ``combine_groups`` must already hold the pow2-bucketed
+        key-domain size — :func:`run_grouped_aggregate` measures it from the
+        actual keys before calling this."""
+        if self.combine != "auto":
+            return self
+        acc_bytes = self.combine_groups * (self.width * 4 + 4)
+        staging_bytes = self.num_executors * self.capacity * (self.width + 2) * 4
+        dense = self.combine_groups > 0 and acc_bytes < staging_bytes
+        return replace(self, combine="dense" if dense else "sorted")
+
+    @property
+    def combine_cspec(self):
+        """The ``ops/combine.CombineSpec`` of the dense tier (quantization
+        rides inside it — one dispatch, both tiers compose)."""
+        from sparkucx_tpu.ops.combine import CombineSpec
+
+        return CombineSpec(
+            num_groups=max(1, self.combine_groups),
+            aggs=self.aggs,
+            dtype=self.dtype,
+            quantize_mode=self.quantize_mode,
+            quantize_block=self.quantize_block_size,
+        )
 
     def resolve_impl(self, platform: Optional[str] = None) -> "AggregateSpec":
         if self.impl != "auto":
@@ -263,6 +329,22 @@ class AggregateSpec:
                 raise ValueError(
                     "quantization needs a floating value dtype — integer "
                     "aggregates are exact by contract and stay unquantized"
+                )
+        if self.combine not in ("off", "auto", "dense", "sorted"):
+            raise ValueError(
+                f"unknown combine tier {self.combine!r} (off|auto|dense|sorted)"
+            )
+        if self.combine != "off":
+            if not self.partial:
+                raise ValueError(
+                    "the fused combine folds PARTIAL aggregate rows across "
+                    "the exchange; set partial=True (count_distinct can "
+                    "therefore never use it)"
+                )
+            if self.combine == "dense" and self.combine_groups <= 0:
+                raise ValueError(
+                    "combine='dense' needs combine_groups > 0 (the dense key "
+                    "domain; keys must lie in [0, combine_groups))"
                 )
 
 
@@ -363,7 +445,27 @@ def _distinct_count_col(out_cap: int, pk, col, valid):
     )
 
 
-def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
+def _partial_rows(spec: AggregateSpec, qspec, cap, idx, keys, values, valid, tight):
+    """Map-side partial aggregation (HashAggregateExec(partial) below the
+    Exchange): reduce locally first, then exchange one row per local distinct
+    key carrying (key | agg columns | count).  The count lane travels BITCAST
+    through the value dtype, so it is exact for any 32-bit dtype (a float32
+    cast would silently round counts > 2^24).  Shared by the unfused body and
+    the fused-combine body so the two wire formats can never drift — the
+    fused tiers' bit-equality against the unfused path rests on it."""
+    lk, lv, lc, lng = _segment_reduce(spec.aggs, cap, keys, values, valid, tight=tight)
+    if qspec is not None:
+        # tier-b lossy opt-in: quantize the partial value columns on the
+        # send side; the packed int32 payload bitcasts through the float
+        # dtype lane (bit-preserving — the exchange only moves rows)
+        lv = jax.lax.bitcast_convert_type(quantize_rows(qspec, lv), spec.dtype)
+    packed = jnp.concatenate(
+        [lv, jax.lax.bitcast_convert_type(lc, spec.dtype)[:, None]], axis=1
+    )
+    return lk, packed, idx < lng
+
+
+def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None, dq_acc=None):
     cap = spec.capacity
     idx = jnp.arange(cap, dtype=jnp.int32)
     valid = idx < num_valid[0]
@@ -376,24 +478,9 @@ def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
     counts = None
     qspec = spec.qspec if (spec.partial and spec.quantize_mode != "off") else None
     if spec.partial:
-        # Map-side partial aggregation (HashAggregateExec(partial) below the
-        # Exchange): reduce locally first, then exchange one row per local
-        # distinct key carrying (key | agg columns | count).  The count lane
-        # travels BITCAST through the value dtype, so it is exact for any
-        # 32-bit dtype (a float32 cast would silently round counts > 2^24).
-        lk, lv, lc, lng = _segment_reduce(
-            spec.aggs, cap, keys, values, valid, tight=(mask is None)
+        keys, values, valid = _partial_rows(
+            spec, qspec, cap, idx, keys, values, valid, tight=(mask is None)
         )
-        keys = lk
-        if qspec is not None:
-            # tier-b lossy opt-in: quantize the partial value columns on the
-            # send side; the packed int32 payload bitcasts through the float
-            # dtype lane (bit-preserving — the exchange only moves rows)
-            lv = jax.lax.bitcast_convert_type(quantize_rows(qspec, lv), spec.dtype)
-        values = jnp.concatenate(
-            [lv, jax.lax.bitcast_convert_type(lc, spec.dtype)[:, None]], axis=1
-        )
-        valid = idx < lng
 
     payload_width = (
         qspec.quantized_width(spec.width) if qspec is not None else spec.width
@@ -423,7 +510,144 @@ def _aggregate_body(spec: AggregateSpec, keys, values, num_valid, mask=None):
     group_keys, group_vals, group_count, num_groups = _segment_reduce(
         spec.aggs, spec.recv_capacity, rkeys, rvals, rvalid, counts=counts
     )
-    return group_keys, group_vals, group_count, num_groups[None], rtotal[None]
+    out = (group_keys, group_vals, group_count, num_groups[None], rtotal[None])
+    if dq_acc is not None:
+        # donated dequantize accumulator: the extra output matches the
+        # donated input's (recv_capacity, width) float geometry, so XLA
+        # aliases the buffers and the dequantized merge input stops
+        # double-buffering next to the received packed rows — the caller
+        # threads the returned array back in on the next call
+        return out + (rvals,)
+    return out
+
+
+def _sorted_combine_walk(spec: AggregateSpec, sched, slot_rows, flat, me):
+    """High-cardinality fallback tier (``combine='sorted'``): walk the ring
+    schedule and merge every landed window into a BOUNDED sorted accumulator
+    of ``recv_capacity`` groups via :func:`_segment_reduce` — a per-superstep
+    partial sort/merge.  Post-exchange memory is O(recv_capacity) instead of
+    the full landed grid, and integer folds stay bit-identical to the unfused
+    path (segment sums associate).  Overflow detection is unchanged: distinct
+    keys on a shard never exceed its received partial rows, so the driver's
+    ``recv_totals`` check still triggers the doubling retry first.
+
+    Scheduled permutes only (``lowering='xla'``) — the bounded merge has no
+    kernel epilogue form; the dense tier is the Pallas-fused one."""
+    ax = spec.axis_name
+    n = spec.num_executors
+    qspec = spec.qspec if spec.quantize_mode != "off" else None
+    out_cap = spec.recv_capacity
+    lane = flat.shape[1]
+    idx = jnp.arange(out_cap, dtype=jnp.int32)
+
+    def fold(window, state):
+        ak, av, ac, ang = state
+        wkeys = jax.lax.bitcast_convert_type(window[:, 0], jnp.uint32)
+        wc = jax.lax.bitcast_convert_type(window[:, -1:], jnp.int32)[:, 0]
+        wp = window[:, 1:-1]
+        if qspec is not None:
+            wp = dequantize_rows(
+                qspec, jax.lax.bitcast_convert_type(wp, jnp.int32), spec.width
+            ).astype(spec.dtype)
+        # accumulator rows are partial rows themselves (counts compose by
+        # sum), so one segment reduce over [acc | window] IS the merge
+        mk = jnp.concatenate([ak, wkeys])
+        mv = jnp.concatenate([av, wp], axis=0)
+        mc = jnp.concatenate([ac, wc])
+        mvalid = jnp.concatenate([idx < ang, wc > 0])
+        return _segment_reduce(spec.aggs, out_cap, mk, mv, mvalid, counts=mc, tight=False)
+
+    state = (
+        jnp.zeros(out_cap, jnp.uint32),
+        jnp.zeros((out_cap, spec.width), spec.dtype),
+        jnp.zeros(out_cap, jnp.int32),
+        jnp.zeros((), jnp.int32),
+    )
+    # canonical fold order (ops/combine.py): own slot first, then schedule
+    # items in step order
+    own = jax.lax.dynamic_slice(flat, (me * slot_rows, 0), (slot_rows, lane))
+    state = fold(own, state)
+    w = slot_rows // sched.chunks
+    for step in sched.steps:
+        for item in step:
+            d = item.offset
+            send_row = ((me + d) % n) * slot_rows + item.chunk * w
+            window = jax.lax.dynamic_slice(flat, (send_row, 0), (w, lane))
+            got = jax.lax.ppermute(window, ax, [(i, (i + d) % n) for i in range(n)])
+            state = fold(got, state)
+    return state
+
+
+def _fused_aggregate_body(
+    spec: AggregateSpec, sched, lowering, keys, values, num_valid, mask=None
+):
+    """The COMPUTE-IN-EXCHANGE shard body (``spec.combine != 'off'``): local
+    partial reduce, place the partial rows into per-destination slots of the
+    sender-major ring grid, then fold every window into the accumulator AS IT
+    LANDS (ops/ici_exchange.combine_axis_grid — one Pallas launch under the
+    DMA lowering) instead of staging O(rows) received rows.  The dense tier
+    compacts the (combine_groups,) accumulator through the same
+    :func:`_segment_reduce` the unfused final phase uses — single-element
+    segments are identity folds, so the output contract (ascending keys,
+    counts, num_groups, recv_totals) is preserved bit-for-bit."""
+    from sparkucx_tpu.ops.ici_exchange import combine_axis_grid
+
+    cap = spec.capacity
+    n = spec.num_executors
+    ax = spec.axis_name
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid[0]
+    if mask is not None:
+        valid &= mask
+    qspec = spec.qspec if spec.quantize_mode != "off" else None
+    keys, values, valid = _partial_rows(
+        spec, qspec, cap, idx, keys, values, valid, tight=(mask is None)
+    )
+
+    # slot placement: owner-sorted rows land at (owner * cap + rank-within-
+    # owner) — each destination's region is a tight valid prefix, the
+    # all-zero tail is the count==0 padding the combine fold skips
+    rows = jnp.concatenate(
+        [jax.lax.bitcast_convert_type(keys.astype(jnp.uint32), spec.dtype)[:, None], values],
+        axis=1,
+    )
+    owners = hash_owners(keys, n, valid)
+    sizes = jnp.bincount(owners, length=n + 1)[:n].astype(jnp.int32)
+    order = jnp.argsort(owners, stable=True)
+    sowners = owners[order]
+    start = exclusive_cumsum(sizes)
+    pos = idx - start[jnp.clip(sowners, 0, n - 1)]
+    dest = jnp.where(sowners < n, sowners * cap + pos, n * cap)
+    slot = (
+        jnp.zeros((n * cap, rows.shape[1]), spec.dtype)
+        .at[dest]
+        .set(rows[order], mode="drop")
+    )
+
+    me = jax.lax.axis_index(ax)
+    # recv_totals keeps the unfused contract (TRUE partial rows hashed to
+    # each shard) so the driver's overflow/retry behavior is identical
+    sizes_mat = jax.lax.all_gather(sizes, ax)
+    rtotal = jnp.sum(sizes_mat[:, me]).astype(jnp.int32)
+
+    if spec.combine == "dense":
+        accv, accc = combine_axis_grid(
+            ax, n, cap, sched, slot, me, spec.combine_cspec, lowering
+        )
+        # compaction: one segment reduce over the dense domain — every group
+        # is its own single-row segment (identity fold, exact for floats too)
+        gk, gv, gc, ng = _segment_reduce(
+            spec.aggs,
+            spec.recv_capacity,
+            jnp.arange(spec.combine_groups, dtype=jnp.uint32),
+            accv,
+            accc[:, 0] > 0,
+            counts=accc[:, 0],
+            tight=False,
+        )
+    else:
+        gk, gv, gc, ng = _sorted_combine_walk(spec, sched, cap, slot, me)
+    return gk, gv, gc, ng[None], rtotal[None]
 
 
 def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
@@ -452,27 +676,106 @@ def build_grouped_aggregate(mesh: Mesh, spec: AggregateSpec):
       wire-traffic reduction is visible right here).  Any value
       > ``recv_capacity`` means that shard's exchange truncated and its groups
       are incomplete: re-run with headroom, like SortSpec.recv_capacity.
+
+    With ``spec.combine != 'off'`` (and more than one executor) the exchange
+    runs the COMPUTE-IN-EXCHANGE route (:func:`_fused_aggregate_body`):
+    identical signature, identical outputs — bit-identical for exact dtypes,
+    within ``QuantizeSpec.error_bound`` per partial row when quantized.
     """
     if spec.num_executors != mesh.devices.size:
         raise ValueError(f"spec.num_executors={spec.num_executors} != mesh size {mesh.devices.size}")
-    spec = spec.resolve_impl(platform=mesh.devices.reshape(-1)[0].platform)
+    platform = mesh.devices.reshape(-1)[0].platform
+    spec = spec.resolve_impl(platform=platform)
+    if spec.combine == "auto":
+        spec = spec.resolve_combine()
     spec.validate()
     ax = spec.axis_name
 
+    if spec.combine != "off" and spec.num_executors > 1:
+        # compute-in-exchange route: the shard body IS the scheduled ring
+        # (same FAST schedule the ICI exchange builds), folding windows into
+        # the accumulator as they land instead of staging received rows
+        from sparkucx_tpu.ops.hierarchy import device_slice_ids
+        from sparkucx_tpu.ops.ici_exchange import (
+            DEFAULT_CHUNKS_PER_DEST,
+            resolve_ici_lowering,
+            resolve_schedule_lowering,
+            ring_schedule,
+            schedule_chunks,
+        )
+
+        ids = device_slice_ids(mesh.devices.reshape(-1))
+        kind = "ici" if ids is None or len(set(ids)) == 1 else "dcn"
+        sched = ring_schedule(
+            spec.num_executors,
+            schedule_chunks(spec.capacity, DEFAULT_CHUNKS_PER_DEST),
+            kind=kind,
+        )
+        if spec.combine == "sorted":
+            low = "xla"  # the bounded merge rides scheduled permutes only
+        else:
+            low = resolve_schedule_lowering(
+                resolve_ici_lowering(spec.combine_lowering, platform), kind
+            )
+        body = functools.partial(_fused_aggregate_body, spec, sched, low)
+        reuse_dq = False
+    else:
+        body = functools.partial(_aggregate_body, spec)
+        # the unfused quantized fallback reuses ONE donated dequantize
+        # accumulator across calls instead of double-buffering the merge
+        # input next to the packed received rows
+        reuse_dq = spec.partial and spec.quantize_mode != "off"
+
+    def _body(*args):
+        args = list(args)
+        dq = args.pop() if reuse_dq else None
+        m = args.pop() if spec.with_filter else None
+        if reuse_dq:
+            return body(args[0], args[1], args[2], mask=m, dq_acc=dq)
+        return body(args[0], args[1], args[2], mask=m)
+
+    mask_in = (P(ax),) if spec.with_filter else ()
+    dq_in = (P(ax, None),) if reuse_dq else ()
     shard = shard_map(
-        functools.partial(_aggregate_body, spec),
+        _body,
         mesh=mesh,
-        in_specs=((P(ax), P(ax, None), P(ax)) + ((P(ax),) if spec.with_filter else ())),
-        out_specs=(P(ax), P(ax, None), P(ax), P(ax), P(ax)),
+        in_specs=(P(ax), P(ax, None), P(ax)) + mask_in + dq_in,
+        out_specs=(P(ax), P(ax, None), P(ax), P(ax), P(ax))
+        + ((P(ax, None),) if reuse_dq else ()),
         check_vma=False,
     )
     key_sh = NamedSharding(mesh, P(ax))
     row_sh = NamedSharding(mesh, P(ax, None))
-    fn = jax.jit(
+    mask_sh = (key_sh,) if spec.with_filter else ()
+    if not reuse_dq:
+        fn = jax.jit(
+            shard,
+            in_shardings=(key_sh, row_sh, key_sh) + mask_sh,
+            out_shardings=(key_sh, row_sh, key_sh, key_sh, key_sh),
+        )
+        fn.spec = spec
+        return fn
+
+    inner = jax.jit(
         shard,
-        in_shardings=(key_sh, row_sh, key_sh) + ((key_sh,) if spec.with_filter else ()),
-        out_shardings=(key_sh, row_sh, key_sh, key_sh, key_sh),
+        in_shardings=(key_sh, row_sh, key_sh) + mask_sh + (row_sh,),
+        out_shardings=(key_sh, row_sh, key_sh, key_sh, key_sh, row_sh),
+        donate_argnums=(3 + len(mask_sh),),
     )
+    state = {"dq": None}
+
+    def fn(*args):
+        if state["dq"] is None:
+            state["dq"] = jax.device_put(
+                np.zeros(
+                    (spec.num_executors * spec.recv_capacity, spec.width), spec.dtype
+                ),
+                row_sh,
+            )
+        *outs, dq = inner(*args, state["dq"])
+        state["dq"] = dq
+        return tuple(outs)
+
     fn.spec = spec
     return fn
 
@@ -773,6 +1076,18 @@ def run_grouped_aggregate(
             "with_filter=True): the compiled signatures differ"
         )
 
+    if spec.combine == "auto":
+        # host-side dense-domain detection: the dense fused combine needs
+        # every key inside [0, G); measure G from the ACTUAL keys (pow2-
+        # bucketed — a compile-cache key dimension) and let resolve_combine
+        # keep it only when the accumulator undercuts the exchanged slot
+        # grid, else take the bounded sorted fallback
+        if keys.size:
+            g = 1 << int(np.max(keys)).bit_length()  # pow2 ceil of max+1
+            spec = replace(spec, combine_groups=int(g)).resolve_combine()
+        else:
+            spec = replace(spec, combine="sorted")
+
     pk, pv, nv = shard_rows_host(keys, values, n, cap, value_dtype=spec.dtype)
 
     key_sh = NamedSharding(mesh, P(spec.axis_name))
@@ -812,6 +1127,253 @@ def run_grouped_aggregate(
         f"aggregation overflowed recv_capacity {attempt_spec.recv_capacity // 2} "
         f"after {max_attempts} doublings — hash(key) distribution too skewed"
     )
+
+
+def run_plan_grouped_aggregate(
+    mesh: Mesh,
+    spec: AggregateSpec,
+    plan,
+    keys: np.ndarray,
+    values: np.ndarray,
+    mask: Optional[np.ndarray] = None,
+    stats=None,
+):
+    """Drive one partial grouped aggregation through an ``ExchangePlan`` with
+    the UNIFIED EXECUTOR — the compute-in-exchange route composed with quota
+    sub-rounds (``plan.chunks_per_round``), exactly the engine the transports
+    run raw shuffles through:
+
+    * stage A (once): one jitted shard body does the map-side partial reduce
+      and seals the partial rows into the staging slot layout
+      (``slot = capacity`` rows per destination, count==0 padding);
+    * stage B (per sub-round, via ``transport.executor.execute_plan``): slice
+      the quota window out of the sealed payload ON DEVICE
+      (``skew.slice_subround``), run the fused-combine exchange
+      ``transport.executor.build_plan_exchange`` lowered for the plan
+      (``plan.combine == 'dense'`` routes to ``build_combine_exchange``), and
+      merge each sub-round's identity-seeded accumulator into the running one
+      in ``finish_round`` (``ops/combine.merge_accumulators``, running
+      accumulator first — deterministic float order).  The drain ships the
+      O(groups) accumulator, never the landed rows;
+    * stage C (once): dense compaction through the same
+      :func:`_segment_reduce` the single-shot fused body uses.
+
+    Integer results are bit-identical to :func:`run_grouped_aggregate` with
+    any quota (segment sums associate).  Only the dense tier composes with
+    sub-round chunking (a bounded sorted accumulator cannot merge across
+    sub-rounds without a second full sort); plans with ``combine != 'dense'``
+    fall back to :func:`run_grouped_aggregate`.
+    """
+    from sparkucx_tpu.ops.combine import acc_init, merge_accumulators
+    from sparkucx_tpu.ops.skew import chunk_size_rows, slice_subround
+    from sparkucx_tpu.transport.executor import build_plan_exchange, execute_plan
+
+    if plan.combine != "dense":
+        return run_grouped_aggregate(mesh, spec, keys, values, mask=mask)
+    if spec.combine == "auto":
+        spec = spec.resolve_combine()
+    spec = spec.resolve_impl(platform=mesh.devices.reshape(-1)[0].platform)
+    spec = replace(spec, combine="dense")
+    spec.validate()
+    if len(plan.chunks_per_round) != 1:
+        raise ValueError(
+            "one aggregation is one staging round — plan the quota as "
+            f"chunks_per_round=(k,), got {plan.chunks_per_round}"
+        )
+    n = spec.num_executors
+    cap = spec.capacity
+    ax = spec.axis_name
+    cspec = spec.combine_cspec
+    lane = cspec.row_width
+    if spec.width + 2 != lane and spec.quantize_mode == "off":
+        raise ValueError(f"row lane mismatch: {spec.width + 2} != {lane}")
+    q = int(plan.slot_rows)
+    G = cspec.num_groups
+
+    key_sh = NamedSharding(mesh, P(ax))
+    row_sh = NamedSharding(mesh, P(ax, None))
+
+    # ---- stage A: partial reduce + slot sealing (once) ----
+    def _seal(keys, values, num_valid, mask=None):
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        valid = idx < num_valid[0]
+        if mask is not None:
+            valid &= mask
+        qspec = spec.qspec if spec.quantize_mode != "off" else None
+        keys, values, valid = _partial_rows(
+            spec, qspec, cap, idx, keys, values, valid, tight=(mask is None)
+        )
+        rows = jnp.concatenate(
+            [
+                jax.lax.bitcast_convert_type(keys.astype(jnp.uint32), spec.dtype)[:, None],
+                values,
+            ],
+            axis=1,
+        )
+        owners = hash_owners(keys, n, valid)
+        sizes = jnp.bincount(owners, length=n + 1)[:n].astype(jnp.int32)
+        order = jnp.argsort(owners, stable=True)
+        sowners = owners[order]
+        start = exclusive_cumsum(sizes)
+        pos = idx - start[jnp.clip(sowners, 0, n - 1)]
+        dest = jnp.where(sowners < n, sowners * cap + pos, n * cap)
+        slot = (
+            jnp.zeros((n * cap, lane), spec.dtype).at[dest].set(rows[order], mode="drop")
+        )
+        return slot, sizes[None, :]
+
+    mask_in = (P(ax),) if spec.with_filter else ()
+    seal = jax.jit(
+        shard_map(
+            _seal,
+            mesh=mesh,
+            in_specs=(P(ax), P(ax, None), P(ax)) + mask_in,
+            out_specs=(P(ax, None), P(ax, None)),
+            check_vma=False,
+        ),
+        in_shardings=(key_sh, row_sh, key_sh)
+        + ((key_sh,) if spec.with_filter else ()),
+        out_shardings=(row_sh, row_sh),
+    )
+
+    # ---- stage B: the plan's sub-rounds through the unified executor ----
+    exchange = build_plan_exchange(
+        mesh,
+        num_executors=n,
+        send_rows=n * q,
+        lane=lane,
+        axis_name=ax,
+        impl=plan.lowering,
+        combine=cspec,
+    )
+
+    # one compiled slicer per chunk index (the window offset is static — the
+    # plan has few chunks, all pow2-bucketed, so this stays a tiny cache)
+    slicers = {}
+
+    def _slicer(chunk: int):
+        if chunk not in slicers:
+
+            def _slice(payload, size_row, *, _c=chunk):
+                return (
+                    slice_subround(payload, n, _c, q, xp=jnp),
+                    chunk_size_rows(size_row, _c, q, xp=jnp),
+                )
+
+            slicers[chunk] = jax.jit(
+                shard_map(
+                    _slice,
+                    mesh=mesh,
+                    in_specs=(P(ax, None), P(ax, None)),
+                    out_specs=(P(ax, None), P(ax, None)),
+                    check_vma=False,
+                ),
+                in_shardings=(row_sh, row_sh),
+                out_shardings=(row_sh, row_sh),
+            )
+        return slicers[chunk]
+
+    # identity seed, replicated host-side once — each sub-round donates a
+    # fresh device copy to the exchange (merge_accumulators folds them)
+    av0, ac0 = acc_init(cspec)
+    av_host = np.tile(np.asarray(av0), (n, 1))
+    ac_host = np.tile(np.asarray(ac0), (n, 1))
+
+    merge = jax.jit(
+        lambda av, ac, bv, bc: merge_accumulators(cspec, (av, ac), (bv, bc)),
+        donate_argnums=(0, 1),
+    )
+
+    total = keys.shape[0]
+    if total > n * cap:
+        raise ValueError(f"{total} rows exceed {n} x {cap} capacity")
+    if spec.with_filter != (mask is not None):
+        raise ValueError("spec.with_filter and mask must agree (see run_grouped_aggregate)")
+    pk, pv, nv = shard_rows_host(keys, values, n, cap, value_dtype=spec.dtype)
+    extra = ()
+    if mask is not None:
+        pm, _, _ = shard_rows_host(
+            mask.astype(np.uint32), np.zeros((total, 0), np.int32), n, cap
+        )
+        extra = (jax.device_put(pm.astype(bool), key_sh),)
+    payload, size_row = seal(
+        jax.device_put(pk, key_sh),
+        jax.device_put(pv, row_sh),
+        jax.device_put(nv, key_sh),
+        *extra,
+    )
+
+    def submit(rnd, chunk, nchunks):
+        sub_payload, sub_sizes = _slicer(chunk)(payload, size_row)
+        return exchange(
+            sub_payload,
+            sub_sizes,
+            jax.device_put(av_host, row_sh),
+            jax.device_put(ac_host, row_sh),
+        )
+
+    def finish_round(rnd, nchunks, parts):
+        accv, accc, recv = parts[0]
+        for bv, bc, brecv in parts[1:]:
+            accv, accc = merge(accv, accc, bv, bc)
+            recv = recv + brecv
+        return accv, accc, recv
+
+    results = execute_plan(
+        plan,
+        submit=submit,
+        drain_chunk=lambda rnd, chunk, nchunks, ticket: ticket,
+        finish_round=finish_round,
+        # the drain-side telemetry now counts the O(groups) accumulator, not
+        # O(rows) received rows — the fused route's headline memory win
+        result_bytes=lambda r: int(r[0].nbytes + r[1].nbytes),
+        occupancy=lambda r: (int(np.asarray(r[2]).sum()), n * cap),
+        stats=stats,
+        name="aggregate.fused",
+    )
+    accv, accc, recv_sizes = results[0]
+
+    # ---- stage C: compaction (once) + host finish ----
+    def _compact(accv, accc):
+        gk, gv, gc, ng = _segment_reduce(
+            spec.aggs,
+            spec.recv_capacity,
+            jnp.arange(G, dtype=jnp.uint32),
+            accv,
+            accc[:, 0] > 0,
+            counts=accc[:, 0],
+            tight=False,
+        )
+        return gk, gv, gc, ng[None]
+
+    compact = jax.jit(
+        shard_map(
+            _compact,
+            mesh=mesh,
+            in_specs=(P(ax, None), P(ax, None)),
+            out_specs=(P(ax), P(ax, None), P(ax), P(ax)),
+            check_vma=False,
+        ),
+        in_shardings=(row_sh, row_sh),
+        out_shardings=(key_sh, row_sh, key_sh, key_sh),
+    )
+    out_k, out_v, out_c, num_groups = compact(accv, accc)
+    if (np.asarray(num_groups) > spec.recv_capacity).any():
+        raise RuntimeError(
+            f"dense compaction overflowed recv_capacity {spec.recv_capacity}; "
+            "re-plan with headroom"
+        )
+    keys_h, vals_h, cnts_h = unpack_shard_prefixes(
+        (out_k, out_v, out_c), np.asarray(num_groups), spec.recv_capacity
+    )
+    order = np.argsort(keys_h)
+    keys_h, vals_h, cnts_h = keys_h[order], vals_h[order], cnts_h[order]
+    if "avg" in spec.aggs:
+        vals_h = vals_h.astype(np.float64)
+        for c, agg in enumerate(spec.aggs):
+            if agg == "avg":
+                vals_h[:, c] /= np.maximum(cnts_h, 1)
+    return keys_h, vals_h, cnts_h
 
 
 # ----------------------------------------------------------------------------

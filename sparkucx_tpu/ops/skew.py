@@ -91,6 +91,16 @@ class ExchangePlan:
       bytes (payloads are exact); these fields parameterize the fetch path,
       the aggregation plane, and the bench harness, and land in the per-
       shuffle ``exchange.plan`` trace event.
+    * ``combine`` — the receive-side compute-in-exchange tier for partial
+      grouped aggregations (``'off' | 'dense' | 'sorted'``).  ``dense`` folds
+      every landed window into a fixed per-group accumulator inside the
+      exchange (O(groups) post-exchange memory and drain bytes, one fused
+      kernel launch under the DMA lowering); ``sorted`` is the bounded
+      per-superstep sort/merge fallback when the key domain is not
+      dense-representable.  Only meaningful when the shuffle carries an
+      ``AggregateSpec`` with partial aggregation; raw block exchanges ignore
+      it.  Chosen from all-gathered geometry only (SPMD lockstep — see
+      ops/planner.py).
     """
 
     slot_rows: int
@@ -104,6 +114,7 @@ class ExchangePlan:
     quantize_mode: str = "off"
     quantize_block: int = 128
     hedge_ms: int = 0
+    combine: str = "off"
 
     @property
     def num_subrounds(self) -> int:
@@ -162,6 +173,7 @@ class ExchangePlan:
             "quantize_mode": self.quantize_mode,
             "quantize_block": self.quantize_block,
             "hedge_ms": self.hedge_ms,
+            "combine": self.combine,
         }
 
 

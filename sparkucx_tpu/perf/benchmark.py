@@ -105,6 +105,14 @@ Modes:
   inner join in the TPC-H shape (BASELINE.json configs[2]) — ``--build-rows``
   dimension rows (unique keys, 8 int32 lanes) probed by -n fact rows (16
   lanes), both sides hash-exchanged then matched; prints M probe rows/s.
+* ``combine`` — the receive-side fused-combine exchange
+  (ops/ici_exchange.build_combine_exchange) vs the unfused reference
+  (scheduled exchange, then a separate fold over the landed grid): partial
+  aggregate rows with ``--keys`` distinct groups, -s bytes per peer slot,
+  over ``--executors`` devices.  Asserts the fused accumulator bit-identical
+  to the reference fold off the clock and prints the drain-bytes collapse
+  (O(rows) landed grid vs O(groups) accumulator) plus the launch-count
+  collapse (one fused kernel vs one dispatch per schedule item + the fold).
 """
 
 from __future__ import annotations
@@ -130,7 +138,8 @@ def _parse_args(argv):
         choices=[
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "adaptive", "wire",
-            "ici", "failover", "elastic", "compress", "tenants", "obs", "gray",
+            "ici", "combine", "failover", "elastic", "compress", "tenants",
+            "obs", "gray",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -2616,6 +2625,216 @@ def run_ici(args) -> None:
     print(stats.report(), flush=True)
 
 
+def measure_combine(
+    executors: int = 8, slot_rows: int = 1024, num_groups: int = 128,
+    iterations: int = 5, chunks_per_dest: int = 0, report=None,
+) -> dict:
+    """Measurement core of the ``combine`` mode — the receive-side fused
+    combine (ops/ici_exchange.build_combine_exchange) against the unfused
+    reference: the same FAST-scheduled exchange followed by a SEPARATE fold
+    launch over the landed O(rows) grid.
+
+    Both sides are fed identical seeded partial-aggregate rows (``[key |
+    sum/min/max/avg lanes | count]``, keys in ``[0, num_groups)``) with
+    ragged per-peer sizes; the fused accumulator is asserted BIT-IDENTICAL
+    to the reference fold off the clock (int32 folds are order-exact), then
+    both are timed over chained donated iterations.  The two headline
+    numbers of the compute-in-exchange argument land in the result dict:
+
+    * ``drain``: the reference drains the landed grid — ``n * slot_rows *
+      lane * 4`` B per device, O(rows) — where the fused side drains only
+      the accumulator (``CombineSpec.acc_bytes``, O(groups));
+    * ``launches``: the fused exchange+fold is ONE jitted launch (one
+      Pallas kernel under the DMA lowering) vs the reference's exchange
+      launch plus fold launch, with one dispatch per schedule item inside
+      the scheduled-XLA walk.
+
+    ``report(impl, it, seconds, bytes)`` per iteration.  Shared by the CLI
+    and bench.py."""
+    from sparkucx_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.ops._compat import shard_map
+    from sparkucx_tpu.ops.combine import CombineSpec, acc_init, combine_window
+    from sparkucx_tpu.ops.exchange import ExchangeSpec, make_mesh
+    from sparkucx_tpu.ops.ici_exchange import (
+        DEFAULT_CHUNKS_PER_DEST,
+        build_combine_exchange,
+        build_ici_exchange,
+    )
+
+    if chunks_per_dest <= 0:
+        chunks_per_dest = DEFAULT_CHUNKS_PER_DEST
+    avail = jax.device_count()
+    n = min(executors, avail)
+    if n < 2:
+        raise RuntimeError(f"combine mode needs >=2 devices (have {avail})")
+    cspec = CombineSpec(num_groups=num_groups, aggs=("sum", "min", "max", "avg"))
+    lane = cspec.row_width
+    slot = max(chunks_per_dest, slot_rows)
+    send_rows = n * slot
+    spec = ExchangeSpec(
+        num_executors=n, send_rows=send_rows, recv_rows=send_rows, lane=lane
+    )
+    mesh = make_mesh(n)
+    pspec = P("ex", None)
+    sharding = NamedSharding(mesh, pspec)
+    fused = build_combine_exchange(mesh, spec, cspec, chunks_per_dest=chunks_per_dest)
+    ref_ex = build_ici_exchange(mesh, spec, chunks_per_dest=chunks_per_dest)
+
+    # the reference's post-exchange fold: a second launch over the landed
+    # grid (int32 folds are order-insensitive, so one whole-grid window
+    # reproduces the fused canonical order bit-exactly)
+    def _fold(grid):
+        return combine_window(cspec, grid, *acc_init(cspec))
+
+    fold = jax.jit(
+        shard_map(
+            _fold, mesh=mesh, in_specs=(pspec,), out_specs=(pspec, pspec),
+            check_vma=False,
+        ),
+        in_shardings=(sharding,),
+        out_shardings=(sharding, sharding),
+    )
+
+    # seeded partial rows: every staged row is a real partial (count >= 1)
+    # up to its ragged per-peer size; padding rows stay all-zero (count 0)
+    rng = np.random.default_rng(23)
+    sizes_host = rng.integers(1, slot + 1, size=(n, n)).astype(np.int32)
+    data_host = np.zeros((n * send_rows, lane), dtype=np.int32)
+    for i in range(n):
+        for j in range(n):
+            c = int(sizes_host[i, j])
+            base = i * send_rows + j * slot
+            data_host[base : base + c, 0] = rng.integers(0, num_groups, size=c)
+            data_host[base : base + c, 1:-1] = rng.integers(
+                -100, 100, size=(c, cspec.width)
+            )
+            data_host[base : base + c, -1] = rng.integers(1, 5, size=c)
+    av0, ac0 = acc_init(cspec)
+    av_host = np.tile(np.asarray(av0), (n, 1))
+    ac_host = np.tile(np.asarray(ac0), (n, 1))
+    sizes = jax.device_put(sizes_host, sharding)
+    data = jax.device_put(data_host, sharding)
+
+    # warmup/compile + off-clock bit-equality: fused fold vs exchange-then-fold
+    recv, rs_ref = ref_ex(jax.device_put(data_host, sharding), sizes)
+    rv_ref, rc_ref = fold(recv)
+    fv, fc, rs_f = fused(
+        data, sizes,
+        jax.device_put(av_host, sharding), jax.device_put(ac_host, sharding),
+    )
+    assert np.array_equal(np.asarray(rs_ref), np.asarray(rs_f)), (
+        "fused recv_sizes diverged from the scheduled exchange"
+    )
+    assert np.asarray(rv_ref).tobytes() == np.asarray(fv).tobytes(), (
+        "fused accumulator values diverged from exchange-then-fold"
+    )
+    assert np.asarray(rc_ref).tobytes() == np.asarray(fc).tobytes(), (
+        "fused accumulator counts diverged from exchange-then-fold"
+    )
+
+    remote_bytes = n * (n - 1) * slot * lane * 4
+
+    def time_fused():
+        best = 0.0
+        for it in range(iterations):
+            av = jax.device_put(av_host, sharding)
+            ac = jax.device_put(ac_host, sharding)
+            t0 = time.perf_counter()
+            for _ in range(4):  # chained: the donated accumulator recycles
+                av, ac, _ = fused(data, sizes, av, ac)
+            jax.block_until_ready(av)
+            dt = time.perf_counter() - t0
+            best = max(best, 4 * remote_bytes / dt / 1e9)
+            if report is not None:
+                report("fused", it, dt, 4 * remote_bytes)
+        return best
+
+    def time_reference():
+        best = 0.0
+        for it in range(iterations):
+            cur = jax.device_put(data_host, sharding)
+            t0 = time.perf_counter()
+            for _ in range(4):  # chained: exchange donates, then the fold
+                cur, _ = ref_ex(cur, sizes)
+                accs = fold(cur)
+            jax.block_until_ready(accs)
+            dt = time.perf_counter() - t0
+            best = max(best, 4 * remote_bytes / dt / 1e9)
+            if report is not None:
+                report("unfused", it, dt, 4 * remote_bytes)
+        return best
+
+    fused_gbps = time_fused()
+    ref_gbps = time_reference()
+    sched = fused.schedule
+    ref_drain = n * slot * lane * 4  # the landed grid, per device — O(rows)
+    return {
+        "executors": n,
+        "slot_rows": slot,
+        "groups": num_groups,
+        "lane": lane,
+        "lowering": fused.lowering,
+        "supersteps": sched.num_steps,
+        "chunks": sched.chunks,
+        "fused_gbps": fused_gbps,
+        "unfused_gbps": ref_gbps,
+        "bit_identical": True,
+        "drain": {
+            "reference_bytes": ref_drain,
+            "fused_bytes": cspec.acc_bytes,
+            "ratio": ref_drain / cspec.acc_bytes,
+        },
+        # one jitted launch folds windows as they land (one Pallas kernel
+        # under the DMA lowering); the reference needs its exchange launch
+        # plus a separate fold launch, with one dispatch per schedule item
+        # inside the scheduled-XLA walk
+        "launches": 1,
+        "reference_launches": 2,
+        "reference_dispatches": len(sched.items()) + 1,
+    }
+
+
+def run_combine(args) -> None:
+    size = parse_size(args.block_size)
+    n = args.executors if args.executors > 1 else 8
+
+    def report(impl, it, dt, tot):
+        print(
+            f"{impl:7} iter {it}: {tot} remote bytes in {dt*1e3:.1f} ms "
+            f"= {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    r = measure_combine(
+        n, max(1, size // 512), max(2, args.keys),
+        iterations=args.iterations, chunks_per_dest=args.chunks, report=report,
+    )
+    d = r["drain"]
+    print(
+        f"n={r['executors']}: fused {r['fused_gbps']:.2f} GB/s vs unfused "
+        f"{r['unfused_gbps']:.2f} GB/s, {r['supersteps']} supersteps x "
+        f"{r['chunks']} chunks [{r['lowering']}]; bit-identical",
+        flush=True,
+    )
+    print(
+        f"drain per device: {d['reference_bytes']} B landed grid (O(rows)) -> "
+        f"{d['fused_bytes']} B accumulator (O(groups)), {d['ratio']:.1f}x less",
+        flush=True,
+    )
+    print(
+        f"launches: exchange+fold in {r['launches']} vs "
+        f"{r['reference_launches']} (separate fold launch eliminated; "
+        f"{r['reference_dispatches']} scheduled dispatches collapse under "
+        f"the DMA lowering)",
+        flush=True,
+    )
+
+
 def run_write(args) -> None:
     size = parse_size(args.block_size)
     impls = (
@@ -3090,6 +3309,8 @@ def main(argv=None) -> None:
         run_skew(args)
     elif args.mode == "adaptive":
         run_adaptive(args)
+    elif args.mode == "combine":
+        run_combine(args)
     elif args.mode == "ici":
         run_ici(args)
     elif args.mode == "sort":

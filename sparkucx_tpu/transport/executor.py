@@ -80,6 +80,7 @@ def build_plan_exchange(
     impl: str,
     num_slices: int = 1,
     quantize=None,
+    combine=None,
 ):
     """THE lowering dispatch: one compiled exchange for a plan's geometry.
 
@@ -87,10 +88,15 @@ def build_plan_exchange(
     transports and the quantized-variant routing in ``ops/ici_exchange.py``:
     ``impl`` is the *resolved* tier (``resolve_exchange_impl`` over the
     plan's ``lowering`` field), ``num_slices > 1`` selects the two-phase
-    ICI+DCN route, and a ``QuantizeSpec`` routes to the lossy aggregation
-    exchange.  Callers keep their own compile caches (and their cache keys —
-    the bucketing discipline the cache-hygiene pass audits); this function
-    is the single place a key miss turns into a lowering."""
+    ICI+DCN route, a ``QuantizeSpec`` routes to the lossy aggregation
+    exchange, and a ``CombineSpec`` (``plan.combine == 'dense'``) routes to
+    the receive-side fused-combine exchange — the one route whose output is
+    the O(groups) accumulator instead of O(rows) received rows (its
+    ``QuantizeSpec`` rides inside the ``CombineSpec``, so the two tiers
+    compose without a second dispatch arm).  Callers keep their own compile
+    caches (and their cache keys — the bucketing discipline the cache-hygiene
+    pass audits); this function is the single place a key miss turns into a
+    lowering."""
     spec = ExchangeSpec(
         num_executors=num_executors,
         send_rows=send_rows,
@@ -99,6 +105,17 @@ def build_plan_exchange(
         axis_name=axis_name,
         impl="auto",
     )
+    if combine is not None:
+        from sparkucx_tpu.ops.ici_exchange import (
+            DEFAULT_CHUNKS_PER_DEST,
+            build_combine_exchange,
+        )
+
+        # the fused combine is inherently the scheduled ring (the fold rides
+        # the superstep epilogue); flat meshes only, like the quantized tier
+        return build_combine_exchange(
+            mesh, spec, combine, chunks_per_dest=DEFAULT_CHUNKS_PER_DEST
+        )
     if quantize is not None:
         from sparkucx_tpu.ops.ici_exchange import build_quantized_exchange
 

@@ -295,6 +295,10 @@ class SpmdShuffleExecutor:
             used_rows_total=int(total),
             row_bytes=self.conf.block_alignment,
             platform=self.mesh.devices.reshape(-1)[0].platform,
+            # raw block shuffles: no aggregation geometry (agg_partial False)
+            # -> plan.combine is always 'off' here; the fields are filled by
+            # the aggregation plane.  All-gathered geometry only (maxes/total
+            # above), so every process derives the SAME tier — SPMD lockstep
             signals=PlanSignals.from_registry(self.peer.metrics),
         )
         plan = self.planner.plan(ctx)

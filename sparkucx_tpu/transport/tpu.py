@@ -425,6 +425,10 @@ class TpuShuffleCluster:
             used_rows_total=used_total,
             row_bytes=self.row_bytes,
             platform=self.mesh.devices.reshape(-1)[0].platform,
+            # raw block shuffles carry no aggregation geometry: agg_partial
+            # stays False, so the planner's combine tier resolves to 'off'
+            # (the fused fold only applies to partial-aggregate exchanges —
+            # ops/relational.py fills these fields on that path)
             signals=signals,
         )
         plan = self.planner.plan(ctx)
