@@ -41,6 +41,26 @@ wire-schema         AmId enum + header struct formats extracted from source
 conf-registry       every ``spark.shuffle.tpu.*`` knob is a real field,
                     has a DEPLOYMENT.md row, a test reference, and a
                     byte-identical off-path default
+lockstep-taint      AST taint dataflow: local telemetry (PlanSignals,
+                    metrics/health/breaker reads, clocks) must never reach
+                    a collective-affecting ExchangePlan field or steer a
+                    pre-collective SPMD branch; the COLLECTIVE/SERVE_PLANE
+                    field split is cross-checked against the dataclass
+span-discipline     explicit ``start_span`` results closed via ``end_span``
+                    in a finally on all paths (or returned with a
+                    documented closer); trace-instant names documented in
+                    OBSERVABILITY.md
+metrics-naming      ``sample``/``counter_dict_provider`` family and name
+                    literals match ``sparkucx_tpu_<family>_<name>``; the
+                    family set and the OBSERVABILITY.md table pin each
+                    other both ways
+error-taxonomy      TransportError subclasses classified retryable vs
+                    fail-fast in ERROR_TAXONOMY, documented in API.md; the
+                    reader's retry path statically barred from swallowing
+                    fail-fast types
+tier-vocabulary     plan tier strings (lowering, combine, codec, quantize
+                    modes, planner/host-recv modes) compared, passed, and
+                    documented only from the declared TIER_VOCAB
 ==================  ========================================================
 
 The runtime half of PR 3 — the buffer sanitizer — lives in
@@ -63,12 +83,17 @@ from sparkucx_tpu.analysis import (  # noqa: F401,E402
     cache,
     confreg,
     donation,
+    errors,
     hostsync,
     lockorder,
     locks,
+    metricnames,
     private,
     protocol,
     reactor,
     resources,
+    spans,
+    taint,
     threads,
+    tiers,
 )

@@ -3,9 +3,11 @@
 Runs every registered pass (module and whole-program) over
 ``sparkucx_tpu/`` and exits non-zero on any finding not covered by a
 reviewed allowlist entry (analysis/config.py).  A full default run also
-FAILS on stale configuration: an allowlist entry no finding matches, or a
-REQUIRED_SURFACE path that names no analyzed file — reviewed exceptions
-that have rotted get pruned, not accumulated.  Imports no jax/numpy —
+FAILS on stale configuration: an allowlist entry no finding matches, a
+REQUIRED_SURFACE path that names no analyzed file, or a function-pinning
+table entry (DONATING_BUILDERS / TUPLE_DONATING_BUILDERS /
+HOST_SYNC_ROOTS) naming a function no longer defined anywhere — reviewed
+exceptions that have rotted get pruned, not accumulated.  Imports no jax/numpy —
 safe on a bare interpreter and cheap in CI.
 """
 
@@ -19,9 +21,28 @@ from sparkucx_tpu.analysis import all_pass_names, analyze_tree
 from sparkucx_tpu.analysis.base import load_program, package_root
 from sparkucx_tpu.analysis.config import (
     ALLOWLIST,
+    DONATING_BUILDERS,
+    HOST_SYNC_ROOTS,
     REQUIRED_SURFACE,
     TESTS_ALLOWLIST,
+    TUPLE_DONATING_BUILDERS,
 )
+
+
+def _defined_function_names(root: str):
+    """Every ``def <name>(`` in the package, by cheap regex sweep — enough
+    to catch config tables pinning functions that a refactor deleted."""
+    import re
+
+    names = set()
+    pat = re.compile(r"^\s*(?:async\s+)?def\s+(\w+)\s*\(", re.MULTILINE)
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in files:
+            if fname.endswith(".py"):
+                with open(os.path.join(dirpath, fname)) as f:
+                    names.update(pat.findall(f.read()))
+    return names
 
 
 def main(argv=None) -> int:
@@ -92,6 +113,24 @@ def main(argv=None) -> int:
                 stale += 1
                 print(f"stale REQUIRED_SURFACE path (no such file): {path}",
                       file=sys.stderr)
+        # function-pinning tables rot the same way allowlist entries do: a
+        # builder ladder removed by a refactor leaves its donation/host-sync
+        # entries matching nothing (the PR 13 executor unification deleted
+        # `_run_exchange_quota` and the per-variant `_assemble` ladder)
+        defined = _defined_function_names(package_root())
+        for table_name, table in (
+            ("DONATING_BUILDERS", DONATING_BUILDERS),
+            ("TUPLE_DONATING_BUILDERS", TUPLE_DONATING_BUILDERS),
+            ("HOST_SYNC_ROOTS", dict.fromkeys(HOST_SYNC_ROOTS)),
+        ):
+            for fn_name in sorted(table):
+                if fn_name not in defined:
+                    stale += 1
+                    print(
+                        f"stale {table_name} entry (no `def {fn_name}` "
+                        f"anywhere in the package): {fn_name}",
+                        file=sys.stderr,
+                    )
 
     npass = len(passes) if passes else len(all_pass_names())
     if violations or stale:

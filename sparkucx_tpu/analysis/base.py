@@ -174,7 +174,7 @@ def repo_root() -> str:
 
 #: Docs that global passes cross-reference, loaded by basename from
 #: ``<repo>/docs`` when present.
-PROGRAM_DOCS = ("SHIM_PROTOCOL.md", "DEPLOYMENT.md")
+PROGRAM_DOCS = ("SHIM_PROTOCOL.md", "DEPLOYMENT.md", "OBSERVABILITY.md", "API.md")
 
 
 def _load_docs() -> Dict[str, str]:

@@ -52,14 +52,9 @@ from __future__ import annotations
 #: - spmd.py ``_submit``: ``np.asarray(payload)`` sits on the host-payload
 #:   branch (the ``isinstance(payload, jax.Array)`` arm above it device_puts
 #:   instead); asarray over an ndarray is a free view, not a device sync.
-#: - tpu.py ``_assemble``: the mixed host/device round fallback D2H-copies
-#:   device payloads into the host assembly buffer.  That D2H is the
-#:   documented cost of mixed-mode rounds (an executor sealed fewer device
-#:   rounds than its peers), accepted until a device-side repack exists.
-#: - tpu.py ``_submit_quota``: the quota engine's twin of ``_assemble`` — the
-#:   np.asarray sits on the mixed host/device branch (the all-device arm above
-#:   it slices on-device via jnp), guarded by ``isinstance(p, jax.Array)``;
-#:   same documented mixed-mode D2H cost, same scope.
+#:   (The retired per-variant engines' ``_assemble``/``_submit_quota``
+#:   entries were pruned with PR 13 — the unified plan executor replaced
+#:   them.)
 #:
 #: - tpu.py ``_recover_and_rerun``: the degraded-mode recovery path (elastic
 #:   mesh, reached from ``_run_exchange`` only after an executor died).  It
@@ -316,6 +311,11 @@ OFF_PATH_DEFAULTS = {
     "slot_quota_rows": 0,
     "planner_mode": "static",
     "planner_optimize": False,
+    # adaptive-only thresholds: inert while planner_mode == "static" (the
+    # off-path planner never reads them), so their defaults ARE the pinned
+    # off-path values — all four planner.* knobs stay in one reviewed table
+    "planner_target_padding": 0.5,
+    "planner_min_quota_rows": 256,
     "host_recv_mode": "array",
     "sanitize": False,
     "fetch_hedge_ms": 0,
@@ -331,6 +331,184 @@ OFF_PATH_DEFAULTS = {
     "obs_postmortem_dir": "",
     "exchange_fused_combine": False,
 }
+
+# ----------------------------------------------------------------------
+# lockstep-taint tables
+
+#: The plan dataclass and the module defining it.  The taint pass parses the
+#: dataclass fields and cross-checks the declared COLLECTIVE/SERVE_PLANE
+#: split below against them, so the registry cannot drift from the code.
+PLAN_MODULE = "ops/skew.py"
+PLAN_CLASS = "ExchangePlan"
+
+#: ExchangePlan fields that shape the COLLECTIVE schedule: in the SPMD
+#: deployment every process compiles and submits collectives from these, so
+#: they must be pure functions of conf + all-gathered geometry — a per-host
+#: telemetry read steering one of them is a divergent compiled program and a
+#: cluster-wide hang.  ``quantize_mode``/``quantize_block`` are here (not
+#: serve-plane) because they select a DIFFERENT compiled collective
+#: (``build_quantized_exchange``) — the lossy encode runs inside the kernel.
+COLLECTIVE_FIELDS = (
+    "slot_rows",
+    "chunks_per_round",
+    "single_shot",
+    "round_order",
+    "lowering",
+    "quantize_mode",
+    "quantize_block",
+    "combine",
+)
+
+#: Fields local telemetry MAY steer: they shape how one host serves or
+#: overlaps, never what any collective computes.  ``pipeline_depth`` is here
+#: deliberately (ops/planner.py:36): depth changes WHEN stages overlap,
+#: never the order collectives are submitted in, so it may vary per host.
+SERVE_PLANE_FIELDS = (
+    "pipeline_depth",
+    "streams",
+    "codec",
+    "hedge_ms",
+)
+
+#: Modules the taint dataflow runs over (the plan-producing and
+#: plan-consuming layers).  Fixture runs that contain none of these analyze
+#: every module they were given instead.
+TAINT_MODULES = (
+    "ops/planner.py",
+    "ops/skew.py",
+    "transport/spmd.py",
+    "transport/executor.py",
+)
+
+#: Callee names whose results are local telemetry (may differ per host):
+#: metric registry snapshots, PlanSignals construction, health/wire/breaker
+#: reads, and clocks.  Matched on the bare callee name, so both
+#: ``registry.snapshot()`` and ``self.membership.snapshot()`` taint.
+TAINT_SOURCE_CALLS = (
+    "PlanSignals",
+    "from_registry",
+    "snapshot",
+    "health_snapshot",
+    "wire_lane_stats",
+    "breaker_state",
+    "breaker_allows",
+    "eviction_stats",
+    "monotonic",
+    "monotonic_ns",
+    "perf_counter",
+    "perf_counter_ns",
+    "process_time",
+    "time",
+)
+
+#: Attribute reads that (re-)introduce taint wherever they appear:
+#: ``ctx.signals`` is THE sanctioned telemetry channel into a planner, and
+#: reading it back out is where serve-plane-only discipline must hold.
+TAINT_SOURCE_ATTRS = ("signals",)
+
+#: Constructor/rewrite callees whose keywords are plan/context fields — the
+#: taint sinks.  A tainted value bound to a COLLECTIVE_FIELDS keyword (or a
+#: collective keyword written under a telemetry-tainted branch) is a
+#: finding; taint bound to a serve-plane keyword (or the ``signals``
+#: channel) is absorbed there by design.
+PLAN_CONSTRUCTORS = ("ExchangePlan", "PlanContext", "replace")
+
+#: Functions whose branch conditions run BEFORE collective submission in the
+#: SPMD transport (matched by name in the analyzed modules): a tainted
+#: condition there can diverge which collective each process enters.
+#: Branches whose body ends in ``raise`` are exempt — failing fast before a
+#: collective is the sanctioned response to local bad news (membership), a
+#: divergent schedule is not.
+SPMD_PRECOLLECTIVE_FUNCS = ("run_exchange",)
+
+# ----------------------------------------------------------------------
+# span-discipline / metrics-naming tables
+
+#: Doc carrying the metric family registry and the trace-point table.
+TRACE_DOC = "OBSERVABILITY.md"
+
+#: The tracer implementation itself (opens/closes spans by definition) —
+#: excluded from the span-discipline walk.
+TRACE_IMPL_MODULES = ("utils/trace.py",)
+
+#: The metrics module and the exposition prefix every family rides under
+#: (``<prefix>_<family>_<name>``); the pass pins the PREFIX constant and
+#: checks family/name literals against the scheme and the TRACE_DOC table.
+OBS_METRICS_MODULE = "obs/metrics.py"
+METRIC_PREFIX = "sparkucx_tpu"
+
+# ----------------------------------------------------------------------
+# error-taxonomy tables
+
+#: Module defining the TransportError hierarchy, and the doc whose "Failure
+#: semantics" section must name every classified type.
+ERROR_MODULE = "core/operation.py"
+ERROR_BASE = "TransportError"
+ERROR_DOC = "API.md"
+
+#: THE machine-checked retryable/fail-fast registry (API.md "Failure
+#: semantics" points here).  Every TransportError subclass in the package
+#: must appear exactly once; the pass fails on an unclassified subclass AND
+#: on a stale entry naming a deleted class.
+#: - retryable: transient per-block conditions — another attempt (or a
+#:   replica) can succeed.
+#: - retryable-backoff: the third arm — the server shed load; retry after a
+#:   typed backoff hint, never instantly.
+#: - fail-fast: deterministic rejections and no-recovery losses — every
+#:   replica gives the same answer, so a retry only burns the budget and
+#:   hides the real error.
+ERROR_TAXONOMY = {
+    "BlockNotFoundError": "retryable",
+    "BlockCorruptError": "retryable",
+    "ResourceExhaustedError": "retryable-backoff",
+    "UnknownTenantError": "fail-fast",
+    "TenantQuotaExceededError": "fail-fast",
+    "ExecutorLostError": "fail-fast",
+}
+
+#: Reader retry/failover functions (matched by name): statically barred from
+#: catching a fail-fast type, and a base-class ``except TransportError``
+#: there must carry an isinstance re-raise guard covering EVERY fail-fast
+#: class — anything less silently retries a deterministic rejection.
+RETRY_PATH_FUNCS = ("_retry_fetch",)
+
+# ----------------------------------------------------------------------
+# tier-vocabulary tables
+
+#: THE plan/conf tier vocabularies, defined once.  The pass cross-checks
+#: every parse/validate/literal-comparison site against these: a string
+#: compared to, assigned to, or passed as a keyword named after one of these
+#: fields must be in its vocabulary — tier typos become findings instead of
+#: silently-dead dispatch arms.  ``lowering`` carries the union of the plan
+#: tier (stock|pallas|auto) and the kernel lowering it resolves to
+#: (auto|dma|xla|interpret|tiled) because both ride the same field name.
+#: The bare word ``impl`` is deliberately NOT pinned: every op module uses
+#: it for its own local dispatch tiers (ragged|dense|radix|single|...), so
+#: a global vocabulary for it would be fiction — the plan-level names
+#: (``lowering``, ``exchange_impl``, ``gather_impl``) are the pinned ones.
+TIER_VOCAB = {
+    "lowering": ("stock", "pallas", "auto", "dma", "xla", "tiled", "interpret"),
+    "exchange_impl": ("stock", "pallas", "auto"),
+    "gather_impl": ("auto", "dma", "tiled", "xla"),
+    "combine": ("off", "auto", "dense", "sorted"),
+    "codec": ("off", "dict", "rle", "delta"),
+    "wire_compress_codec": ("off", "dict", "rle", "delta"),
+    "quantize_mode": ("off", "int8", "blockfloat"),
+    "planner_mode": ("static", "adaptive"),
+    "host_recv_mode": ("array", "memmap", "device"),
+}
+
+#: Conf-backed vocabulary keys whose every value must have a DEPLOYMENT.md
+#: mention (operators pick these by name; an undocumented tier is
+#: unreachable in practice and rots).
+TIER_DOC_KEYS = (
+    "exchange_impl",
+    "gather_impl",
+    "wire_compress_codec",
+    "quantize_mode",
+    "planner_mode",
+    "host_recv_mode",
+)
 
 # ----------------------------------------------------------------------
 # tests-tree run

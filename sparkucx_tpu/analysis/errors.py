@@ -1,0 +1,209 @@
+"""error-taxonomy: the retryable/fail-fast split is a registry, not folklore.
+
+The gray-failure arc (health scoring, breakers, hedged fetches) made the
+reader's retry/failover path load-bearing: it may retry *transient* faults
+(missing/corrupt blocks, resource exhaustion) but must propagate
+*fail-fast* faults (tenant errors, lost executors) immediately — retrying
+those wastes the failover budget and masks cluster-state bugs.  API.md
+documents the split in prose; ``ERROR_TAXONOMY`` (analysis/config.py) is
+its machine-checked registry.  This pass pins three things:
+
+* **completeness** — every ``TransportError`` subclass defined in
+  ``ERROR_MODULE`` (transitively: subclasses of subclasses) is classified
+  in ERROR_TAXONOMY, every registry entry names a class that still exists,
+  and every classified class appears in the ``ERROR_DOC`` text;
+* **retry-path hygiene** — functions named in ``RETRY_PATH_FUNCS`` (the
+  reader's retry/failover machinery) must not name a fail-fast class in an
+  ``except`` clause; and
+* **broad-catch coverage** — when a retry-path function catches the base
+  ``TransportError`` (broad by design: transport faults and socket errors
+  share cleanup), the function must guard with ``isinstance`` + ``raise``
+  covering *all* fail-fast classes, so fail-fast faults fall through the
+  retry loop.  Guard classes are resolved through module-level tuple
+  constants (``_FAIL_FAST = (A, B, C)``), so the fail-fast set lives in one
+  assignment next to the imports.
+
+Escape hatch: ``#: taxonomy-ok <reason>`` on the except/guard line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sparkucx_tpu.analysis.base import (
+    Finding,
+    Program,
+    register_global,
+)
+from sparkucx_tpu.analysis.config import (
+    ERROR_BASE,
+    ERROR_DOC,
+    ERROR_MODULE,
+    ERROR_TAXONOMY,
+    RETRY_PATH_FUNCS,
+)
+
+PASS = "error-taxonomy"
+ESCAPE = "#: taxonomy-ok"
+
+_FAIL_FAST = frozenset(
+    name for name, kind in ERROR_TAXONOMY.items() if kind == "fail-fast"
+)
+
+
+def _escaped(lines: List[str], lineno: int) -> bool:
+    return 1 <= lineno <= len(lines) and ESCAPE in lines[lineno - 1]
+
+
+def _base_names(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for base in cls.bases:
+        if isinstance(base, ast.Name):
+            out.add(base.id)
+        elif isinstance(base, ast.Attribute):
+            out.add(base.attr)
+    return out
+
+
+def collect_error_classes(tree: ast.Module) -> Dict[str, ast.ClassDef]:
+    """Transitive subclasses of ERROR_BASE defined in this module."""
+    classes = {
+        node.name: node for node in tree.body if isinstance(node, ast.ClassDef)
+    }
+    family: Set[str] = {ERROR_BASE}
+    changed = True
+    while changed:
+        changed = False
+        for name, node in classes.items():
+            if name not in family and _base_names(node) & family:
+                family.add(name)
+                changed = True
+    return {n: classes[n] for n in family if n != ERROR_BASE and n in classes}
+
+
+def _exc_names(node: ast.AST, module_consts: Dict[str, List[str]]) -> List[str]:
+    """Class names an ``except`` clause or isinstance() second arg refers
+    to — Names, Attributes, tuples of those, and module-level tuple
+    constants resolved by name."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Name):
+        if node.id in module_consts:
+            return list(module_consts[node.id])
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Tuple):
+        out: List[str] = []
+        for elt in node.elts:
+            out.extend(_exc_names(elt, module_consts))
+        return out
+    return []
+
+
+def _module_name_tuples(tree: ast.Module) -> Dict[str, List[str]]:
+    """Module-level ``X = (A, B, C)`` assignments of bare names — the idiom
+    for declaring a fail-fast guard set once."""
+    out: Dict[str, List[str]] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Tuple)
+        ):
+            names = [
+                elt.id for elt in node.value.elts if isinstance(elt, ast.Name)
+            ]
+            if names and len(names) == len(node.value.elts):
+                out[node.targets[0].id] = names
+    return out
+
+
+def _guard_covered(fn: ast.AST, module_consts: Dict[str, List[str]]) -> Set[str]:
+    """Class names covered by ``isinstance(x, C)`` tests inside ``fn``
+    whose branch re-raises (the fail-fast escape from a broad catch)."""
+    covered: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        calls = [test]
+        # also accept `isinstance(...) or isinstance(...)` unions
+        if isinstance(test, ast.BoolOp):
+            calls = list(test.values)
+        has_raise = any(isinstance(s, ast.Raise) for s in ast.walk(node))
+        if not has_raise:
+            continue
+        for call in calls:
+            if (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id == "isinstance"
+                and len(call.args) == 2
+            ):
+                covered.update(_exc_names(call.args[1], module_consts))
+    return covered
+
+
+@register_global(PASS)
+def error_taxonomy_pass(program: Program) -> List[Finding]:
+    findings: List[Finding] = []
+
+    entry = program.module(ERROR_MODULE)
+    doc = program.docs.get(ERROR_DOC)
+    if entry is not None:
+        tree, source = entry
+        lines = source.splitlines()
+        defined = collect_error_classes(tree)
+        for name, node in sorted(defined.items()):
+            if name not in ERROR_TAXONOMY:
+                if not _escaped(lines, node.lineno):
+                    findings.append(Finding(ERROR_MODULE, node.lineno, PASS, (
+                        f"{ERROR_BASE} subclass '{name}' is not classified in "
+                        f"ERROR_TAXONOMY (analysis/config.py) — declare it "
+                        f"retryable or fail-fast so the reader's failover "
+                        f"path can be checked against it")))
+            elif doc is not None and name not in doc:
+                findings.append(Finding(ERROR_MODULE, node.lineno, PASS, (
+                    f"error class '{name}' is classified "
+                    f"'{ERROR_TAXONOMY[name]}' but undocumented in "
+                    f"{ERROR_DOC} — the failure-semantics table is the "
+                    f"caller contract; add it")))
+        for name in sorted(set(ERROR_TAXONOMY) - set(defined)):
+            findings.append(Finding(ERROR_MODULE, 1, PASS, (
+                f"ERROR_TAXONOMY classifies '{name}' but no such "
+                f"{ERROR_BASE} subclass is defined in {ERROR_MODULE} — "
+                f"prune the stale registry entry")))
+
+    # retry-path hygiene across the whole program
+    for rel, (tree, source) in sorted(program.modules.items()):
+        lines = source.splitlines()
+        module_consts = _module_name_tuples(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in RETRY_PATH_FUNCS:
+                continue
+            for handler in ast.walk(node):
+                if not isinstance(handler, ast.ExceptHandler):
+                    continue
+                caught = _exc_names(handler.type, module_consts)
+                bad = sorted(set(caught) & _FAIL_FAST)
+                if bad and not _escaped(lines, handler.lineno):
+                    findings.append(Finding(rel, handler.lineno, PASS, (
+                        f"retry path '{node.name}' catches fail-fast "
+                        f"'{bad[0]}' — fail-fast faults must propagate, not "
+                        f"burn failover budget (ERROR_TAXONOMY)")))
+                if ERROR_BASE in caught:
+                    covered = _guard_covered(node, module_consts)
+                    missing = sorted(_FAIL_FAST - covered)
+                    if missing and not _escaped(lines, handler.lineno):
+                        findings.append(Finding(rel, handler.lineno, PASS, (
+                            f"retry path '{node.name}' catches the broad "
+                            f"{ERROR_BASE} without isinstance+raise guards "
+                            f"covering fail-fast {', '.join(missing)} — "
+                            f"those faults would be silently retried")))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

@@ -1,0 +1,179 @@
+"""span-discipline: every tracer span opened is closed on all paths.
+
+The ``with span(...)``/``TRACER.span(...)`` context managers are safe by
+construction; the hazard is the *explicit* ``start_span``/``end_span`` pair
+(pipelined paths that overlap windows can't keep spans on the thread-local
+stack, so they hand ``SpanCtx`` objects around by value).  A span started
+and never ended renders as an unterminated bar in Perfetto and — worse —
+corrupts the ring's duration accounting silently.  Rules per
+``start_span(...)`` call site:
+
+* assigned to a name — the same function must call ``end_span(<name>)``
+  inside a ``finally`` block (all-paths closure), OR return the name
+  (handoff: the function's docstring must then say who ends it, via
+  ``end_span`` / "ended by" / "closed by").
+* returned directly — handoff: same docstring requirement.
+* anything else (discarded, nested in an expression) — flagged: the
+  ``SpanCtx`` is unreachable and the span can never be ended.
+
+``instant(<literal>)`` event names are cross-checked against the trace
+documentation (``TRACE_DOC``) when it is loaded: instants are the trace
+vocabulary dashboards and postmortem tooling grep for, so an undocumented
+name is a finding.  Modules in ``TRACE_IMPL_MODULES`` (the tracer itself)
+are skipped.  Escape hatch: ``#: span-ok <reason>`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from sparkucx_tpu.analysis.base import (
+    Finding,
+    Program,
+    callee_name,
+    docstring_of,
+    register_global,
+)
+from sparkucx_tpu.analysis.config import TRACE_DOC, TRACE_IMPL_MODULES
+
+PASS = "span-discipline"
+ESCAPE = "#: span-ok"
+
+_HANDOFF_WORDS = ("end_span", "ended by", "closed by")
+
+_FN_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _escaped(lines: List[str], lineno: int) -> bool:
+    return 1 <= lineno <= len(lines) and ESCAPE in lines[lineno - 1]
+
+
+def _walk_scope(fn: ast.AST):
+    """Yield descendants of ``fn`` without crossing into nested function
+    scopes (each nested def gets its own _check_function visit)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FN_TYPES):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _finally_end_span_vars(fn: ast.AST) -> Set[str]:
+    """Names passed to ``end_span(...)`` from inside any ``finally`` block
+    of ``fn`` (nested statements included — the close usually sits under a
+    ``with executor_scope`` inside the finally)."""
+    out: Set[str] = set()
+    for node in _walk_scope(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if (
+                    isinstance(sub, ast.Call)
+                    and callee_name(sub) == "end_span"
+                    and sub.args
+                    and isinstance(sub.args[0], ast.Name)
+                ):
+                    out.add(sub.args[0].id)
+    return out
+
+
+def _returned_vars(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in _walk_scope(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+            out.add(node.value.id)
+    return out
+
+
+def _check_function(fn: ast.AST, rel: str, lines: List[str],
+                    findings: List[Finding]) -> None:
+    closed = _finally_end_span_vars(fn)
+    returned = _returned_vars(fn)
+    doc = docstring_of(fn).lower()
+    handoff_documented = any(w in doc for w in _HANDOFF_WORDS)
+
+    # map each start_span call to the statement that anchors it
+    for stmt in _walk_scope(fn):
+        if isinstance(stmt, ast.Assign):
+            call = stmt.value
+            if isinstance(call, ast.Call) and callee_name(call) == "start_span":
+                if _escaped(lines, call.lineno):
+                    continue
+                tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+                if isinstance(tgt, ast.Name):
+                    if tgt.id in closed:
+                        continue
+                    if tgt.id in returned:
+                        if handoff_documented:
+                            continue
+                        findings.append(Finding(rel, call.lineno, PASS, (
+                            f"span handed off via return of '{tgt.id}' but "
+                            f"'{getattr(fn, 'name', '<fn>')}' does not document "
+                            f"its closer — say who calls end_span (docstring: "
+                            f"'ended by ...') or close it in a finally")))
+                        continue
+                findings.append(Finding(rel, call.lineno, PASS, (
+                    f"start_span result is never passed to end_span inside a "
+                    f"finally block of '{getattr(fn, 'name', '<fn>')}' — a "
+                    f"span must be closed on all paths (or returned with a "
+                    f"documented closer)")))
+        elif isinstance(stmt, ast.Return):
+            call = stmt.value
+            if isinstance(call, ast.Call) and callee_name(call) == "start_span":
+                if _escaped(lines, call.lineno) or handoff_documented:
+                    continue
+                findings.append(Finding(rel, call.lineno, PASS, (
+                    f"'{getattr(fn, 'name', '<fn>')}' returns a started span "
+                    f"but its docstring never says who ends it — document the "
+                    f"handoff ('ended by ...' / 'closed by ...')")))
+        elif isinstance(stmt, ast.Expr):
+            call = stmt.value
+            if isinstance(call, ast.Call) and callee_name(call) == "start_span":
+                if _escaped(lines, call.lineno):
+                    continue
+                findings.append(Finding(rel, call.lineno, PASS, (
+                    "start_span result discarded — the SpanCtx is "
+                    "unreachable, so the span can never be ended")))
+
+
+def _check_instants(tree: ast.Module, rel: str, lines: List[str],
+                    doc: Optional[str], findings: List[Finding]) -> None:
+    if doc is None:
+        return  # no trace doc loaded (installed-package run / bare fixture)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and callee_name(node) == "instant"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            name = node.args[0].value
+            if name not in doc and not _escaped(lines, node.lineno):
+                findings.append(Finding(rel, node.lineno, PASS, (
+                    f"trace instant '{name}' is not documented in "
+                    f"{TRACE_DOC} — instants are the grep vocabulary for "
+                    f"dashboards and postmortems; add it to the trace-points "
+                    f"table")))
+
+
+@register_global(PASS)
+def span_discipline_pass(program: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    doc = program.docs.get(TRACE_DOC)
+    for rel, (tree, source) in sorted(program.modules.items()):
+        if rel in TRACE_IMPL_MODULES:
+            continue
+        lines = source.splitlines()
+        seen: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.lineno in seen:
+                    continue
+                seen.add(node.lineno)
+                _check_function(node, rel, lines, findings)
+        _check_instants(tree, rel, lines, doc, findings)
+    return findings

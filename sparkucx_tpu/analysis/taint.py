@@ -1,0 +1,453 @@
+"""lockstep-taint: local telemetry must never shape the collective schedule.
+
+The SPMD deployment compiles and submits collectives on every process from
+one :class:`ExchangePlan`; any plan field in ``COLLECTIVE_FIELDS``
+(analysis/config.py) that depends on a per-host quantity — a metrics
+snapshot, ``PlanSignals``, health/breaker state, a clock — is a divergent
+compiled program and a cluster-wide hang at the next collective.  This pass
+is an AST taint dataflow over the plan-producing and plan-consuming modules
+(``TAINT_MODULES``):
+
+* **sources** — calls named in ``TAINT_SOURCE_CALLS`` (registry
+  ``snapshot()``, ``PlanSignals`` / ``from_registry``, ``health_snapshot``,
+  ``wire_lane_stats``, breaker reads, clocks) and attribute reads named in
+  ``TAINT_SOURCE_ATTRS`` (``ctx.signals`` — the sanctioned telemetry channel
+  re-taints wherever it is read back out).
+* **clean** — everything else, deliberately including conf fields, function
+  parameters, and all-gather results: the invariant is about *telemetry*
+  divergence, and unknown calls (``jax.jit``, cross-module planners) return
+  clean unless fed taint.
+* **propagation** — through names, attributes, operators, containers,
+  comprehensions; through module-local calls (bare names, ``self.``/
+  ``cls.`` methods, and *nested defs with their closure environment* — the
+  transitive/helper case) by analyzing the callee under the caller's
+  argument taint; through any other call when an argument is tainted.
+* **sinks** — a ``COLLECTIVE_FIELDS`` keyword (or mapped positional) at an
+  ``ExchangePlan`` / ``dataclasses.replace`` / ``PlanContext`` call, a
+  ``plan.<collective_field> = ...`` assignment, either with a tainted value
+  or lexically under a telemetry-tainted branch (implicit flow); and any
+  tainted branch condition in ``SPMD_PRECOLLECTIVE_FUNCS`` (the SPMD
+  transport's pre-collective orchestration) whose body does not end in
+  ``raise`` — failing fast on local bad news is sanctioned, a divergent
+  schedule is not.
+* **absorption** — taint bound to a ``SERVE_PLANE_FIELDS`` keyword or the
+  ``signals`` channel is absorbed: those fields are the declared serve
+  plane, and the resulting plan/context object stays clean so one hedge
+  tweak does not cascade false positives over the whole planner.
+
+The declared COLLECTIVE/SERVE_PLANE split is cross-checked against the
+``ExchangePlan`` dataclass itself (``PLAN_MODULE``): a plan field in
+neither registry, in both, or a registry name with no field is a finding —
+the registry cannot drift from the code.
+
+Escape hatch: ``#: lockstep-ok <reason>`` on the sink/branch line, plus the
+standard allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sparkucx_tpu.analysis.base import Finding, Program, register_global
+from sparkucx_tpu.analysis.config import (
+    COLLECTIVE_FIELDS,
+    PLAN_CLASS,
+    PLAN_CONSTRUCTORS,
+    PLAN_MODULE,
+    SERVE_PLANE_FIELDS,
+    SPMD_PRECOLLECTIVE_FUNCS,
+    TAINT_MODULES,
+    TAINT_SOURCE_ATTRS,
+    TAINT_SOURCE_CALLS,
+)
+
+PASS = "lockstep-taint"
+ESCAPE = "#: lockstep-ok"
+
+_COLLECTIVE = frozenset(COLLECTIVE_FIELDS)
+_SERVE = frozenset(SERVE_PLANE_FIELDS)
+#: keywords that absorb taint at a plan constructor (the declared serve
+#: plane plus the sanctioned PlanContext telemetry channel)
+_ABSORBING = _SERVE | {"signals"}
+
+
+def plan_field_order(tree: ast.Module) -> List[str]:
+    """Ordered field names of the PLAN_CLASS dataclass (for mapping
+    positional constructor args to fields)."""
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == PLAN_CLASS:
+            return [
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+    return []
+
+
+class _FnInfo:
+    """One analyzable function: its AST plus the closure environment it was
+    defined under (non-empty only for nested defs)."""
+
+    __slots__ = ("node", "closure")
+
+    def __init__(self, node: ast.AST, closure: Dict[str, bool]):
+        self.node = node
+        self.closure = closure
+
+
+class _ModuleTaint:
+    """Demand-driven per-module taint analysis."""
+
+    def __init__(self, tree: ast.Module, source: str, rel: str,
+                 plan_fields: List[str]):
+        self.rel = rel
+        self.lines = source.splitlines()
+        self.plan_fields = plan_fields
+        self.findings: Set[Tuple[int, str]] = set()
+        # bare name -> FnInfos (module functions and every class's methods
+        # share the namespace, like the host-sync pass's call-graph index)
+        self.fns: Dict[str, List[_FnInfo]] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.fns.setdefault(node.name, []).append(_FnInfo(node, {}))
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.fns.setdefault(item.name, []).append(_FnInfo(item, {}))
+        #: (fn id, frozenset tainted params) -> returns-tainted (memo +
+        #: recursion guard: an in-flight entry reads as clean, analyzed twice)
+        self._memo: Dict[Tuple[int, frozenset], bool] = {}
+        self._active: Set[Tuple[int, frozenset]] = set()
+
+    # -- driver ---------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for infos in self.fns.values():
+            for info in infos:
+                self._analyze(info, frozenset())
+        return [
+            Finding(self.rel, line, PASS, msg)
+            for line, msg in sorted(self.findings)
+        ]
+
+    # -- helpers --------------------------------------------------------
+
+    def _escaped(self, lineno: int) -> bool:
+        if 1 <= lineno <= len(self.lines):
+            return ESCAPE in self.lines[lineno - 1]
+        return False
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        if not self._escaped(node.lineno):
+            self.findings.add((node.lineno, msg))
+
+    @staticmethod
+    def _param_names(fn: ast.AST) -> List[str]:
+        a = fn.args
+        names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        names += [p.arg for p in a.kwonlyargs]
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    # -- function analysis ---------------------------------------------
+
+    def _analyze(self, info: _FnInfo, tainted_params: frozenset) -> bool:
+        key = (id(info.node), tainted_params)
+        if key in self._memo:
+            return self._memo[key]
+        if key in self._active:
+            return False  # recursion: assume clean on the back edge
+        self._active.add(key)
+        env: Dict[str, bool] = dict(info.closure)
+        for name in self._param_names(info.node):
+            env[name] = name in tainted_params
+        local_fns: Dict[str, _FnInfo] = {}
+        ret = [False]
+        self._walk_body(info.node.body, env, local_fns, 0, info.node, ret)
+        self._active.discard(key)
+        self._memo[key] = ret[0]
+        return ret[0]
+
+    def _walk_body(self, body, env, local_fns, branch_taint, fn, ret) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, env, local_fns, branch_taint, fn, ret)
+
+    def _walk_stmt(self, stmt, env, local_fns, branch_taint, fn, ret) -> None:
+        E = lambda node: self._expr(node, env, local_fns, branch_taint)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: carries the defining scope's taint as its closure
+            local_fns[stmt.name] = _FnInfo(stmt, dict(env))
+        elif isinstance(stmt, ast.Assign):
+            v = E(stmt.value)
+            for tgt in stmt.targets:
+                self._assign(tgt, v, env, branch_taint)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, E(stmt.value), env, branch_taint)
+        elif isinstance(stmt, ast.AugAssign):
+            v = E(stmt.value) or E(stmt.target)
+            self._assign(stmt.target, v, env, branch_taint)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            t = E(stmt.test)
+            if t and fn.name in SPMD_PRECOLLECTIVE_FUNCS:
+                if not self._raise_only(stmt.body) and not self._escaped(stmt.lineno):
+                    self.findings.add((stmt.lineno, (
+                        f"pre-collective branch in '{fn.name}' tested on local "
+                        f"telemetry — every SPMD process must take the same "
+                        f"path into the collective (raise-only fail-fast "
+                        f"branches are exempt)")))
+            inner = branch_taint + (1 if t else 0)
+            self._walk_body(stmt.body, env, local_fns, inner, fn, ret)
+            self._walk_body(stmt.orelse, env, local_fns, inner, fn, ret)
+        elif isinstance(stmt, ast.For):
+            v = E(stmt.iter)
+            self._assign(stmt.target, v, env, branch_taint)
+            # second pass catches loop-carried taint through the body
+            for _ in range(2):
+                self._walk_body(stmt.body, env, local_fns, branch_taint, fn, ret)
+            self._walk_body(stmt.orelse, env, local_fns, branch_taint, fn, ret)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                v = E(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, v, env, branch_taint)
+            self._walk_body(stmt.body, env, local_fns, branch_taint, fn, ret)
+        elif isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, env, local_fns, branch_taint, fn, ret)
+            for handler in stmt.handlers:
+                if handler.name:
+                    env[handler.name] = False
+                self._walk_body(handler.body, env, local_fns, branch_taint, fn, ret)
+            self._walk_body(stmt.orelse, env, local_fns, branch_taint, fn, ret)
+            self._walk_body(stmt.finalbody, env, local_fns, branch_taint, fn, ret)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None and E(stmt.value):
+                ret[0] = True
+        elif isinstance(stmt, ast.Expr):
+            E(stmt.value)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                E(stmt.exc)
+        # Pass/Break/Continue/Import/Global/Delete: nothing to track
+
+    @staticmethod
+    def _raise_only(body) -> bool:
+        return bool(body) and isinstance(body[-1], ast.Raise)
+
+    def _assign(self, tgt, tainted: bool, env, branch_taint) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = tainted
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._assign(elt, tainted, env, branch_taint)
+        elif isinstance(tgt, ast.Starred):
+            self._assign(tgt.value, tainted, env, branch_taint)
+        elif isinstance(tgt, ast.Attribute) and tgt.attr in _COLLECTIVE:
+            if tainted:
+                self._flag(tgt, (
+                    f"collective plan field '{tgt.attr}' assigned from local "
+                    f"telemetry — collective-schedule fields must derive from "
+                    f"conf + all-gathered geometry only (SPMD lockstep)"))
+            elif branch_taint:
+                self._flag(tgt, (
+                    f"collective plan field '{tgt.attr}' assigned under a "
+                    f"telemetry-tainted branch — the write itself diverges "
+                    f"per host (SPMD lockstep)"))
+
+    # -- expressions ----------------------------------------------------
+
+    def _expr(self, node, env, local_fns, branch_taint) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return env.get(node.id, False)
+        if isinstance(node, ast.Attribute):
+            if node.attr in TAINT_SOURCE_ATTRS:
+                return True
+            return self._expr(node.value, env, local_fns, branch_taint)
+        if isinstance(node, ast.Call):
+            return self._call(node, env, local_fns, branch_taint)
+        if isinstance(node, (ast.Lambda,)):
+            # approximate: a lambda is tainted when its body reads taint from
+            # the defining scope (params shadow to clean)
+            inner = dict(env)
+            for p in node.args.args:
+                inner[p.arg] = False
+            return self._expr(node.body, inner, local_fns, branch_taint)
+        # generic: any tainted sub-expression taints the whole expression
+        # (operators, comparisons, containers, subscripts, comprehensions,
+        # f-strings, starred/keyword wrappers)
+        out = False
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword, ast.comprehension)):
+                out = self._expr_any(child, env, local_fns, branch_taint) or out
+        return out
+
+    def _expr_any(self, node, env, local_fns, branch_taint) -> bool:
+        if isinstance(node, ast.keyword):
+            return self._expr(node.value, env, local_fns, branch_taint)
+        if isinstance(node, ast.comprehension):
+            t = self._expr(node.iter, env, local_fns, branch_taint)
+            self._assign(node.target, t, env, branch_taint)
+            for cond in node.ifs:
+                self._expr(cond, env, local_fns, branch_taint)
+            return t
+        return self._expr(node, env, local_fns, branch_taint)
+
+    def _callee(self, node: ast.Call) -> Optional[str]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return None
+
+    def _resolve_local(self, node: ast.Call, local_fns) -> List[_FnInfo]:
+        """Module-local / closure-local callees: bare names, nested defs,
+        and ``self.``/``cls.``-qualified methods."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in local_fns:
+                return [local_fns[func.id]]
+            return self.fns.get(func.id, [])
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("self", "cls")
+        ):
+            return self.fns.get(func.attr, [])
+        return []
+
+    def _call(self, node: ast.Call, env, local_fns, branch_taint) -> bool:
+        name = self._callee(node)
+        arg_taints = [
+            self._expr(a.value if isinstance(a, ast.Starred) else a,
+                       env, local_fns, branch_taint)
+            for a in node.args
+        ]
+        kw_taints = {
+            kw.arg: self._expr(kw.value, env, local_fns, branch_taint)
+            for kw in node.keywords
+        }
+
+        if name in PLAN_CONSTRUCTORS or name == PLAN_CLASS:
+            self._sink_check(node, name, arg_taints, kw_taints, branch_taint)
+
+        # sources taint regardless of arguments
+        if name in TAINT_SOURCE_CALLS:
+            return True
+
+        # module-local / closure calls: propagate argument taint through the
+        # callee (the transitive/helper case)
+        targets = self._resolve_local(node, local_fns)
+        if targets:
+            out = False
+            for info in targets:
+                params = self._param_names(info.node)
+                # drop the bound receiver for self./cls. method calls
+                offset = 0
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and params
+                    and params[0] in ("self", "cls")
+                ):
+                    offset = 1
+                tainted = set()
+                for i, t in enumerate(arg_taints):
+                    if t and i + offset < len(params):
+                        tainted.add(params[i + offset])
+                for kw, t in kw_taints.items():
+                    if t and kw in params:
+                        tainted.add(kw)
+                out = self._analyze(info, frozenset(tainted)) or out
+            return out
+
+        if name in PLAN_CONSTRUCTORS or name == PLAN_CLASS:
+            # serve-plane keywords absorb their taint by design; the object
+            # is tainted only through its base (replace arg 0) or a
+            # non-absorbing field
+            base = arg_taints[0] if (name == "replace" and arg_taints) else False
+            field_taint = any(
+                t for kw, t in kw_taints.items() if kw not in _ABSORBING
+            )
+            return base or field_taint
+
+        # unknown call: clean unless fed taint
+        return any(arg_taints) or any(kw_taints.values())
+
+    def _sink_check(self, node, name, arg_taints, kw_taints, branch_taint) -> None:
+        for kw in node.keywords:
+            if kw.arg in _COLLECTIVE:
+                if kw_taints.get(kw.arg):
+                    self._flag(node, (
+                        f"collective plan field '{kw.arg}' derives from local "
+                        f"telemetry at this {name}(...) — collective-schedule "
+                        f"fields must be pure functions of conf + all-gathered "
+                        f"geometry (SPMD lockstep)"))
+                elif branch_taint:
+                    self._flag(node, (
+                        f"collective plan field '{kw.arg}' written under a "
+                        f"telemetry-tainted branch at this {name}(...) — the "
+                        f"schedule rewrite itself diverges per host "
+                        f"(SPMD lockstep)"))
+        if name == PLAN_CLASS and self.plan_fields:
+            for i, t in enumerate(arg_taints):
+                if i < len(self.plan_fields) and self.plan_fields[i] in _COLLECTIVE:
+                    field = self.plan_fields[i]
+                    if t:
+                        self._flag(node, (
+                            f"collective plan field '{field}' derives from "
+                            f"local telemetry at this {name}(...) — "
+                            f"collective-schedule fields must be pure "
+                            f"functions of conf + all-gathered geometry "
+                            f"(SPMD lockstep)"))
+                    elif branch_taint:
+                        self._flag(node, (
+                            f"collective plan field '{field}' written under a "
+                            f"telemetry-tainted branch at this {name}(...) — "
+                            f"the schedule rewrite itself diverges per host "
+                            f"(SPMD lockstep)"))
+
+
+@register_global(PASS)
+def lockstep_taint_pass(program: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    plan_fields: List[str] = []
+
+    plan_entry = program.module(PLAN_MODULE)
+    if plan_entry is not None:
+        tree, _source = plan_entry
+        plan_fields = plan_field_order(tree)
+        declared = set(COLLECTIVE_FIELDS) | set(SERVE_PLANE_FIELDS)
+        both = set(COLLECTIVE_FIELDS) & set(SERVE_PLANE_FIELDS)
+        fields = set(plan_fields)
+        for name in sorted(both):
+            findings.append(Finding(PLAN_MODULE, 1, PASS,
+                f"plan field '{name}' is declared BOTH collective and "
+                f"serve-plane — the split must partition the dataclass"))
+        for name in sorted(fields - declared):
+            findings.append(Finding(PLAN_MODULE, 1, PASS,
+                f"{PLAN_CLASS} field '{name}' is in neither COLLECTIVE_FIELDS "
+                f"nor SERVE_PLANE_FIELDS — classify it in analysis/config.py "
+                f"before the analyzer can police it"))
+        for name in sorted(declared - fields):
+            findings.append(Finding(PLAN_MODULE, 1, PASS,
+                f"registry names unknown plan field '{name}' — "
+                f"COLLECTIVE_FIELDS/SERVE_PLANE_FIELDS drifted from the "
+                f"{PLAN_CLASS} dataclass; prune the stale entry"))
+
+    targets = [rel for rel in TAINT_MODULES if rel in program.modules]
+    if not targets:
+        targets = sorted(program.modules)  # fixture runs
+    for rel in targets:
+        tree, source = program.modules[rel]
+        if not plan_fields:
+            plan_fields = plan_field_order(tree)  # fixture-defined dataclass
+        findings.extend(_ModuleTaint(tree, source, rel, plan_fields).run())
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

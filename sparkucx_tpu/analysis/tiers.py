@@ -1,0 +1,147 @@
+"""tier-vocabulary: plan tier strings are defined once and never drift.
+
+The lossy tiers (int8/blockfloat quantization, dict/rle/delta codecs) made
+vocabulary drift a silent-corruption risk, not a typo: a parse site that
+accepts ``"bf16"`` where the planner only emits ``"blockfloat"`` routes
+data through the wrong kernel, and nothing crashes.  ``TIER_VOCAB``
+(analysis/config.py) is the single declared vocabulary per tier knob;
+this pass cross-checks every site that mentions one:
+
+* **comparisons** — ``x.lowering == "stock"``, ``impl in ("stock",
+  "pallas")``, either operand order: when the non-literal side's terminal
+  name is a vocab key, every compared literal must be in that key's
+  vocabulary;
+* **keywords** — ``f(lowering="dma")``: a keyword named like a vocab key
+  with a literal string value must pass the same check;
+* **assignments** — ``lowering = "stock"`` / ``self.combine: str =
+  "off"``: a target named like a vocab key assigned a literal likewise;
+* **docs** — for knobs in ``TIER_DOC_KEYS`` every vocabulary value must
+  appear in DEPLOYMENT.md (the conf table is where operators learn the
+  accepted spellings — a value missing there is unreachable in practice).
+
+Dynamic values (conf reads, variables) are out of scope — the vocabulary
+check bites exactly where a human typed a tier string.  Escape hatch:
+``#: tier-ok <reason>`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from sparkucx_tpu.analysis.base import Finding, Program, register_global
+from sparkucx_tpu.analysis.config import CONF_DOC, TIER_DOC_KEYS, TIER_VOCAB
+
+PASS = "tier-vocabulary"
+ESCAPE = "#: tier-ok"
+
+
+def _escaped(lines: List[str], lineno: int) -> bool:
+    return 1 <= lineno <= len(lines) and ESCAPE in lines[lineno - 1]
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """``plan.lowering`` -> lowering, ``impl`` -> impl."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[List[str]]:
+    """String literals on the comparison's literal side: a constant, or a
+    tuple/list/set of constants.  None when any element is dynamic."""
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else None
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: List[str] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _vocab_pairs(left: ast.AST, right: ast.AST) -> Optional[Tuple[str, List[str]]]:
+    """(vocab key, compared literals) when one side is a vocab-named
+    name/attribute and the other is all string literals."""
+    for named, lit in ((left, right), (right, left)):
+        key = _terminal_name(named)
+        if key in TIER_VOCAB:
+            lits = _literal_strs(lit)
+            if lits:
+                return key, lits
+    return None
+
+
+@register_global(PASS)
+def tier_vocabulary_pass(program: Program) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def flag(rel: str, lineno: int, key: str, value: str) -> None:
+        vocab = ", ".join(TIER_VOCAB[key])
+        findings.append(Finding(rel, lineno, PASS, (
+            f"'{value}' is not in the declared '{key}' tier vocabulary "
+            f"({vocab}) — tier strings are defined once in "
+            f"analysis/config.py TIER_VOCAB; a drifted spelling routes "
+            f"data through the wrong kernel silently")))
+
+    for rel, (tree, source) in sorted(program.modules.items()):
+        lines = source.splitlines()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Compare) and len(node.comparators) == 1:
+                pair = _vocab_pairs(node.left, node.comparators[0])
+                if pair and not _escaped(lines, node.lineno):
+                    key, lits = pair
+                    for lit in lits:
+                        if lit not in TIER_VOCAB[key]:
+                            flag(rel, node.lineno, key, lit)
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (
+                        kw.arg in TIER_VOCAB
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                        and kw.value.value not in TIER_VOCAB[kw.arg]
+                        and not _escaped(lines, node.lineno)
+                    ):
+                        flag(rel, node.lineno, kw.arg, kw.value.value)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                value = node.value
+                if not (
+                    isinstance(value, ast.Constant) and isinstance(value.value, str)
+                ):
+                    continue
+                for tgt in targets:
+                    key = _terminal_name(tgt)
+                    if (
+                        key in TIER_VOCAB
+                        and value.value not in TIER_VOCAB[key]
+                        and not _escaped(lines, node.lineno)
+                    ):
+                        flag(rel, node.lineno, key, value.value)
+
+    doc = program.docs.get(CONF_DOC)
+    if doc is not None:
+        for key in TIER_DOC_KEYS:
+            for value in TIER_VOCAB.get(key, ()):
+                # backticked (the conf-table idiom) or a standalone word —
+                # substring alone would vacuously pass short values ("off")
+                if f"`{value}`" not in doc and not re.search(
+                    rf"\b{re.escape(value)}\b", doc
+                ):
+                    findings.append(Finding("config.py", 1, PASS, (
+                        f"tier value '{value}' of knob '{key}' is not "
+                        f"documented in {CONF_DOC} — operators learn the "
+                        f"accepted spellings from the conf table; enumerate "
+                        f"the full vocabulary there")))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

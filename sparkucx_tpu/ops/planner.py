@@ -36,6 +36,12 @@ telemetry (which may differ per host) only steers serve-plane fields that
 never enter a collective (hedge, codec, streams).  ``pipeline_depth`` may
 vary per host safely: depth changes WHEN stages overlap, never the order
 collectives are submitted in.
+
+This invariant is no longer prose-only: the analyzer's ``lockstep-taint``
+pass (docs/ANALYSIS.md) taint-tracks telemetry through this module and the
+SPMD transport and fails CI when a tainted value reaches a field declared
+collective in ``analysis/config.py::COLLECTIVE_FIELDS`` — the registry is
+itself cross-checked against the :class:`ExchangePlan` dataclass.
 """
 
 from __future__ import annotations

@@ -29,6 +29,7 @@ import numpy as np
 
 from sparkucx_tpu.core.block import MemoryBlock, ShuffleBlockId
 from sparkucx_tpu.core.operation import (
+    ExecutorLostError,
     OperationStatus,
     Request,
     TenantQuotaExceededError,
@@ -38,6 +39,13 @@ from sparkucx_tpu.core.operation import (
 from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 from sparkucx_tpu.memory.pool import MemoryPool
 from sparkucx_tpu.utils.trace import TRACER, instant
+
+#: The fail-fast arm of the failure taxonomy (docs/API.md "Failure
+#: semantics", machine-checked by analysis ERROR_TAXONOMY): faults every
+#: replica answers identically (tenant admission) or that name an executor
+#: the membership plane already declared dead.  Retrying burns the failover
+#: budget to hit the same wall — the retry path re-raises these immediately.
+_FAIL_FAST_ERRORS = (TenantQuotaExceededError, UnknownTenantError, ExecutorLostError)
 
 
 @dataclass
@@ -375,7 +383,8 @@ class TpuShuffleReader:
     def _start_window_span(self, num_blocks: int):
         """Open the per-window ``read.window`` span (explicit start/end: the
         pipelined path overlaps windows, so the span can't live on the
-        thread-local stack).  None when tracing is off."""
+        thread-local stack).  Ended by ``_end_window_span`` in the read
+        loop's ``finally``.  None when tracing is off."""
         if not TRACER.active:
             return None
         with TRACER.executor_scope(self.executor_id):
@@ -648,10 +657,12 @@ class TpuShuffleReader:
         timed-out attempt quarantines its buffer too.  Returns
         ``(result, buffer_holding_the_bytes)``.
 
-        Tenant admission rejections (UnknownTenantError /
-        TenantQuotaExceededError) are NOT retried: every replica enforces the
-        same registry budgets, so failing over would just re-pay the backoff
-        to hit the same wall — they propagate immediately.
+        Fail-fast faults (``_FAIL_FAST_ERRORS``) are NOT retried: tenant
+        admission rejections (UnknownTenantError / TenantQuotaExceededError)
+        hit the same registry budgets on every replica, and
+        ``ExecutorLostError`` means the membership plane already declared
+        the peer dead — failing over would just re-pay the backoff to hit
+        the same wall.  They propagate immediately.
         ``ResourceExhaustedError`` (memory-pressure shed, the third arm of
         the failure taxonomy) IS retried: it inherits the jittered doubling
         backoff, which is exactly the back-off-and-retry contract the typed
@@ -663,9 +674,7 @@ class TpuShuffleReader:
         routes straight to the replica ring without burning a full deadline
         per attempt; if EVERY candidate's breaker rejects, the full list is
         kept (an open breaker must delay, never strand, a block)."""
-        if failed is not None and isinstance(
-            failed.error, (TenantQuotaExceededError, UnknownTenantError)
-        ):
+        if failed is not None and isinstance(failed.error, _FAIL_FAST_ERRORS):
             if buf is not None:
                 buf.close()
             raise failed.error
@@ -700,6 +709,9 @@ class TpuShuffleReader:
                         executor, bid.shuffle_id, bid.map_id, bid.reduce_id, buf
                     )
                 except (TransportError, OSError) as e:
+                    if isinstance(e, _FAIL_FAST_ERRORS):
+                        buf.close()
+                        raise
                     last_error = e  # dead peer at connect time: next candidate
                     continue
                 t0 = time.monotonic_ns()
@@ -753,9 +765,7 @@ class TpuShuffleReader:
                     )
                     return result, buf
                 last_error = result.error
-                if isinstance(
-                    last_error, (TenantQuotaExceededError, UnknownTenantError)
-                ):
+                if isinstance(last_error, _FAIL_FAST_ERRORS):
                     buf.close()
                     raise last_error
         if buf is not None:

@@ -1083,6 +1083,622 @@ class TestConfRegistry:
 
 
 # ----------------------------------------------------------------------
+# lockstep-taint
+
+
+class TestLockstepTaint:
+    def test_flags_direct_telemetry_into_collective_field(self):
+        findings = run_source(
+            src(
+                """
+                def plan(registry, plan):
+                    snap = registry.snapshot()
+                    return replace(plan, chunks_per_round=snap["depth"])
+                """
+            ),
+            passes=["lockstep-taint"],
+        )
+        assert len(findings) == 1
+        assert "chunks_per_round" in findings[0].message
+        assert "local telemetry" in findings[0].message
+
+    def test_flags_transitive_helper_flow(self):
+        # the satellite-required case: telemetry flows through a module
+        # helper before reaching chunks_per_round
+        findings = run_source(
+            src(
+                """
+                def _derive(stall_ns):
+                    return 2 if stall_ns > 1000 else 8
+
+                def plan(registry, plan):
+                    snap = registry.snapshot()
+                    depth = _derive(snap["rx_stall_p99_ns"])
+                    return replace(plan, chunks_per_round=depth)
+                """
+            ),
+            passes=["lockstep-taint"],
+        )
+        assert len(findings) == 1
+        assert "chunks_per_round" in findings[0].message
+
+    def test_flags_closure_helper_flow(self):
+        # nested def capturing tainted state from the enclosing scope
+        findings = run_source(
+            src(
+                """
+                def plan(registry, plan):
+                    snap = registry.snapshot()
+
+                    def pick():
+                        return snap["depth"] + 1
+
+                    return replace(plan, chunks_per_round=pick())
+                """
+            ),
+            passes=["lockstep-taint"],
+        )
+        assert len(findings) == 1
+        assert "chunks_per_round" in findings[0].message
+
+    def test_flags_collective_rewrite_under_tainted_branch(self):
+        # implicit flow: the VALUE is a constant but the rewrite only
+        # happens on hosts whose local telemetry crossed a threshold
+        findings = run_source(
+            src(
+                """
+                def plan(registry, plan):
+                    sig = registry.snapshot()
+                    if sig["padding"] > 0.5:
+                        plan = replace(plan, chunks_per_round=4)
+                    return plan
+                """
+            ),
+            passes=["lockstep-taint"],
+        )
+        assert len(findings) == 1
+        assert "telemetry-tainted branch" in findings[0].message
+
+    def test_serve_plane_steering_clean(self):
+        # the satellite-required clean fixture: telemetry may steer
+        # hedge_ms/streams freely (serve-plane), and the resulting plan
+        # object stays clean (absorption)
+        findings = run_source(
+            src(
+                """
+                def plan(registry, plan):
+                    sig = registry.snapshot()
+                    hedge = 5 if sig["rx_stall_p99_ns"] else 0
+                    plan = replace(plan, hedge_ms=hedge)
+                    if sig["credit_stall_ns"]:
+                        plan = replace(plan, streams=2)
+                    return replace(plan, chunks_per_round=8)
+                """
+            ),
+            passes=["lockstep-taint"],
+        )
+        assert findings == []
+
+    def test_conf_and_geometry_clean(self):
+        # conf fields and all-gathered geometry are the sanctioned inputs
+        findings = run_source(
+            src(
+                """
+                def plan(conf, gathered_rows, plan):
+                    rows = int(gathered_rows.max())
+                    return replace(
+                        plan,
+                        chunks_per_round=conf.exchange_chunks_per_round,
+                        slot_rows=rows,
+                        lowering=conf.exchange_impl,
+                    )
+                """
+            ),
+            passes=["lockstep-taint"],
+        )
+        assert findings == []
+
+    def test_escape_comment(self):
+        findings = run_source(
+            src(
+                """
+                def plan(registry, plan):
+                    snap = registry.snapshot()
+                    return replace(plan, chunks_per_round=snap["d"])  #: lockstep-ok reviewed
+                """
+            ),
+            passes=["lockstep-taint"],
+        )
+        assert findings == []
+
+    def test_precollective_branch_flagged_raise_exempt(self):
+        findings = run_source(
+            src(
+                """
+                def run_exchange(self):
+                    snap = self.membership.snapshot()
+                    if snap["dead"]:
+                        raise RuntimeError("executor lost")
+                    if snap["slow"]:
+                        self.use_degraded_schedule()
+                    self.collective()
+                """
+            ),
+            passes=["lockstep-taint"],
+        )
+        assert len(findings) == 1
+        assert "pre-collective branch" in findings[0].message
+        assert findings[0].line == 6  # the schedule branch, not the raise
+
+    def test_registry_partitions_exchange_plan(self):
+        # acceptance criterion: COLLECTIVE_FIELDS == ExchangePlan fields
+        # minus the declared serve-plane fields, with no overlap
+        import dataclasses
+
+        from sparkucx_tpu.analysis.config import (
+            COLLECTIVE_FIELDS,
+            SERVE_PLANE_FIELDS,
+        )
+        from sparkucx_tpu.ops.skew import ExchangePlan
+
+        fields = {f.name for f in dataclasses.fields(ExchangePlan)}
+        assert set(COLLECTIVE_FIELDS) | set(SERVE_PLANE_FIELDS) == fields
+        assert not set(COLLECTIVE_FIELDS) & set(SERVE_PLANE_FIELDS)
+        assert set(COLLECTIVE_FIELDS) == fields - set(SERVE_PLANE_FIELDS)
+
+    def test_registry_drift_flagged(self):
+        # a plan field the registry never classified must fail the run —
+        # the fixture poses as ops/skew.py so the dataclass cross-check fires
+        findings = run_source(
+            src(
+                """
+                class ExchangePlan:
+                    slot_rows: int
+                    chunks_per_round: int
+                    single_shot: bool
+                    round_order: tuple
+                    lowering: str
+                    pipeline_depth: int
+                    streams: int
+                    codec: str
+                    quantize_mode: str
+                    quantize_block: int
+                    hedge_ms: int
+                    combine: str
+                    mystery_knob: int
+                """
+            ),
+            passes=["lockstep-taint"],
+            filename="ops/skew.py",
+        )
+        assert len(findings) == 1
+        assert "mystery_knob" in findings[0].message
+        assert "neither COLLECTIVE_FIELDS nor SERVE_PLANE_FIELDS" in findings[0].message
+
+    def test_real_planner_and_spmd_transport_pass(self, capsys):
+        # the real AdaptivePlanner steers serve-plane fields from telemetry
+        # and the SPMD transport fail-fasts on membership — both must be
+        # clean under the pass (acceptance criterion)
+        assert analysis_main(["--ci", "--passes", "lockstep-taint"]) == 0
+        assert capsys.readouterr().out == ""
+
+    def test_injected_regression_in_real_planner_caught(self):
+        # mutate the REAL planner source: steering chunks_per_round from
+        # PlanSignals telemetry must flag, at the mutated line — proving
+        # the pass guards the actual code, not just toy fixtures
+        import sparkucx_tpu.ops.planner as planner_mod
+
+        src = open(planner_mod.__file__).read()
+        needle = "plan = dataclasses.replace(plan, hedge_ms=hedge)"
+        assert needle in src  # the serve-plane hedge steer in AdaptivePlanner
+        mutated = src.replace(
+            needle,
+            "plan = dataclasses.replace(plan, hedge_ms=hedge, "
+            "chunks_per_round=(1 + int(sig.rx_stall_p99_ns > 0),))",
+        )
+        findings = run_source(
+            mutated, passes=["lockstep-taint"], filename="ops/planner.py"
+        )
+        assert len(findings) == 1
+        assert "chunks_per_round" in findings[0].message
+        # implicit flow too: widening a serve-plane rewrite that sits under
+        # a telemetry branch with a collective field
+        mutated2 = src.replace(
+            'plan = dataclasses.replace(plan, codec="off")',
+            'plan = dataclasses.replace(plan, codec="off", single_shot=True)',
+        )
+        assert mutated2 != src
+        findings2 = run_source(
+            mutated2, passes=["lockstep-taint"], filename="ops/planner.py"
+        )
+        assert len(findings2) == 1
+        assert "single_shot" in findings2[0].message
+        assert "telemetry-tainted branch" in findings2[0].message
+
+
+# ----------------------------------------------------------------------
+# span-discipline
+
+
+class TestSpanDiscipline:
+    def test_flags_discarded_span(self):
+        findings = run_source(
+            src(
+                """
+                def serve(tracer):
+                    tracer.start_span("server.serve")
+                """
+            ),
+            passes=["span-discipline"],
+        )
+        assert len(findings) == 1
+        assert "discarded" in findings[0].message
+
+    def test_flags_span_not_closed_in_finally(self):
+        findings = run_source(
+            src(
+                """
+                def serve(tracer):
+                    ctx = tracer.start_span("server.serve")
+                    do_work()
+                    tracer.end_span(ctx)
+                """
+            ),
+            passes=["span-discipline"],
+        )
+        assert len(findings) == 1
+        assert "closed on all paths" in findings[0].message
+
+    def test_finally_closed_clean(self):
+        findings = run_source(
+            src(
+                """
+                def serve(tracer):
+                    ctx = tracer.start_span("server.serve")
+                    try:
+                        do_work()
+                    finally:
+                        tracer.end_span(ctx)
+                """
+            ),
+            passes=["span-discipline"],
+        )
+        assert findings == []
+
+    def test_handoff_requires_docstring(self):
+        flagged = run_source(
+            src(
+                """
+                def open_window(tracer):
+                    return tracer.start_span("read.window")
+                """
+            ),
+            passes=["span-discipline"],
+        )
+        assert len(flagged) == 1
+        assert "docstring" in flagged[0].message
+        clean = run_source(
+            src(
+                '''
+                def open_window(tracer):
+                    """Open the window span; ended by close_window."""
+                    return tracer.start_span("read.window")
+                '''
+            ),
+            passes=["span-discipline"],
+        )
+        assert clean == []
+
+    def test_instant_names_checked_against_doc(self):
+        doc = {"OBSERVABILITY.md": "| `exchange.plan` | planner resolved |"}
+        flagged = run_source(
+            src(
+                """
+                def f():
+                    instant("exchange.bogus")
+                """
+            ),
+            passes=["span-discipline"],
+            docs=doc,
+        )
+        assert len(flagged) == 1
+        assert "exchange.bogus" in flagged[0].message
+        clean = run_source(
+            src(
+                """
+                def f():
+                    instant("exchange.plan")
+                """
+            ),
+            passes=["span-discipline"],
+            docs=doc,
+        )
+        assert clean == []
+
+    def test_escape_comment(self):
+        findings = run_source(
+            src(
+                """
+                def serve(tracer):
+                    tracer.start_span("fire.and.forget")  #: span-ok sampled externally
+                """
+            ),
+            passes=["span-discipline"],
+        )
+        assert findings == []
+
+
+# ----------------------------------------------------------------------
+# metrics-naming
+
+
+class TestMetricsNaming:
+    DOC = {"OBSERVABILITY.md": "| `ops` | stats |\n| `wire` | lanes |\n"}
+
+    def test_flags_bad_family_and_name(self):
+        findings = run_source(
+            src(
+                """
+                def provide():
+                    return [sample("Bad-Family", "x", 1)]
+                """
+            ),
+            passes=["metrics-naming"],
+        )
+        assert any("Bad-Family" in m for m in messages(findings))
+        findings = run_source(
+            src(
+                """
+                def provide():
+                    return [sample("ops", "camelCase", 1)]
+                """
+            ),
+            passes=["metrics-naming"],
+        )
+        assert any("snake_case" in m for m in messages(findings))
+
+    def test_undocumented_family_flagged(self):
+        findings = run_source(
+            src(
+                """
+                def provide():
+                    return [sample("ghost", "x_total", 1)]
+                """
+            ),
+            passes=["metrics-naming"],
+            docs=self.DOC,
+        )
+        assert any(
+            "ghost" in m and "no row" in m for m in messages(findings)
+        )
+
+    def test_documented_families_clean_and_stale_row_flagged(self):
+        findings = run_source(
+            src(
+                """
+                def wire_up(reg):
+                    reg.register("ops", counter_dict_provider("ops", get))
+                    return sample("wire", "tx_bytes_total", 1)
+                """
+            ),
+            passes=["metrics-naming"],
+            docs=self.DOC,
+        )
+        assert findings == []
+        # drop the wire registration: its doc row is now stale
+        findings = run_source(
+            src(
+                """
+                def wire_up(reg):
+                    reg.register("ops", counter_dict_provider("ops", get))
+                """
+            ),
+            passes=["metrics-naming"],
+            docs=self.DOC,
+        )
+        assert any(
+            "wire" in m and "stale" in m for m in messages(findings)
+        )
+
+    def test_escape_comment(self):
+        findings = run_source(
+            src(
+                """
+                def provide():
+                    return [sample("Legacy-Fam", "x", 1)]  #: metric-ok grandfathered
+                """
+            ),
+            passes=["metrics-naming"],
+        )
+        assert findings == []
+
+
+# ----------------------------------------------------------------------
+# error-taxonomy
+
+
+class TestErrorTaxonomy:
+    API = {"API.md": "BlockNotFoundError UnknownTenantError retryable fail-fast"}
+
+    def test_retry_path_catching_fail_fast_flagged(self):
+        findings = run_source(
+            src(
+                """
+                def _retry_fetch(self):
+                    try:
+                        fetch()
+                    except ExecutorLostError:
+                        pass
+                """
+            ),
+            passes=["error-taxonomy"],
+        )
+        assert len(findings) == 1
+        assert "ExecutorLostError" in findings[0].message
+        assert "fail-fast" in findings[0].message
+
+    def test_broad_catch_without_guards_flagged(self):
+        findings = run_source(
+            src(
+                """
+                def _retry_fetch(self):
+                    try:
+                        fetch()
+                    except TransportError:
+                        pass
+                """
+            ),
+            passes=["error-taxonomy"],
+        )
+        assert len(findings) == 1
+        assert "silently retried" in findings[0].message
+
+    def test_broad_catch_with_tuple_guard_clean(self):
+        # the reader idiom: one module-level fail-fast tuple, isinstance +
+        # re-raise inside the broad handler
+        findings = run_source(
+            src(
+                """
+                _FF = (TenantQuotaExceededError, UnknownTenantError, ExecutorLostError)
+
+                def _retry_fetch(self):
+                    try:
+                        fetch()
+                    except TransportError as e:
+                        if isinstance(e, _FF):
+                            raise
+                """
+            ),
+            passes=["error-taxonomy"],
+        )
+        assert findings == []
+
+    def test_unclassified_subclass_flagged(self):
+        findings = run_source(
+            src(
+                """
+                class TransportError(RuntimeError):
+                    pass
+
+                class NewFangledError(TransportError):
+                    pass
+                """
+            ),
+            passes=["error-taxonomy"],
+            filename="core/operation.py",
+        )
+        assert any(
+            "NewFangledError" in m and "not classified" in m
+            for m in messages(findings)
+        )
+
+    def test_stale_taxonomy_entry_flagged(self):
+        # a registry entry whose class was deleted must fail
+        findings = run_source(
+            src(
+                """
+                class TransportError(RuntimeError):
+                    pass
+                """
+            ),
+            passes=["error-taxonomy"],
+            filename="core/operation.py",
+        )
+        assert any("stale registry entry" in m for m in messages(findings))
+
+    def test_escape_comment(self):
+        findings = run_source(
+            src(
+                """
+                def _retry_fetch(self):
+                    try:
+                        fetch()
+                    except ExecutorLostError:  #: taxonomy-ok reviewed special case
+                        pass
+                """
+            ),
+            passes=["error-taxonomy"],
+        )
+        assert findings == []
+
+    def test_real_taxonomy_classifies_every_subclass(self, capsys):
+        assert analysis_main(["--ci", "--passes", "error-taxonomy"]) == 0
+        assert capsys.readouterr().out == ""
+
+
+# ----------------------------------------------------------------------
+# tier-vocabulary
+
+
+class TestTierVocabulary:
+    def test_flags_drifted_compare_literal(self):
+        findings = run_source(
+            src(
+                """
+                def pick(conf):
+                    if conf.quantize_mode == "bf16":
+                        return fancy()
+                """
+            ),
+            passes=["tier-vocabulary"],
+        )
+        assert len(findings) == 1
+        assert "'bf16'" in findings[0].message
+
+    def test_flags_drifted_keyword_and_membership(self):
+        findings = run_source(
+            src(
+                """
+                def build(plan):
+                    if plan.codec in ("off", "zstd"):
+                        return None
+                    return compile_exchange(lowering="fast")
+                """
+            ),
+            passes=["tier-vocabulary"],
+        )
+        assert len(messages(findings)) == 2
+        assert any("'zstd'" in m for m in messages(findings))
+        assert any("'fast'" in m for m in messages(findings))
+
+    def test_vocabulary_literals_clean(self):
+        findings = run_source(
+            src(
+                """
+                def pick(conf, plan):
+                    lowering = "stock"
+                    if conf.exchange_impl in ("pallas", "auto"):
+                        lowering = "pallas"
+                    return replace(plan, lowering=lowering, combine="sorted")
+                """
+            ),
+            passes=["tier-vocabulary"],
+        )
+        assert findings == []
+
+    def test_escape_comment(self):
+        findings = run_source(
+            src(
+                """
+                def pick(conf):
+                    return conf.codec == "experimental"  #: tier-ok staged rollout
+                """
+            ),
+            passes=["tier-vocabulary"],
+        )
+        assert findings == []
+
+    def test_doc_vocabulary_enumerated(self):
+        # a doc missing a documented knob's tier value must flag
+        findings = run_source(
+            "x = 1\n",
+            passes=["tier-vocabulary"],
+            docs={"DEPLOYMENT.md": "| `quantize.mode` | off | `int8` only |"},
+        )
+        assert any("blockfloat" in m for m in messages(findings))
+
+
+# ----------------------------------------------------------------------
 # CLI
 
 
@@ -1126,6 +1742,11 @@ class TestCli:
             "resource-balance",
             "wire-schema",
             "conf-registry",
+            "lockstep-taint",
+            "span-discipline",
+            "metrics-naming",
+            "error-taxonomy",
+            "tier-vocabulary",
         ):
             assert name in out
 
@@ -1138,6 +1759,33 @@ class TestCli:
         err = capsys.readouterr().err
         assert "stale allowlist entry" in err
         assert "never-matches-anything" in err
+
+    def test_stale_builder_table_entry_fails_full_run(self, capsys, monkeypatch):
+        # PR 10 policy extended to the function-pinning tables: a donation
+        # entry for a deleted builder (the PR 13 `_run_exchange_quota`
+        # cleanup) must fail the default run, not silently match nothing
+        import sparkucx_tpu.analysis.__main__ as cli
+
+        monkeypatch.setattr(
+            cli,
+            "DONATING_BUILDERS",
+            {**cli.DONATING_BUILDERS, "_run_exchange_quota": (0,)},
+        )
+        assert analysis_main([]) == 1
+        err = capsys.readouterr().err
+        assert "stale DONATING_BUILDERS entry" in err
+        assert "_run_exchange_quota" in err
+
+    def test_stale_host_sync_root_fails_full_run(self, capsys, monkeypatch):
+        import sparkucx_tpu.analysis.__main__ as cli
+
+        monkeypatch.setattr(
+            cli, "HOST_SYNC_ROOTS", cli.HOST_SYNC_ROOTS + ("_assemble",)
+        )
+        assert analysis_main([]) == 1
+        err = capsys.readouterr().err
+        assert "stale HOST_SYNC_ROOTS entry" in err
+        assert "_assemble" in err
 
     def test_dump_lock_graph(self, capsys):
         assert analysis_main(["--dump-lock-graph"]) == 0
