@@ -389,6 +389,33 @@ def main():
     except Exception as e:
         RESULT["tenants_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # 1d2. Popularity-aware fan-in sub-metric — also TPU-free (per
+    # replica-set width, single-worker loopback servers under a fixed
+    # per-request service stall; 8 concurrent readers fan in on ONE hot
+    # block promoted past serve.hotThresholdFetchesPerSec and spread across
+    # the HOT_SET_PULL-advertised holders): aggregate GB/s + pooled p99 per
+    # width, and the width-4/width-1 speedup (perf/benchmark.py
+    # measure_fanin; bit-identical from every holder off the clock).
+    try:
+        from sparkucx_tpu.perf.benchmark import measure_fanin
+
+        fn = measure_fanin(
+            num_readers=8, block_bytes=256 << 10, iterations=2,
+            fetches_per_reader=3,
+        )
+        RESULT["fanin"] = {
+            "per_width": {
+                str(w): {
+                    "agg_gbps": round(m["agg_gbps"], 3),
+                    "p99_fetch_ms": round(m["p99_fetch_ms"], 2),
+                }
+                for w, m in fn["per_width"].items()
+            },
+            "speedup": round(fn["speedup"], 3),
+        }
+    except Exception as e:
+        RESULT["fanin_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # 1e. Compression sub-metric — also TPU-free (loopback peer wire with the
     # tier-(a) chunk codecs).  Reports ratio x effective GB/s, never ratio
     # alone: a codec only counts if DECODED bytes per wall-second go up.

@@ -202,6 +202,14 @@ LOCK_ATTR_CLASSES = {
     "_metrics": "MetricsRegistry",
     "recorder": "FlightRecorder",
     "tracer": "Tracer",
+    # popularity-aware serving tier (PR 19): both locks are leaves by design
+    # — the tracker computes EWMAs and the serve cache mutates its LRU map
+    # with no calls out while held.  Wiring them here lets the lock-order
+    # pass prove that instead of assuming it.
+    "popularity": "BlockPopularity",
+    "_popularity": "BlockPopularity",
+    "serve_cache": "ServeCache",
+    "_serve_cache": "ServeCache",
 }
 
 #: Locks that exist to SERIALIZE a blocking wire write and are therefore
@@ -330,6 +338,16 @@ OFF_PATH_DEFAULTS = {
     "obs_ring_capacity": 8192,
     "obs_postmortem_dir": "",
     "exchange_fused_combine": False,
+    # popularity-aware serving tier: threshold 0 = no tracker, no HotSetPull
+    # frames, no widened replica pushes; serve_hot_replicas is hot-path-only
+    # (inert while the threshold is 0) and serve_cache_bytes 0 = no decoded
+    # cache, so serve behavior stays byte-identical.  compress_cache_bytes is
+    # only consulted while compress.codec is on (itself pinned "off") — its
+    # default preserves the historical 128 MiB pool cap.
+    "serve_hot_threshold_fetches_per_sec": 0.0,
+    "serve_hot_replicas": 4,
+    "serve_cache_bytes": 0,
+    "compress_cache_bytes": 128 << 20,
 }
 
 # ----------------------------------------------------------------------

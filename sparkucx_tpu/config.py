@@ -210,6 +210,41 @@ class TpuShuffleConf:
     #: ``breaker_failure_threshold`` > 0.
     breaker_cooldown_ms: int = 1000
 
+    # popularity-aware serving tier (hot-block replica fanout + serve cache)
+    #: Per-block fetch-rate promotion threshold (fetches/sec, EWMA —
+    #: store/hbm_store.py ``BlockPopularity``): when a served block's observed
+    #: fetch rate crosses it, the serving executor promotes the block's
+    #: shuffle to HOT — the replicator widens the shuffle's replica set to
+    #: ``serve.hotReplicas`` ring successors (reusing the REPLICA_PUT/
+    #: REPLICA_ACK plane) and advertises the widened holder list through the
+    #: HotSetPull AM so readers spread fetches across every holder instead of
+    #: queueing on the primary.  Cooling below half the threshold demotes the
+    #: advertisement again (hysteresis) — never below the
+    #: ``replication.factor`` fault-tolerance floor.  0 (default) disables
+    #: popularity tracking entirely: no tracker state, no HotSetPull frames,
+    #: wire and store behavior byte-identical to the golden captures.
+    serve_hot_threshold_fetches_per_sec: float = 0.0
+    #: Widened replica-set width for HOT shuffles: how many ring successors a
+    #: hot shuffle is replicated to (total holders = the primary + this many),
+    #: clamped to at least ``replication.factor`` so promotion can only ever
+    #: ADD holders and demotion can only retreat to the fault-tolerance
+    #: floor.  Inert while ``serve.hotThresholdFetchesPerSec`` is 0.
+    serve_hot_replicas: int = 4
+    #: Byte budget for the serve-side decoded-block cache
+    #: (service/eviction.py ``ServeCache``): blocks the popularity tracker
+    #: marks hot are pinned decoded in a byte-budgeted LRU above the eviction
+    #: tiers — charged against the owning tenant's HBM quota — so serving the
+    #: hot set never pays a demotion restage.  0 (default) = no serve cache;
+    #: store serve behavior byte-identical to the golden captures.
+    serve_cache_bytes: int = 0
+    #: Byte cap for the serve-side encoded-chunk pool (transport/peer.py
+    #: BlockServer): sealed chunks pay the encoder once and every later fetch
+    #: serves the cached encoding, evicted least-recently-served (LRU) once
+    #: the held encoded bytes exceed this cap.  Only consulted while
+    #: ``compress.codec`` is on; the default preserves the historical 128 MiB
+    #: pool.
+    compress_cache_bytes: int = 128 << 20
+
     # staged store (HBM; NVKV analogue).  512 = one exchange row (128 int32
     # lanes, the native XLA:TPU tile width) and exactly NVKV's sector alignment
     # (NvkvHandler.scala:244-256).
@@ -493,6 +528,10 @@ class TpuShuffleConf:
             ("fetch.hedgeMaxMs", "fetch_hedge_max_ms", int),
             ("breaker.failureThreshold", "breaker_failure_threshold", int),
             ("breaker.cooldownMs", "breaker_cooldown_ms", int),
+            ("serve.hotThresholdFetchesPerSec", "serve_hot_threshold_fetches_per_sec", float),
+            ("serve.hotReplicas", "serve_hot_replicas", int),
+            ("serve.cacheBytes", "serve_cache_bytes", parse_size),
+            ("compress.cacheBytes", "compress_cache_bytes", parse_size),
             ("store.softWatermark", "store_soft_watermark", parse_size),
             ("store.hardWatermark", "store_hard_watermark", parse_size),
             ("server.acceptBacklog", "server_accept_backlog", int),
@@ -621,6 +660,16 @@ class TpuShuffleConf:
             raise ValueError("breaker_failure_threshold must be >= 0 (0 = breakers off)")
         if self.breaker_cooldown_ms < 0:
             raise ValueError("breaker_cooldown_ms must be >= 0")
+        if self.serve_hot_threshold_fetches_per_sec < 0:
+            raise ValueError(
+                "serve_hot_threshold_fetches_per_sec must be >= 0 (0 = popularity tracking off)"
+            )
+        if self.serve_hot_replicas < 0:
+            raise ValueError("serve_hot_replicas must be >= 0")
+        if self.serve_cache_bytes < 0:
+            raise ValueError("serve_cache_bytes must be >= 0 (0 = no serve-side cache)")
+        if self.compress_cache_bytes < 0:
+            raise ValueError("compress_cache_bytes must be >= 0 (0 = no encoded-chunk pool)")
         if self.store_soft_watermark < 0:
             raise ValueError("store_soft_watermark must be >= 0 (0 = no soft watermark)")
         if self.store_hard_watermark < 0:

@@ -29,6 +29,11 @@ ServerBusy            13  load shedding: the server's accept backlog is full
                           (``server.acceptBacklog``) — sent best-effort before
                           closing the shed connection; headerless, bodyless.
                           Clients surface it as retryable ResourceExhaustedError
+HotSetPull            14  popularity-aware serving: pull the peer's hot-set
+                          advertisement — request (tag), reply body = packed
+                          {shuffle: [holder executor ids]} table (hot shuffles
+                          whose replica sets were widened beyond
+                          ``replication.factor``)
 ====================  ==  =======================================================
 
 Ids 5-6 extend the reference schema for the striped zero-copy wire path: a
@@ -51,7 +56,7 @@ from __future__ import annotations
 import enum
 import struct
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 class AmId(enum.IntEnum):
@@ -71,6 +76,7 @@ class AmId(enum.IntEnum):
     TRACE_PULL = 11
     METRICS_PULL = 12
     SERVER_BUSY = 13
+    HOT_SET_PULL = 14
 
 
 _FRAME = struct.Struct("<IQQ")
@@ -270,6 +276,44 @@ def unpack_replica_trace_ext(data) -> Optional[Tuple[int, int]]:
     if magic != REPLICA_TRACE_MAGIC:
         return None
     return trace_id, span_id
+
+
+#: HotSetPull reply body (popularity-aware serving): the advertised hot-set
+#: table, ``{shuffle_id: [holder executor ids]}``.  Layout: a ``_HOT_HDR``
+#: shuffle count, then per shuffle a ``_HOT_ENT`` (shuffle_id, num_holders)
+#: followed by num_holders ``_HOT_EID`` executor ids.  Requests reuse the
+#: obs-plane pull shape (u64 tag header, empty body) so the reply can be
+#: parked on the tag like TracePull/MetricsPull.  An empty table (count 0)
+#: is a valid reply — nothing is hot.
+_HOT_HDR = struct.Struct("<I")
+_HOT_ENT = struct.Struct("<iI")
+_HOT_EID = struct.Struct("<i")
+
+
+def pack_hot_set(hot: Dict[int, List[int]]) -> bytes:
+    """Pack the hot-set advertisement table (sorted for determinism)."""
+    out = bytearray(_HOT_HDR.pack(len(hot)))
+    for sid in sorted(hot):
+        holders = sorted(hot[sid])
+        out += _HOT_ENT.pack(sid, len(holders))
+        for eid in holders:
+            out += _HOT_EID.pack(eid)
+    return bytes(out)
+
+
+def unpack_hot_set(data) -> Dict[int, List[int]]:
+    (n,) = _HOT_HDR.unpack_from(data)
+    pos = _HOT_HDR.size
+    out: Dict[int, List[int]] = {}
+    for _ in range(n):
+        sid, nh = _HOT_ENT.unpack_from(data, pos)
+        pos += _HOT_ENT.size
+        holders: List[int] = []
+        for _ in range(nh):
+            holders.append(_HOT_EID.unpack_from(data, pos)[0])
+            pos += _HOT_EID.size
+        out[sid] = holders
+    return out
 
 
 #: Membership frame header (MemberSuspect / MemberRejoin): the observer's

@@ -61,6 +61,15 @@ Modes:
   as the FETCH_BLOCK_REQ extension (tenant-local shuffle ids, server-side
   TenantRegistry translation).  Prints aggregate GB/s, per-app GB/s, the
   min/max per-app fairness ratio, and p50/p99 per-block fetch latency.
+* ``fanin`` — popularity-aware serving under N-reducer fan-in on ONE hot
+  block: per replica-set width (1/2/4 holders), a fresh loopback cluster of
+  single-worker servers with a fixed per-FETCH_BLOCK_REQ service stall (the
+  deterministic single-server ceiling); a bootstrap storm promotes the block
+  (``serve.hotThresholdFetchesPerSec``), the primary advertises every holder
+  over HOT_SET_PULL, and -t (default 8) concurrent readers rotate their
+  fetches across the set.  Prints aggregate GB/s + pooled p99 per-fetch
+  latency per width and the width-4/width-1 speedup; off the clock the block
+  is asserted bit-identical from EVERY holder.
 * ``elastic`` — degraded-mode exchange recovery under chaos: an
   ``--executors``-wide loopback cluster with ``elastic.enabled`` and
   ``replication.factor = 1`` runs multi-round shuffles of -s-byte blocks.
@@ -139,7 +148,7 @@ def _parse_args(argv):
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "adaptive", "wire",
             "ici", "combine", "failover", "elastic", "compress", "tenants",
-            "obs", "gray",
+            "obs", "gray", "fanin",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -1121,6 +1130,179 @@ def measure_tenants(
         server.close()
 
 
+def measure_fanin(
+    num_readers: int = 8,
+    block_bytes: int = 256 << 10,
+    iterations: int = 3,
+    widths=(1, 2, 4),
+    fetches_per_reader: int = 4,
+    serve_stall_ms: float = 2.0,
+    report=None,
+) -> dict:
+    """Measurement core of the ``fanin`` mode — N-reducer fan-in on ONE hot
+    block vs the popularity tier's replica-set width.
+
+    Per width ``w``: a fresh loopback cluster of ``w`` servers (primary +
+    ``w - 1`` ring successors at ``replication.factor = w - 1``), each with a
+    single-worker reactor (``server.workers = 1``) and every FETCH_BLOCK_REQ
+    stalled ``serve_stall_ms`` — a deterministic per-request service-time
+    ceiling, so one server saturates and the only way up is MORE HOLDERS.
+    A bootstrap storm promotes the block past
+    ``serve.hotThresholdFetchesPerSec``; the primary then advertises all
+    ``w`` holders over HOT_SET_PULL, and ``num_readers`` concurrent reader
+    transports (deterministic per-reader rotation) fan their fetches out
+    across the set.  The stall is armed AFTER staging/replication and
+    disarmed before the off-clock pass, which asserts the block bit-identical
+    from EVERY holder.  Returns per-width aggregate GB/s and pooled p99
+    per-fetch latency plus the width-max/width-1 speedup.
+    ``report(phase, it, seconds, bytes)`` per pass.  Shared by the CLI and
+    bench.py."""
+    from sparkucx_tpu.core.definitions import AmId
+    from sparkucx_tpu.shuffle.reader import TpuShuffleReader
+    from sparkucx_tpu.testing import faults
+
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, size=block_bytes, dtype=np.uint8).tobytes()
+    per_width: dict = {}
+    for w in widths:
+        conf = TpuShuffleConf(
+            replication_factor=w - 1,
+            serve_hot_threshold_fetches_per_sec=1.0,
+            serve_hot_replicas=w - 1,
+            serve_cache_bytes=4 * block_bytes,
+            server_workers=1,
+            wire_timeout_ms=10_000,
+            staging_capacity_per_executor=block_bytes + (1 << 20),
+        )
+        servers = [PeerTransport(conf, executor_id=i) for i in range(w)]
+        addrs = [t.init() for t in servers]
+        for t in servers:
+            for j, a in enumerate(addrs):
+                if j != t.executor_id:
+                    t.add_executor(j, a)
+        clients: List[PeerTransport] = []
+        try:
+            servers[0].store.create_shuffle(0, 1, 1)
+            mw = servers[0].store.map_writer(0, 0)
+            mw.write_partition(0, payload)
+            mw.commit()
+            servers[0].store.seal(0)
+            assert servers[0].replication_wait(0, timeout=60.0)
+
+            for i in range(num_readers):
+                c = PeerTransport(conf, executor_id=100 + i)
+                c.init()
+                c.add_executor(0, addrs[0])
+                for j in range(1, w):
+                    c.add_executor(j, addrs[j])
+                clients.append(c)
+
+            def fetch_once(c, target):
+                buf = MemoryBlock(np.zeros(block_bytes, np.uint8), size=block_bytes)
+                req = c.fetch_block(target, 0, 0, 0, buf)
+                deadline = time.monotonic() + 10.0
+                while not req.completed() and time.monotonic() < deadline:
+                    c.progress()
+                res = req.wait(1)
+                assert res.status == OperationStatus.SUCCESS, str(res.error)
+                return buf
+
+            # bootstrap storm: back-to-back fetches promote the block and
+            # (w > 1) stand up the widened advertisement
+            for _ in range(6):
+                fetch_once(clients[0], 0).close()
+            assert servers[0].popularity.is_hot(0)
+            holders = clients[0].hot_holders(0, 0) or [0]
+            assert len(holders) == w, f"width {w}: advertised {holders}"
+
+            def make_reader(c):
+                return TpuShuffleReader(
+                    c,
+                    executor_id=c.executor_id,
+                    shuffle_id=0,
+                    start_partition=0,
+                    end_partition=1,
+                    num_mappers=1,
+                    block_sizes=lambda m, r: block_bytes,
+                    max_blocks_per_request=1,
+                    sender_of=lambda m: 0,
+                    holders_of=c.hot_holders,
+                    fetch_retries=2,
+                    fetch_deadline_ms=10_000,
+                    fetch_backoff_ms=10,
+                )
+
+            def drain(c, lat):
+                for _ in range(fetches_per_reader):
+                    t0 = time.perf_counter()
+                    for blk in make_reader(c).fetch_blocks():
+                        blk.release()
+                    lat.append(time.perf_counter() - t0)
+
+            for c in clients:  # warmup: connect, learn the hot set
+                for blk in make_reader(c).fetch_blocks():
+                    blk.release()
+
+            # service-time ceiling, armed only for the timed passes
+            entry = faults.arm(
+                "peer.server.frame",
+                faults.stall(serve_stall_ms / 1e3),
+                match={"am_id": int(AmId.FETCH_BLOCK_REQ)},
+            )
+            total = num_readers * fetches_per_reader * block_bytes
+            best = 0.0
+            latencies: List[float] = []
+            for it in range(iterations):
+                lat = [[] for _ in clients]
+                threads = [
+                    threading.Thread(target=drain, args=(c, lat[i]))
+                    for i, c in enumerate(clients)
+                ]
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                wall = time.perf_counter() - t0
+                best = max(best, total / wall / 1e9)
+                for per_client in lat:
+                    latencies.extend(per_client)
+                if report is not None:
+                    report(f"width-{w}", it, wall, total)
+            faults.disarm(entry)
+
+            # off-clock: the same bytes from EVERY advertised holder
+            for holder in holders:
+                buf = fetch_once(clients[0], holder)
+                assert bytes(buf.host_view()[:block_bytes]) == payload, (
+                    f"width {w}: holder {holder} served different bytes"
+                )
+                buf.close()
+
+            lats = np.sort(np.asarray(latencies))
+            per_width[w] = {
+                "agg_gbps": best,
+                "p99_fetch_ms": float(
+                    lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+                ) * 1e3,
+                "holders": holders,
+            }
+        finally:
+            faults.reset()
+            for c in clients:
+                c.close()
+            for t in servers:
+                t.close()
+    w_lo, w_hi = min(widths), max(widths)
+    return {
+        "readers": num_readers,
+        "block_bytes": block_bytes,
+        "per_width": per_width,
+        "speedup": per_width[w_hi]["agg_gbps"]
+        / max(per_width[w_lo]["agg_gbps"], 1e-12),
+    }
+
+
 def measure_elastic(
     num_executors: int = 4,
     block_bytes: int = 8 << 10,
@@ -1682,6 +1864,36 @@ def run_tenants(args) -> None:
     for app, gbps in sorted(r["per_app_gbps"].items()):
         used = r["tenant_stats"].get(app, {}).get("used_bytes", 0)
         print(f"tenants   {app}: {gbps:.3f} GB/s, hbm used {used} B", flush=True)
+
+
+def run_fanin(args) -> None:
+    size = parse_size(args.block_size)
+    readers = args.threads if args.threads > 1 else 8
+
+    def report(phase, it, dt, tot):
+        print(
+            f"{phase} iter {it}: {readers} readers x 1 hot block x {size} B "
+            f"in {dt*1e3:.1f} ms = {tot / dt / 1e9:.2f} GB/s",
+            flush=True,
+        )
+
+    r = measure_fanin(
+        num_readers=readers,
+        block_bytes=size,
+        iterations=args.iterations,
+        report=report,
+    )
+    for w, m in sorted(r["per_width"].items()):
+        print(
+            f"fanin width {w}: {m['agg_gbps']:.2f} GB/s aggregate, "
+            f"p99 fetch {m['p99_fetch_ms']:.2f} ms, holders {m['holders']}",
+            flush=True,
+        )
+    print(
+        f"fanin: width-{max(r['per_width'])} / width-{min(r['per_width'])} "
+        f"speedup {r['speedup']:.2f}x, bit-identical from every holder",
+        flush=True,
+    )
 
 
 def run_elastic(args) -> None:
@@ -3293,6 +3505,8 @@ def main(argv=None) -> None:
         run_failover(args)
     elif args.mode == "tenants":
         run_tenants(args)
+    elif args.mode == "fanin":
+        run_fanin(args)
     elif args.mode == "elastic":
         run_elastic(args)
     elif args.mode == "obs":

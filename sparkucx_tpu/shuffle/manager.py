@@ -129,6 +129,12 @@ class TpuShuffleManager:
             def replica_of(primary):
                 return ring_neighbors(primary, executors, factor)
 
+        holders_of = None
+        if self.conf.serve_hot_threshold_fetches_per_sec > 0:
+            # popularity-aware load spreading: ask the primary who else holds
+            # its hot blocks (HotSetPull), so reducers rotate across holders
+            holders_of = getattr(transport, "hot_holders", None)
+
         return TpuShuffleReader(
             transport,
             executor_id,
@@ -145,6 +151,7 @@ class TpuShuffleManager:
             fetch_retries=self.conf.fetch_retries,
             credit_bytes=self.conf.wire_credit_bytes,
             replica_of=replica_of,
+            holders_of=holders_of,
             fetch_deadline_ms=self.conf.fetch_deadline_ms,
             fetch_backoff_ms=self.conf.fetch_backoff_ms,
             fetch_hedge_ms=self.conf.fetch_hedge_ms,

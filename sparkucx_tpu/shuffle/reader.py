@@ -23,7 +23,7 @@ from __future__ import annotations
 import random
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -220,6 +220,7 @@ class TpuShuffleReader:
         fetch_backoff_ms: int = 50,
         fetch_hedge_ms: int = 0,
         fetch_hedge_max_ms: int = 0,
+        holders_of: Optional[Callable[[ExecutorId, int], Sequence[ExecutorId]]] = None,
     ) -> None:
         self.transport = transport
         self.executor_id = executor_id
@@ -271,6 +272,17 @@ class TpuShuffleReader:
         #: scatter target — kept alive until their request completes, then
         #: closed by _sweep_abandoned (single reader thread; no lock)
         self._abandoned: List[Tuple[MemoryBlock, Request]] = []
+        #: popularity-aware load spreading: ``holders_of(primary, shuffle_id)``
+        #: returns the CURRENT holder set the primary advertises for a hot
+        #: shuffle (transport.hot_holders — widened replica sets learned via
+        #: HOT_SET_PULL, []/None when cold).  With >1 holder, this reader
+        #: deterministically rotates its fetches across them instead of
+        #: piling onto the primary.  None = the historical primary-only path.
+        self.holders_of = holders_of
+        #: where each in-flight block of the current window was ACTUALLY sent
+        #: (spread target, not necessarily the primary) — hedges must pick a
+        #: different holder than this (single reader thread; no lock)
+        self._window_targets: Dict[ShuffleBlockId, ExecutorId] = {}
         self.metrics = ShuffleReadMetrics()
 
     # -- raw block iterator ------------------------------------------------
@@ -358,6 +370,30 @@ class TpuShuffleReader:
         self._sweep_abandoned()
         self._flush_read_counters()
 
+    def _spread_target(self, bid: ShuffleBlockId) -> ExecutorId:
+        """Where to send the fetch for ``bid``: the primary, unless the
+        primary advertises a widened holder set for this (hot) shuffle — then
+        a deterministic-per-reader rotation over the sorted holders, so N
+        concurrent reducers spread a fan-in across every holder instead of
+        piling onto one server, while any single reader stays deterministic
+        (retries and the bit-equality contract rely on that)."""
+        primary = self.sender_of(bid.map_id)
+        if self.holders_of is None:
+            return primary
+        try:
+            holders = sorted(set(self.holders_of(primary, bid.shuffle_id) or ()))
+        except (TransportError, OSError):
+            return primary  # advertisement pull failed: serve from primary
+        # never rotate onto ourselves: a co-located copy is the local store
+        # path's business, and the wire transport has no loopback connection
+        # to its own executor (falling out of _issue_window unguarded)
+        holders = [e for e in holders if e != self.executor_id]
+        if len(holders) < 2 or primary not in holders:
+            return primary
+        return holders[
+            (self.executor_id + bid.map_id + bid.reduce_id) % len(holders)
+        ]
+
     def _issue_window(
         self, window: List[ShuffleBlockId]
     ) -> List[Tuple[ShuffleBlockId, MemoryBlock, Request]]:
@@ -368,7 +404,9 @@ class TpuShuffleReader:
             buffers = [MemoryBlock(np.zeros(s, dtype=np.uint8), size=s) for s in sizes]
         groups: dict = {}
         for bid, buf in zip(window, buffers):
-            groups.setdefault(self.sender_of(bid.map_id), []).append((bid, buf))
+            target = self._spread_target(bid)
+            self._window_targets[bid] = target
+            groups.setdefault(target, []).append((bid, buf))
         requests: List[Tuple[ShuffleBlockId, MemoryBlock, Request]] = []
         for sender, items in groups.items():
             reqs = self.transport.fetch_blocks_by_block_ids(
@@ -457,32 +495,55 @@ class TpuShuffleReader:
         return True
 
     def _issue_hedges(self, requests, hedges) -> None:
-        """One duplicate fetch per straggling block, to a replica holder.
+        """One duplicate fetch per straggling block, to a different holder.
 
-        Replica selection walks ``replica_of(primary)`` skipping the primary
-        itself and (when the transport scores peers) any executor whose
-        circuit breaker rejects the probe.  Hedge buffers are allocated
-        OUTSIDE the credit gate on purpose: hedges exist to break stalls, and
-        gating them on credits held by the very window that is stalled would
-        deadlock; the overdraft is bounded by one buffer per straggling
-        block, and losers drain through the ``_abandoned`` quarantine."""
-        if self.replica_of is None:
+        Candidates are the advertised hot-set holders (``holders_of``) plus
+        the replication-ring successors (``replica_of``), minus the executor
+        the straggling fetch was ACTUALLY sent to — racing the same stalled
+        server is exactly the failure hedging exists to break — and minus
+        (when the transport scores peers) any executor whose circuit breaker
+        rejects the probe.  With several admissible holders the pick rotates
+        deterministically per (reader, block), spreading hedge load instead
+        of always hammering the first ring successor.  Hedge buffers are
+        allocated OUTSIDE the credit gate on purpose: hedges exist to break
+        stalls, and gating them on credits held by the very window that is
+        stalled would deadlock; the overdraft is bounded by one buffer per
+        straggling block, and losers drain through the ``_abandoned``
+        quarantine."""
+        if self.replica_of is None and self.holders_of is None:
             return
         allows = getattr(self.transport, "breaker_allows", None)
         for i, (bid, _, req) in enumerate(requests):
             if req.completed() or i in hedges:
                 continue
             primary = self.sender_of(bid.map_id)
-            target: Optional[ExecutorId] = None
-            for e in self.replica_of(primary):
-                if e == primary:
-                    continue
-                if allows is not None and not allows(e):
-                    continue
-                target = e
-                break
-            if target is None:
+            actual = self._window_targets.get(bid, primary)
+            candidates: List[ExecutorId] = []
+            if self.holders_of is not None:
+                try:
+                    candidates += sorted(
+                        set(self.holders_of(primary, bid.shuffle_id) or ())
+                    )
+                except (TransportError, OSError):
+                    pass
+            if primary not in candidates:
+                candidates.append(primary)
+            if self.replica_of is not None:
+                candidates += [
+                    e for e in self.replica_of(primary) if e not in candidates
+                ]
+            admissible = [
+                e
+                for e in candidates
+                if e != actual
+                and e != self.executor_id
+                and (allows is None or allows(e))
+            ]
+            if not admissible:
                 continue
+            target = admissible[
+                (self.executor_id + bid.map_id + bid.reduce_id) % len(admissible)
+            ]
             size = self.block_sizes(bid.map_id, bid.reduce_id)
             hbuf = None
             try:
@@ -540,7 +601,7 @@ class TpuShuffleReader:
                 self.metrics.hedge_wins += 1
                 if record is not None:
                     record(
-                        self.sender_of(bid.map_id),
+                        self._window_targets.get(bid, self.sender_of(bid.map_id)),
                         f"hedged fetch of {bid} lost to replica {target}",
                     )
                 instant(
@@ -682,8 +743,22 @@ class TpuShuffleReader:
         size = self.block_sizes(bid.map_id, bid.reduce_id)
         primary = self.sender_of(bid.map_id)
         candidates: List[ExecutorId] = [primary]
+        if self.holders_of is not None:
+            # hot-set holders are first-class failover candidates: a widened
+            # replica set exists precisely because this block draws fire
+            try:
+                candidates += [
+                    e
+                    for e in sorted(set(self.holders_of(primary, bid.shuffle_id) or ()))
+                    if e not in candidates
+                ]
+            except (TransportError, OSError):
+                pass
         if self.replica_of is not None:
-            candidates += [e for e in self.replica_of(primary) if e != primary]
+            candidates += [
+                e for e in self.replica_of(primary)
+                if e != primary and e not in candidates
+            ]
         allows = getattr(self.transport, "breaker_allows", None)
         if allows is not None and len(candidates) > 1:
             admitted = [e for e in candidates if allows(e)]

@@ -48,6 +48,23 @@ def ring_neighbors(executor_id, executors: Sequence, factor: int) -> List:
     return out
 
 
+def widened_ring_neighbors(
+    executor_id, executors: Sequence, base_factor: int, hot_factor: int
+) -> Tuple[List, List]:
+    """Ring placement for a popularity-promoted (hot) block's replica set:
+    ``(base, extra)`` where ``base`` is the fault-tolerance floor
+    (``ring_neighbors`` at ``replication.factor``) and ``extra`` the
+    ADDITIONAL successors a hot promotion widens onto
+    (``spark.shuffle.tpu.serve.hotReplicas``, never narrower than the
+    floor).  Derived from membership alone — the same determinism contract
+    as :func:`ring_neighbors`, so the promoting server, its peers, and any
+    reader agree on the widened set without a placement exchange."""
+    base = ring_neighbors(executor_id, executors, base_factor)
+    widened = ring_neighbors(executor_id, executors, max(hot_factor, base_factor))
+    extra = [e for e in widened if e not in base]
+    return base, extra
+
+
 def degraded_plan(num_executors: int, alive: Sequence) -> Tuple[int, List, int]:
     """Deterministic placement of an ``num_executors``-wide exchange onto the
     surviving executors: ``(m, phys, waves)`` where ``m`` is the pow2 floor of

@@ -52,6 +52,7 @@ from sparkucx_tpu.core.definitions import (
     pack_chunk_hdr,
     pack_frame,
     pack_frame_prefix,
+    pack_hot_set,
     pack_member_event,
     pack_replica_ack,
     pack_replica_put,
@@ -61,6 +62,7 @@ from sparkucx_tpu.core.definitions import (
     unpack_chunk_codec_ext,
     unpack_chunk_hdr,
     unpack_frame_header,
+    unpack_hot_set,
     unpack_member_event,
     unpack_replica_ack,
     unpack_replica_put,
@@ -85,7 +87,7 @@ from sparkucx_tpu.core.transport import ExecutorId, ShuffleTransport
 # tier-(a) wire compression policy + page formats; ops.compress keeps its jax
 # imports function-local, so this pulls no accelerator stack into the transport
 from sparkucx_tpu.ops.compress import CompressSpec, encode_chunk
-from sparkucx_tpu.store.hbm_store import HbmBlockStore
+from sparkucx_tpu.store.hbm_store import BlockPopularity, HbmBlockStore
 from sparkucx_tpu.testing import faults
 from sparkucx_tpu.obs.metrics import (
     MetricsRegistry,
@@ -101,7 +103,7 @@ from sparkucx_tpu.utils.checksum import crc32c
 from sparkucx_tpu.utils.pagecodec import CODEC_RAW, CodecError, decode_page
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.stats import StatsAggregator
-from sparkucx_tpu.utils.trace import TRACER
+from sparkucx_tpu.utils.trace import TRACER, instant
 
 logger = get_logger("transport.peer")
 
@@ -132,11 +134,11 @@ SIZE_RESOURCE_EXHAUSTED = -4
 #: length — the knob never changes frame layout when off (golden frames).
 _CRC = struct.Struct("<I")
 _MAX_FRAME = MAX_FRAME_BYTES  # shared frame ceiling (core/definitions.py)
-#: Byte cap on a server's encoded-chunk pool (compress.codec on).  Encoded
-#: pages are typically a fraction of their raw chunks, so this covers on the
-#: order of a GiB of hot raw blocks; past it the pool FIFO-evicts — a cap,
-#: not a correctness boundary (a miss just re-encodes).
-_ENCODED_POOL_CAP = 128 << 20
+#: The encoded-chunk pool's byte cap lives on the conf
+#: (``spark.shuffle.tpu.compress.cacheBytes``, 0 disables the pool).  Encoded
+#: pages are typically a fraction of their raw chunks, so the 128 MiB default
+#: covers on the order of a GiB of hot raw blocks; past the cap the pool
+#: LRU-evicts — a cap, not a correctness boundary (a miss just re-encodes).
 
 
 def apply_wire_sockopts(
@@ -453,10 +455,22 @@ class BlockServer:
         tenants=None,
         executor_id: Optional[int] = None,
         metrics: Optional[MetricsRegistry] = None,
+        popularity: Optional[BlockPopularity] = None,
+        hot_sink: Optional[Callable[[int, bool], None]] = None,
+        hot_set_provider: Optional[Callable[[], Dict[int, List[int]]]] = None,
     ) -> None:
         self.conf = conf or TpuShuffleConf()
         self.store = store
         self.registry_lookup = registry_lookup
+        #: popularity-aware serving tier (serve.hotThresholdFetchesPerSec):
+        #: per-block fetch-rate tracker, the owner's reaction hook for
+        #: promote/demote transitions (the transport widens/narrows the
+        #: replica set there), and the advertisement source HOT_SET_PULL
+        #: replies from.  All None by default — the off path never touches
+        #: the tracker lock.
+        self.popularity = popularity
+        self.hot_sink = hot_sink
+        self.hot_set_provider = hot_set_provider
         #: obs plane: which executor this server serves for (trace-event
         #: attribution in the shared-process loopback mesh) and the metrics
         #: registry METRICS_PULL answers from (None = empty exposition)
@@ -499,6 +513,8 @@ class BlockServer:
             "encoded_chunks": 0,
             "raw_chunks": 0,
             "cache_hits": 0,
+            "cache_misses": 0,
+            "cache_evictions": 0,
         }  #: guarded by self._compress_lock
         self._compress_lock = threading.Lock()
         #: serve-side encoded-chunk pool: sealed blocks are immutable for the
@@ -507,10 +523,14 @@ class BlockServer:
         #: other reducers, credit-window re-issues, retry/failover replays —
         #: serves the cached encoding (or the cached "unprofitable, ship raw"
         #: verdict, so incompressible blocks never re-attempt the encoder).
-        #: Maps (bid, offset, len) -> (codec_id, encoded | None); FIFO-evicted
-        #: once the encoded bytes held exceed _ENCODED_POOL_CAP.
+        #: Maps (bid, offset, len) -> (codec_id, encoded | None); insertion
+        #: order doubles as recency order (hits re-insert at the MRU end), so
+        #: eviction from the front is LRU.  Evicted once the encoded bytes
+        #: held exceed ``compress.cacheBytes`` (0 = pool off, every chunk
+        #: re-encodes).
         self._encoded_pool: Dict[tuple, tuple] = {}  #: guarded by self._compress_lock
         self._encoded_pool_bytes = 0  #: guarded by self._compress_lock
+        self._encoded_pool_cap = self.conf.compress_cache_bytes
         # Serving plane: by default, numListenerThreads accept loops on one
         # listen socket (UcxShuffleConf.scala:73-78; the kernel load-balances
         # accepts) and a thread per accepted connection.  With server.workers
@@ -585,6 +605,33 @@ class BlockServer:
             on_close=lambda c, s=state: self._drop_conn(c, s),
         )
 
+    def _fire_hot_transitions(self, transitions) -> None:
+        """Emit the promote/demote trace instants and hand each shuffle-level
+        transition to the owning transport's hot sink (which widens or
+        narrows the replica advertisement).  Sink errors are contained — a
+        failed widen must never fail the fetch that triggered it."""
+        for sid, hot in transitions:
+            if hot:
+                instant("serve.promote", shuffle_id=sid)
+            else:
+                instant("serve.demote", shuffle_id=sid)
+            if self.hot_sink is not None:
+                try:
+                    self.hot_sink(sid, hot)
+                except Exception:
+                    logger.exception(
+                        "hot-set %s of shuffle %d failed",
+                        "promote" if hot else "demote", sid,
+                    )
+
+    def sweep_popularity(self) -> None:
+        """Cool-down pass (rate-limited inside the tracker): demote blocks
+        whose fetch rate decayed below the hysteresis edge, firing
+        ``serve.demote`` for each shuffle whose last hot block cooled."""
+        pop = self.popularity
+        if pop is not None:
+            self._fire_hot_transitions(pop.maybe_sweep())
+
     def _resolve_one(self, bid: ShuffleBlockId):
         """Resolve to a ``(buffer, offset, length)`` view or None.
 
@@ -595,7 +642,38 @@ class BlockServer:
         this path); only blocks with no mappable view (``memory_view() is
         None``) materialize under the block lock.  Store blocks serve a
         zero-copy view of host staging.  Either way the reply path sends the
-        view without another copy."""
+        view without another copy.
+
+        Popularity tier (serve.hotThresholdFetchesPerSec > 0): every resolve
+        folds into the block's fetch-rate EWMA; a hot block is served from
+        the store's decoded-block cache when pinned there (bypassing the
+        eviction tiers — no restage, no LRU bump below), and admitted to it
+        on the miss that follows promotion."""
+        pop = self.popularity
+        hot = False
+        if pop is not None:
+            hot, transitions = pop.observe(bid.shuffle_id, bid.map_id, bid.reduce_id)
+            if transitions:
+                self._fire_hot_transitions(transitions)
+        if hot and self.store is not None:
+            cached = self.store.serve_cache_get(
+                bid.shuffle_id, bid.map_id, bid.reduce_id
+            )
+            if cached is not None:
+                return cached
+        resolved = self._resolve_one_tiers(bid)
+        if hot and self.store is not None and isinstance(resolved, tuple):
+            staging, off, ln = resolved
+            if ln:
+                flat = np.asarray(staging).reshape(-1).view(np.uint8)
+                self.store.serve_cache_offer(
+                    bid.shuffle_id, bid.map_id, bid.reduce_id,
+                    bytes(flat[off : off + ln]),
+                )
+        return resolved
+
+    def _resolve_one_tiers(self, bid: ShuffleBlockId):
+        """The historical registry -> replica -> staging resolution."""
         if self.registry_lookup is not None:
             blk = self.registry_lookup(bid)
             if blk is not None:
@@ -719,7 +797,9 @@ class BlockServer:
         chunk = group.chunk_bytes
         checksum = self.conf.wire_checksum
         cspec = self._compress
-        raw_total = wire_total = encoded_chunks = raw_chunks = cache_hits = 0
+        pool_cap = self._encoded_pool_cap
+        raw_total = wire_total = encoded_chunks = raw_chunks = 0
+        cache_hits = cache_misses = cache_evictions = 0
         for i, e in enumerate(entries):
             if e is None or isinstance(e, int):
                 sizes.append(SIZE_NOT_FOUND if e is None else e)
@@ -740,28 +820,37 @@ class BlockServer:
                     # chunk offset stays the RAW offset — the client resolves
                     # its scatter destination with decoded coordinates.
                     key = (bids[i], pos, n)
-                    with self._compress_lock:
-                        hit = self._encoded_pool.get(key)
+                    hit = None
+                    if pool_cap > 0:
+                        with self._compress_lock:
+                            hit = self._encoded_pool.pop(key, None)
+                            if hit is not None:
+                                # LRU refresh: re-insert at the MRU end
+                                # (insertion order IS recency order)
+                                self._encoded_pool[key] = hit
                     if hit is not None:
                         cid, enc = hit
                         cache_hits += 1
                     else:
+                        cache_misses += 1
                         # encode OUTSIDE the lock: a concurrent reply racing
                         # on the same chunk just produces the same bytes
                         cid, enc = encode_chunk(cspec, wire)
                         cost = len(enc) if enc is not None else 0
-                        with self._compress_lock:
-                            while (
-                                self._encoded_pool_bytes + cost > _ENCODED_POOL_CAP
-                                and self._encoded_pool
-                            ):
-                                oldest = next(iter(self._encoded_pool))
-                                _, old = self._encoded_pool.pop(oldest)
-                                if old is not None:
-                                    self._encoded_pool_bytes -= len(old)
-                            if key not in self._encoded_pool:
-                                self._encoded_pool[key] = (cid, enc)
-                                self._encoded_pool_bytes += cost
+                        if pool_cap > 0:
+                            with self._compress_lock:
+                                while (
+                                    self._encoded_pool_bytes + cost > pool_cap
+                                    and self._encoded_pool
+                                ):
+                                    oldest = next(iter(self._encoded_pool))
+                                    _, old = self._encoded_pool.pop(oldest)
+                                    cache_evictions += 1
+                                    if old is not None:
+                                        self._encoded_pool_bytes -= len(old)
+                                if key not in self._encoded_pool:
+                                    self._encoded_pool[key] = (cid, enc)
+                                    self._encoded_pool_bytes += cost
                     if enc is not None:
                         wire = enc
                         encoded_chunks += 1
@@ -793,6 +882,8 @@ class BlockServer:
                 self.compress_stats["encoded_chunks"] += encoded_chunks
                 self.compress_stats["raw_chunks"] += raw_chunks
                 self.compress_stats["cache_hits"] += cache_hits
+                self.compress_stats["cache_misses"] += cache_misses
+                self.compress_stats["cache_evictions"] += cache_evictions
         blob = b"".join(_SIZE.pack(s) for s in sizes)
         manifest = pack_frame(
             AmId.FETCH_BLOCK_REQ_ACK, _TAG.pack(tag) + _COUNT.pack(len(sizes)) + blob, b""
@@ -866,6 +957,9 @@ class BlockServer:
     def _serve_fetch_req_inner(
         self, conn: socket.socket, state: _ConnState, header: bytes
     ) -> None:
+        # popularity cool-down piggybacks on serve traffic (rate-limited
+        # inside the tracker); explicit sweeps remain available to owners
+        self.sweep_popularity()
         tag, bids = unpack_batch_fetch_req(header)
         app_id = unpack_fetch_req_app_id(header, len(bids))
         gate = None
@@ -1079,6 +1173,18 @@ class BlockServer:
             text = self.metrics.prometheus_text() if self.metrics is not None else ""
             with send_lock:
                 conn.sendall(pack_frame(AmId.METRICS_PULL, _TAG.pack(tag), text.encode()))
+        elif am_id == AmId.HOT_SET_PULL:
+            # popularity plane: hand the puller this executor's advertised
+            # hot-set table — {shuffle: [holder ids]} for every shuffle whose
+            # replica set is currently widened.  Readers rotate their fetches
+            # across the holders.  Empty table when nothing is hot (or the
+            # popularity tier is off) — a valid, cheap reply.
+            (tag,) = _TAG.unpack_from(header)
+            hot = self.hot_set_provider() if self.hot_set_provider is not None else {}
+            with send_lock:
+                conn.sendall(
+                    pack_frame(AmId.HOT_SET_PULL, _TAG.pack(tag), pack_hot_set(hot))
+                )
         elif am_id == AmId.INIT_EXECUTOR_REQ:
             (eid,) = _TAG.unpack_from(header)
             self.handshaken[eid] = body
@@ -1623,7 +1729,10 @@ class PeerTransport(ShuffleTransport):
         #: outstanding acks per shuffle broken down by successor executor —
         #: lets replication_wait name WHICH neighbor stalled, not just that one did
         self._replica_unacked: Dict[int, Dict[ExecutorId, int]] = {}  #: guarded by self._tag_lock
-        #: sealed shuffles awaiting the replicator worker, oldest first
+        #: replication jobs awaiting the replicator worker, oldest first —
+        #: ``(shuffle_id, neighbors | None)`` tuples; None = the ring's
+        #: ``replication.factor`` successors (seal-time push), an explicit
+        #: list = a popularity widen job pushing to the extra holders only
         self._replica_queue: deque = deque()  #: guarded by self._tag_lock
         self._replica_worker: Optional[threading.Thread] = None  #: guarded by self._tag_lock
         self._replica_run = True  #: guarded by self._tag_lock (close() clears)
@@ -1643,6 +1752,18 @@ class PeerTransport(ShuffleTransport):
         #: driver / loopback harness); peer-observed wire failures and rejoin
         #: announcements feed it.  None = membership-unaware (the default).
         self.membership = None
+        #: Popularity-aware serving tier (serve.hotThresholdFetchesPerSec):
+        #: the per-block fetch-rate tracker the block server observes into
+        #: (None = tier off, zero overhead), the advertised holder sets of
+        #: currently-hot shuffles (served to readers via HOT_SET_PULL), and
+        #: the reader-side TTL cache of peers' advertisements.
+        self.popularity: Optional[BlockPopularity] = (
+            BlockPopularity(self.conf.serve_hot_threshold_fetches_per_sec)
+            if self.conf.serve_hot_threshold_fetches_per_sec > 0
+            else None
+        )
+        self._hot_shuffles: Dict[int, List[ExecutorId]] = {}  #: guarded by self._tag_lock
+        self._hot_holders_cache: Dict[ExecutorId, Tuple[float, Dict[int, List[int]]]] = {}  #: guarded by self._tag_lock
         #: Gray-failure plane: per-executor health scores + circuit breakers.
         #: Scoring (latency/error EWMAs) is always on — pure bookkeeping, no
         #: behavior change; the breaker only trips when
@@ -1979,6 +2100,9 @@ class PeerTransport(ShuffleTransport):
         self.metrics.register(
             "health", counter_dict_provider("health", self._health_view)
         )
+        self.metrics.register(
+            "serve", counter_dict_provider("serve", self._serve_view)
+        )
         self.metrics.register("obs", tracer_provider(TRACER))
 
     def _elastic_view(self) -> Dict[str, int]:
@@ -2006,6 +2130,21 @@ class PeerTransport(ShuffleTransport):
         srv = self.server
         reactor = getattr(srv, "_reactor", None) if srv is not None else None
         return reactor.stats() if reactor is not None else {}
+
+    def _serve_view(self) -> Dict[str, int]:
+        """``serve`` metrics family: popularity-tracker counters, serve-cache
+        counters, and the live widened-advertisement gauge.  Empty when the
+        tier is fully off."""
+        out: Dict[str, int] = {}
+        if self.popularity is not None:
+            out.update(self.popularity.snapshot())
+        cache = getattr(self.store, "serve_cache", None)
+        if cache is not None:
+            out.update(cache.snapshot())
+        if self.popularity is not None:
+            with self._tag_lock:
+                out["advertised_hot_shuffles"] = len(self._hot_shuffles)
+        return out
 
     def _pull(self, executor_id: ExecutorId, am_id: AmId, timeout: float = 5.0) -> bytes:
         """Blocking pull RPC on the peer plane (TRACE_PULL / METRICS_PULL):
@@ -2047,6 +2186,39 @@ class PeerTransport(ShuffleTransport):
             errors="replace"
         )
 
+    def _hot_set_view(self) -> Dict[int, List[int]]:
+        """Block-server provider: snapshot of this executor's advertised
+        hot-set table for HOT_SET_PULL replies."""
+        with self._tag_lock:
+            return {sid: list(h) for sid, h in self._hot_shuffles.items()}
+
+    #: reader-side advertisement freshness: one HOT_SET_PULL round-trip per
+    #: primary at most every TTL, amortized over every fetch in between
+    _HOT_SET_TTL_S = 0.25
+
+    def hot_holders(self, executor_id: ExecutorId, shuffle_id: int) -> List[ExecutorId]:
+        """Current holder set the primary advertises for a hot shuffle, or
+        ``[]`` when nothing is advertised (cold shuffle / tier off).  Served
+        from a short TTL cache so readers learn widened sets without a
+        round-trip per fetch; pull failures are non-fatal (an empty table is
+        cached, and the reader just keeps fetching from the primary)."""
+        if self.conf.serve_hot_threshold_fetches_per_sec <= 0:
+            return []
+        now = time.monotonic()
+        with self._tag_lock:
+            cached = self._hot_holders_cache.get(executor_id)
+        if cached is not None and now - cached[0] < self._HOT_SET_TTL_S:
+            return list(cached[1].get(shuffle_id, []))
+        try:
+            table = unpack_hot_set(
+                self._pull(executor_id, AmId.HOT_SET_PULL, timeout=1.0)
+            )
+        except (TransportError, OSError, struct.error):
+            table = {}
+        with self._tag_lock:
+            self._hot_holders_cache[executor_id] = (now, table)
+        return list(table.get(shuffle_id, []))
+
     def wait_for_activity(self, timeout: float = 0.01) -> None:
         """Park until a recv thread posts an ack (or timeout) — the wakeup-mode
         progress contract (GlobalWorkerRpcThread.scala:46-58).  No-op when
@@ -2065,6 +2237,8 @@ class PeerTransport(ShuffleTransport):
             host=host, port=port, member_sink=self._on_member_event,
             tenants=getattr(self.store, "tenants", None),
             executor_id=self.executor_id, metrics=self.metrics,
+            popularity=self.popularity, hot_sink=self._on_hot_transition,
+            hot_set_provider=self._hot_set_view,
         )
         if self.conf.obs_metrics_port > 0:
             try:
@@ -2523,9 +2697,10 @@ class PeerTransport(ShuffleTransport):
                 # attributes the ack to its successor for replication_wait
                 self._replica_acked(sid, executor_id=from_executor)
             return
-        if am_id in (AmId.TRACE_PULL, AmId.METRICS_PULL):
-            # pull-RPC reply (obs plane): tag echo in the header, JSON event
-            # buffer / Prometheus text in the body
+        if am_id in (AmId.TRACE_PULL, AmId.METRICS_PULL, AmId.HOT_SET_PULL):
+            # pull-RPC reply (obs / popularity plane): tag echo in the header,
+            # JSON event buffer / Prometheus text / packed hot-set table in
+            # the body
             if len(header) < _TAG.size:
                 return
             (tag,) = _TAG.unpack_from(header, 0)
@@ -2728,7 +2903,7 @@ class PeerTransport(ShuffleTransport):
                 and self.replica_stats["replica_backlog_bytes"] > cap
                 and self._replica_queue
             ):
-                dropped = self._replica_queue.popleft()
+                dropped, _ = self._replica_queue.popleft()
                 self._replica_pushing.discard(dropped)
                 try:
                     self.replica_stats["dropped_rounds"] += self.store.num_rounds(dropped)
@@ -2738,18 +2913,60 @@ class PeerTransport(ShuffleTransport):
                     "replica backlog over %d B: dropped queued shuffle %d",
                     cap, dropped,
                 )
-            self._replica_pushing.add(shuffle_id)
-            self._replica_queue.append(shuffle_id)
-            worker = self._replica_worker
-            if worker is None or not worker.is_alive():
-                worker = threading.Thread(
-                    target=self._replica_loop,
-                    daemon=True,
-                    name=f"replicator-{self.executor_id}",
-                )
-                self._replica_worker = worker
-                worker.start()
+            self._enqueue_replica_job_locked(shuffle_id, None)
         self._replica_wake.set()
+
+    def _enqueue_replica_job_locked(
+        self, shuffle_id: int, neighbors: Optional[List[ExecutorId]]
+    ) -> None:
+        """Queue one replication job (caller holds ``_tag_lock``; caller sets
+        ``_replica_wake`` after releasing it).  ``neighbors=None`` = the ring
+        successors resolved at push time; a list = a popularity widen job."""
+        self._replica_pushing.add(shuffle_id)
+        self._replica_queue.append((shuffle_id, neighbors))
+        worker = self._replica_worker
+        if worker is None or not worker.is_alive():
+            worker = threading.Thread(
+                target=self._replica_loop,
+                daemon=True,
+                name=f"replicator-{self.executor_id}",
+            )
+            self._replica_worker = worker
+            worker.start()
+
+    def _on_hot_transition(self, shuffle_id: int, hot: bool) -> None:
+        """Block-server hot sink (runs on a serve thread, must stay cheap).
+
+        Promote: widen the shuffle's replica set to ``serve.hotReplicas``
+        ring successors by queuing a push to the holders BEYOND the seal-time
+        ``replication.factor`` set (those already hold the rounds), and
+        advertise the full holder list through HOT_SET_PULL so readers
+        spread their fetches.  Demote: drop the advertisement — readers fall
+        back to the primary; the pushed copies stay (never below the
+        fault-tolerance floor, and a re-promotion reuses them for free)."""
+        if not hot:
+            with self._tag_lock:
+                self._hot_shuffles.pop(shuffle_id, None)
+            return
+        from sparkucx_tpu.shuffle.resolver import widened_ring_neighbors
+
+        with self._conn_lock:
+            peers = list(self._conn_addrs)
+        members = [self.executor_id] + peers
+        base, extra = widened_ring_neighbors(
+            self.executor_id,
+            members,
+            self.conf.replication_factor,
+            self.conf.serve_hot_replicas,
+        )
+        with self._tag_lock:
+            self._hot_shuffles[shuffle_id] = sorted(
+                {self.executor_id, *base, *extra}
+            )
+            if extra:
+                self._enqueue_replica_job_locked(shuffle_id, extra)
+        if extra:
+            self._replica_wake.set()
 
     def _replica_loop(self) -> None:
         """Single replicator worker: drains the seal queue one shuffle at a
@@ -2758,8 +2975,8 @@ class PeerTransport(ShuffleTransport):
             with self._tag_lock:
                 if not self._replica_run:
                     return
-                shuffle_id = self._replica_queue.popleft() if self._replica_queue else None
-            if shuffle_id is None:
+                job = self._replica_queue.popleft() if self._replica_queue else None
+            if job is None:
                 if not self._replica_wake.wait(timeout=0.2):
                     with self._tag_lock:
                         # idle and nothing queued: retire; the next seal respawns
@@ -2768,12 +2985,18 @@ class PeerTransport(ShuffleTransport):
                             return
                 self._replica_wake.clear()
                 continue
-            self._replicate_push(shuffle_id)
+            self._replicate_push(*job)
 
-    def _replicate_push(self, shuffle_id: int) -> None:
+    def _replicate_push(
+        self, shuffle_id: int, neighbors: Optional[List[ExecutorId]] = None
+    ) -> None:
+        """Push one shuffle's sealed rounds to ``neighbors`` (None = the
+        ring's ``replication.factor`` successors; an explicit list = a
+        popularity widen job targeting only the extra holders)."""
         try:
             faults.check("replica.push", shuffle_id=shuffle_id, executor=self.executor_id)
-            neighbors = self.replication_neighbors()
+            if neighbors is None:
+                neighbors = self.replication_neighbors()
             rounds = self.store.replica_source(shuffle_id) if neighbors else []
             round_bytes = sum(len(body) for _, _, body in rounds)
             with self._tag_lock:
@@ -2842,7 +3065,11 @@ class PeerTransport(ShuffleTransport):
             logger.exception("replicator for shuffle %d died", shuffle_id)
         finally:
             with self._tag_lock:
-                self._replica_pushing.discard(shuffle_id)
+                # a widen job can queue behind the seal push for the same
+                # shuffle: the pushing flag (replication_wait's gate) must
+                # survive until the LAST queued job for the shuffle drains
+                if all(s != shuffle_id for s, _ in self._replica_queue):
+                    self._replica_pushing.discard(shuffle_id)
             self._activity.set()
 
     def _replica_acked(

@@ -338,11 +338,10 @@ class TestEncodedPool:
             a.close()
             b.close()
 
-    def test_fifo_eviction_under_tiny_cap(self, monkeypatch):
-        from sparkucx_tpu.transport import peer as peer_mod
-
-        monkeypatch.setattr(peer_mod, "_ENCODED_POOL_CAP", 1)
-        a, b = _pair(wire_compress_codec="rle")
+    def test_lru_eviction_under_tiny_cap(self):
+        # spark.shuffle.tpu.compress.cacheBytes caps the pool; 1 byte forces
+        # an eviction on every insertion
+        a, b = _pair(wire_compress_codec="rle", compress_cache_bytes=1)
         try:
             bids = [ShuffleBlockId(0, i, 0) for i in range(3)]
             payloads = [bytes([i]) * (32 << 10) for i in range(3)]

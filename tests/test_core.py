@@ -191,9 +191,10 @@ class TestWireFrames:
         # (FetchBlockChunk / WireHello, docs/SHIM_PROTOCOL.md), 7-8:
         # replication extensions (ReplicaPut / ReplicaAck), 9-10: membership
         # gossip (MemberSuspect / MemberRejoin), 11-12: observability pulls
-        # (TracePull / MetricsPull), 13: accept-backlog shed (ServerBusy) —
-        # peer plane only, never emitted at wire.streams=1 /
-        # replication.factor=0 / elastic off / server.acceptBacklog=0 with no
+        # (TracePull / MetricsPull), 13: accept-backlog shed (ServerBusy),
+        # 14: hot-holder advertisement (HotSetPull) — peer plane only, never
+        # emitted at wire.streams=1 / replication.factor=0 / elastic off /
+        # server.acceptBacklog=0 / serve.hotThresholdFetchesPerSec=0 with no
         # export/scrape call, so reference parity holds for every frame a
         # stock deployment sees.
         #
@@ -208,12 +209,13 @@ class TestWireFrames:
 
         extracted = extract_am_ids(inspect.getsource(definitions))
         assert extracted == {a.name: int(a) for a in AmId}
-        assert sorted(extracted.values()) == list(range(14))
+        assert sorted(extracted.values()) == list(range(15))
         assert AmId.FETCH_BLOCK_CHUNK == 5 and AmId.WIRE_HELLO == 6
         assert AmId.REPLICA_PUT == 7 and AmId.REPLICA_ACK == 8
         assert AmId.MEMBER_SUSPECT == 9 and AmId.MEMBER_REJOIN == 10
         assert AmId.TRACE_PULL == 11 and AmId.METRICS_PULL == 12
         assert AmId.SERVER_BUSY == 13
+        assert AmId.HOT_SET_PULL == 14
 
 
 class TestConf:
