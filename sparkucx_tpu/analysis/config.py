@@ -348,6 +348,16 @@ OFF_PATH_DEFAULTS = {
     "serve_hot_replicas": 4,
     "serve_cache_bytes": 0,
     "compress_cache_bytes": 128 << 20,
+    # serve.holdersTtlMs is only consulted while serve.hotThresholdFetchesPerSec
+    # is on (itself pinned 0.0 above); its default preserves the historical
+    # hard-coded 250 ms advertisement TTL byte-for-byte.  The query-runner
+    # knobs gate the lineage cache (sparkucx_tpu/query): off = every exchange
+    # executes and is unregistered after its query, so wire/store behavior is
+    # byte-identical to a cache-less runner; cacheMaxBytes is inert while the
+    # cache is off.
+    "serve_holders_ttl_ms": 250,
+    "query_cache_enabled": False,
+    "query_cache_max_bytes": 0,
 }
 
 # ----------------------------------------------------------------------

@@ -244,6 +244,31 @@ class TpuShuffleConf:
     #: ``compress.codec`` is on; the default preserves the historical 128 MiB
     #: pool.
     compress_cache_bytes: int = 128 << 20
+    #: Freshness TTL (ms) of the reader-side hot-holder advertisement cache:
+    #: ``hot_holders`` answers from its last ``HOT_SET_PULL`` for this long
+    #: before re-pulling, amortizing one round-trip per primary over every
+    #: fetch in between.  Only consulted while
+    #: ``serve.hotThresholdFetchesPerSec`` is on; the default preserves the
+    #: historical hard-coded 250 ms.
+    serve_holders_ttl_ms: int = 250
+
+    # query DAG runner (sparkucx_tpu/query) — cross-query shuffle reuse
+    #: Lineage cache master switch: when on, the QueryRunner keys every
+    #: sealed exchange by its lineage hash (input fingerprint + canonical
+    #: sub-DAG + byte-affecting conf tiers) and keeps the exchanged shuffle
+    #: registered so a repeated sub-DAG serves from the store/eviction/serve
+    #: tiers instead of re-executing.  Off (default) = every exchange runs
+    #: and is unregistered after the query, byte-identical to a cache-less
+    #: runner.
+    query_cache_enabled: bool = False
+    #: Byte budget for lineage-cached shuffles (sum of exchanged payload
+    #: bytes kept resident across queries).  0 = no runner-level cap: cached
+    #: rounds are bounded only by the owning tenant's HBM quota (admission
+    #: still charges the tenant).  Over-budget admissions evict cached
+    #: entries largest-footprint-first, keeping the smallest-footprint
+    #: entries resident (arXiv:2112.01075's cost model applied to the
+    #: keep/recompute decision).
+    query_cache_max_bytes: int = 0
 
     # staged store (HBM; NVKV analogue).  512 = one exchange row (128 int32
     # lanes, the native XLA:TPU tile width) and exactly NVKV's sector alignment
@@ -531,7 +556,10 @@ class TpuShuffleConf:
             ("serve.hotThresholdFetchesPerSec", "serve_hot_threshold_fetches_per_sec", float),
             ("serve.hotReplicas", "serve_hot_replicas", int),
             ("serve.cacheBytes", "serve_cache_bytes", parse_size),
+            ("serve.holdersTtlMs", "serve_holders_ttl_ms", int),
             ("compress.cacheBytes", "compress_cache_bytes", parse_size),
+            ("query.cacheEnabled", "query_cache_enabled", lambda v: str(v).lower() == "true"),
+            ("query.cacheMaxBytes", "query_cache_max_bytes", parse_size),
             ("store.softWatermark", "store_soft_watermark", parse_size),
             ("store.hardWatermark", "store_hard_watermark", parse_size),
             ("server.acceptBacklog", "server_accept_backlog", int),
@@ -670,6 +698,12 @@ class TpuShuffleConf:
             raise ValueError("serve_cache_bytes must be >= 0 (0 = no serve-side cache)")
         if self.compress_cache_bytes < 0:
             raise ValueError("compress_cache_bytes must be >= 0 (0 = no encoded-chunk pool)")
+        if self.serve_holders_ttl_ms < 0:
+            raise ValueError(
+                "serve_holders_ttl_ms must be >= 0 (0 = re-pull the holder set every fetch)"
+            )
+        if self.query_cache_max_bytes < 0:
+            raise ValueError("query_cache_max_bytes must be >= 0 (0 = tenant quotas only)")
         if self.store_soft_watermark < 0:
             raise ValueError("store_soft_watermark must be >= 0 (0 = no soft watermark)")
         if self.store_hard_watermark < 0:

@@ -482,3 +482,52 @@ def make_planner(conf):
     if getattr(conf, "planner_mode", "static") == "adaptive":
         return AdaptivePlanner(conf)
     return StaticPlanner(conf)
+
+
+# ----------------------------------------------------------------------
+# Lineage hashing (query/ cross-query shuffle reuse)
+#
+# The lineage cache (sparkucx_tpu/query/lineage.py) keys a sealed shuffle by
+# input fingerprint + canonical plan serialization + the conf tiers that
+# affect the exchanged BYTES.  The helpers live here because this module owns
+# the plan vocabulary: which ExchangePlan fields shape result bytes and which
+# are serve-plane overlap/transport tuning is exactly the COLLECTIVE vs
+# SERVE_PLANE split the lockstep-taint pass pins (analysis/config.py), and
+# keeping the serializer next to the planners means a new plan field fails
+# the lineage property tests (tests/test_query.py) before it can silently
+# ride — or silently skip — a cache key.
+
+
+def canonical_plan(plan: ExchangePlan, fields: Optional[Sequence[str]] = None) -> str:
+    """Deterministic serialization of a plan (sorted keys, no whitespace).
+
+    ``fields`` restricts the view — the lineage cache passes the
+    byte-affecting field set so two plans differing only in serve-plane
+    tuning (hedge delay, stripe width, overlap depth) canonicalize
+    identically, while any collective-schedule or lossy-tier difference
+    yields distinct bytes."""
+    import json
+
+    d = plan.describe()
+    if fields is not None:
+        keep = set(fields)
+        d = {k: v for k, v in d.items() if k in keep}
+    return json.dumps(d, sort_keys=True, separators=(",", ":"))
+
+
+def lineage_hash(*parts: str) -> str:
+    """SHA-256 over length-prefixed parts — the lineage key combinator.
+
+    Length-prefixing keeps the encoding injective (``("ab", "c")`` and
+    ``("a", "bc")`` hash differently), so dag canonicalizations, input
+    fingerprints, and conf signatures can be folded in any fixed order
+    without delimiter collisions."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for part in parts:
+        data = part.encode()
+        h.update(str(len(data)).encode())
+        h.update(b":")
+        h.update(data)
+    return h.hexdigest()

@@ -148,7 +148,7 @@ def _parse_args(argv):
             "server", "client", "superstep", "pipeline", "gather", "sort",
             "columnar", "groupby", "join", "write", "skew", "adaptive", "wire",
             "ici", "combine", "failover", "elastic", "compress", "tenants",
-            "obs", "gray", "fanin",
+            "obs", "gray", "fanin", "queries",
         ],
     )
     p.add_argument("-a", "--address", default="127.0.0.1:13337", help="server host:port")
@@ -1866,6 +1866,174 @@ def run_tenants(args) -> None:
         print(f"tenants   {app}: {gbps:.3f} GB/s, hbm used {used} B", flush=True)
 
 
+def measure_queries(
+    num_apps: int = 4,
+    queries_per_app: int = 5,
+    rows_per_query: int = 2000,
+    keys: int = 64,
+    report=None,
+) -> dict:
+    """Measurement core of the ``queries`` mode — M concurrent tenant DAGs
+    with repeated sub-DAGs through the query runner (sparkucx_tpu/query).
+
+    Each of ``num_apps`` tenants drives ``queries_per_app`` repetitions of a
+    GroupByTest-shaped DAG (scan -> hash exchange -> grouped aggregate) over
+    its own input, one thread per tenant, twice: a COLD pass on a cache-less
+    manager (every exchange executes — the baseline a cache-less runner
+    pays) and a CACHED pass with ``query.cacheEnabled`` on a shared
+    LineageCache, where every repeat after the first serves the sealed
+    shuffle straight from the store tiers and skips the exchange entirely.
+    Asserts every cached-hit result bit-identical to the cold pass off the
+    clock.  Returns cold/warm queries-per-second, the measured hit rate,
+    p50/p99 per-stage latency for both passes, and the tenant usage
+    snapshot.  ``report(phase, app_idx, seconds, queries)`` per tenant
+    drain.  Shared by the CLI and bench.py."""
+    import jax
+
+    from sparkucx_tpu.query import LineageCache, QueryRunner, Stage, StageDag
+    from sparkucx_tpu.service.tenants import TenantRegistry
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+    num_executors = max(1, min(4, jax.device_count()))
+    dag = StageDag(
+        [
+            Stage.make("src", "scan"),
+            Stage.make("ex", "exchange", ["src"]),
+            Stage.make("agg", "aggregate", ["ex"]),
+        ]
+    )
+    apps = [f"app-{i:03d}" for i in range(num_apps)]
+    rng = np.random.default_rng(7)
+    inputs = {
+        app: [
+            (int(k), int(v))
+            for k, v in zip(
+                rng.integers(0, keys, rows_per_query),
+                rng.integers(0, 1 << 20, rows_per_query),
+            )
+        ]
+        for app in apps
+    }
+
+    def _conf(cache_on: bool) -> TpuShuffleConf:
+        return TpuShuffleConf(
+            staging_capacity_per_executor=8 << 20,
+            num_executors=num_executors,
+            query_cache_enabled=cache_on,
+        )
+
+    def _pass(cache_on: bool, phase: str):
+        mgr = TpuShuffleManager(_conf(cache_on), num_executors=num_executors)
+        registry = TenantRegistry()
+        cache = LineageCache() if cache_on else None
+        try:
+            stage_ms: List[float] = []
+            stage_lock = threading.Lock()
+            results: dict = {}
+            runners = {}
+            for app in apps:
+                r = QueryRunner(mgr, app, tenants=registry, cache=cache)
+
+                def observe(name, op, ms):
+                    with stage_lock:
+                        stage_ms.append(ms)
+
+                r.on_stage = observe
+                runners[app] = r
+            # warmup: compile the exchange path once, off the clock
+            runners[apps[0]].run(dag, {"src": inputs[apps[0]]})
+
+            def drain(app):
+                t0 = time.perf_counter()
+                outs = [
+                    runners[app].run(dag, {"src": inputs[app]})
+                    for _ in range(queries_per_app)
+                ]
+                dt = time.perf_counter() - t0
+                results[app] = (outs, dt)
+                if report is not None:
+                    report(phase, app, dt, queries_per_app)
+
+            threads = [threading.Thread(target=drain, args=(app,)) for app in apps]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            qps = num_apps * queries_per_app / wall
+            lat = np.sort(np.asarray(stage_ms))
+            p50 = float(lat[len(lat) // 2])
+            p99 = float(lat[min(len(lat) - 1, int(0.99 * len(lat)))])
+            hits = misses = 0
+            if cache is not None:
+                snap = cache.snapshot()
+                hits, misses = snap["cache_hits"], snap["cache_misses"]
+            return {
+                "qps": qps,
+                "p50_stage_ms": p50,
+                "p99_stage_ms": p99,
+                "hits": hits,
+                "misses": misses,
+                "results": {app: results[app][0] for app in apps},
+                "tenant_stats": registry.stats(),
+            }
+        finally:
+            mgr.stop()
+
+    cold = _pass(False, "cold")
+    warm = _pass(True, "cached")
+    for app in apps:
+        # every cached-hit result bit-identical to cold execution
+        assert warm["results"][app] == cold["results"][app], f"{app} result drift"
+    total = warm["hits"] + warm["misses"]
+    return {
+        "apps": num_apps,
+        "queries_per_app": queries_per_app,
+        "executors": num_executors,
+        "cold_qps": cold["qps"],
+        "warm_qps": warm["qps"],
+        "speedup": warm["qps"] / max(cold["qps"], 1e-12),
+        "hit_rate": warm["hits"] / max(total, 1),
+        "cold_p99_stage_ms": cold["p99_stage_ms"],
+        "p50_stage_ms": warm["p50_stage_ms"],
+        "p99_stage_ms": warm["p99_stage_ms"],
+        "tenant_stats": warm["tenant_stats"],
+        "bit_identical": True,
+    }
+
+
+def run_queries(args) -> None:
+    def report(phase, app, dt, n):
+        print(
+            f"{phase} {app}: {n} queries in {dt*1e3:.1f} ms "
+            f"= {n / dt:.1f} q/s",
+            flush=True,
+        )
+
+    r = measure_queries(
+        num_apps=args.apps,
+        queries_per_app=args.iterations,
+        rows_per_query=args.keys * 32,
+        keys=args.keys,
+        report=report,
+    )
+    print(
+        f"queries: {r['apps']} apps x {r['queries_per_app']} queries, "
+        f"cold {r['cold_qps']:.1f} q/s -> cached {r['warm_qps']:.1f} q/s "
+        f"({r['speedup']:.2f}x at {r['hit_rate']:.0%} hit rate), "
+        f"p99 stage {r['cold_p99_stage_ms']:.2f} -> {r['p99_stage_ms']:.2f} ms, "
+        f"hit results bit-identical",
+        flush=True,
+    )
+    for app, st in sorted(r["tenant_stats"].items()):
+        print(
+            f"queries   {app}: hbm charged {st['used_bytes']} B "
+            f"(cached rounds stay on the tenant's quota)",
+            flush=True,
+        )
+
+
 def run_fanin(args) -> None:
     size = parse_size(args.block_size)
     readers = args.threads if args.threads > 1 else 8
@@ -3507,6 +3675,8 @@ def main(argv=None) -> None:
         run_tenants(args)
     elif args.mode == "fanin":
         run_fanin(args)
+    elif args.mode == "queries":
+        run_queries(args)
     elif args.mode == "elastic":
         run_elastic(args)
     elif args.mode == "obs":

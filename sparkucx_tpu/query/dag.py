@@ -1,0 +1,125 @@
+"""StageDag — the minimal multi-stage query plan the runner executes.
+
+A query is a DAG of named stages over (key, value) record streams:
+
+* ``scan``      — a named input; rows are supplied at run time.
+* ``exchange``  — hash-partition the upstream rows across the cluster through
+  one real shuffle (register / write / collective superstep / windowed read).
+  The only distributed stage, and the only cacheable one: its sealed output
+  is what the lineage cache (query/lineage.py) can serve on a repeat.
+* ``aggregate`` — per-partition grouped aggregation (``aggs`` param, default
+  ``("sum",)``) over an exchange output; hash partitioning already co-located
+  equal keys, so per-partition results are exact.
+* ``join``      — per-partition equi-join of two inputs partitioned by the
+  SAME hash exchange (build side first).
+* ``sort``      — total order over the concatenated upstream rows (the
+  TeraSort tail).
+
+The canonical serialization below is the identity half of the lineage key:
+two queries whose sub-DAGs rooted at an exchange canonicalize identically —
+same structure, same params, same scan fingerprints — will shuffle identical
+bytes (stage compute is deterministic), so the sealed shuffle of one can be
+served to the other.  Determinism of the serialization (sorted keys, sorted
+params, no whitespace) is load-bearing: it feeds a hash.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Tuple
+
+STAGE_OPS = ("scan", "exchange", "aggregate", "join", "sort")
+
+#: inputs arity per op (None = any >= 1)
+_ARITY = {"scan": 0, "exchange": 1, "aggregate": 1, "join": 2, "sort": 1}
+
+
+@dataclass(frozen=True)
+class Stage:
+    """One DAG node.  ``params`` is a sorted tuple of (key, value) pairs so
+    stages hash/compare structurally and serialize deterministically."""
+
+    name: str
+    op: str
+    inputs: Tuple[str, ...] = ()
+    params: Tuple[Tuple[str, object], ...] = ()
+
+    @staticmethod
+    def make(name: str, op: str, inputs=(), **params) -> "Stage":
+        return Stage(
+            name=name,
+            op=op,
+            inputs=tuple(inputs),
+            params=tuple(sorted(params.items())),
+        )
+
+    def param(self, key: str, default=None):
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+
+class StageDag:
+    """Validated, ordered stage list (stages may only reference earlier
+    stages, so list order is already a topological order)."""
+
+    def __init__(self, stages: List[Stage]) -> None:
+        if not stages:
+            raise ValueError("empty dag")
+        self.stages: Tuple[Stage, ...] = tuple(stages)
+        self.by_name: Dict[str, Stage] = {}
+        for st in self.stages:
+            if st.op not in STAGE_OPS:
+                raise ValueError(f"stage {st.name!r}: unknown op {st.op!r}")
+            if st.name in self.by_name:
+                raise ValueError(f"duplicate stage name {st.name!r}")
+            arity = _ARITY[st.op]
+            if arity is not None and len(st.inputs) != arity:
+                raise ValueError(
+                    f"stage {st.name!r}: op {st.op!r} takes {arity} input(s), got {len(st.inputs)}"
+                )
+            for dep in st.inputs:
+                if dep not in self.by_name:
+                    raise ValueError(
+                        f"stage {st.name!r}: input {dep!r} undefined (or defined later)"
+                    )
+            self.by_name[st.name] = st
+
+    @property
+    def sink(self) -> Stage:
+        return self.stages[-1]
+
+    def subdag(self, root: str) -> List[Stage]:
+        """The stages reachable from ``root`` (root last), in dag order."""
+        st = self.by_name.get(root)
+        if st is None:
+            raise KeyError(f"unknown stage {root!r}")
+        keep = {root}
+        for s in reversed(self.stages):
+            if s.name in keep:
+                keep.update(s.inputs)
+        return [s for s in self.stages if s.name in keep]
+
+    def canonical(self, root: str, fingerprints: Optional[Mapping[str, str]] = None) -> str:
+        """Deterministic serialization of the sub-DAG rooted at ``root``.
+
+        ``fingerprints`` maps scan-stage names to content hashes of their
+        input rows; with them the string identifies the exchange's BYTES
+        (structure + params + inputs), without them it identifies only the
+        STRUCTURE — the lineage cache uses the latter to spot a repeated
+        query shape whose inputs changed (stale entry, must invalidate)."""
+        fps = fingerprints or {}
+        nodes = []
+        for s in self.subdag(root):
+            node = {
+                "name": s.name,
+                "op": s.op,
+                "inputs": list(s.inputs),
+                "params": [[k, v] for k, v in s.params],
+            }
+            if s.op == "scan" and s.name in fps:
+                node["fingerprint"] = fps[s.name]
+            nodes.append(node)
+        return json.dumps({"root": root, "stages": nodes}, sort_keys=True, separators=(",", ":"))

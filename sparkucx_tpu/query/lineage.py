@@ -1,0 +1,222 @@
+"""Lineage-keyed cross-query shuffle reuse.
+
+At production query volume concurrent queries repeat sub-DAGs, and the
+fastest shuffle is the one never re-executed.  This module keys every sealed
+shuffle by a **lineage hash** —
+
+    sha256( canonical sub-DAG rooted at the exchange   (structure + params
+                                                        + scan fingerprints)
+          , canonical byte-affecting conf/plan tiers )
+
+— so a repeated exchange is served straight from the store/eviction/serve
+tiers instead of re-running the collective.
+
+Which conf tiers enter the key is NOT a judgement call: the analyzer's
+lockstep-taint registries (analysis/config.py) already split every
+``ExchangePlan`` field into COLLECTIVE (SPMD-lockstep schedule) and
+SERVE_PLANE (per-host serving), and the repo-wide bit-identity invariant
+(tests/test_planner.py and friends) pins that pure *schedule* geometry —
+quota, chunking, round order, lowering — never changes result bytes.  What
+remains byte-affecting is exactly the lossy/content tiers: the wire codec,
+the quantization mode/block, and fused receive-side combine.  The three
+tuples below partition the plan vocabulary accordingly, derived from the
+analyzer registries so the two cannot drift (tests/test_query.py pins the
+partition is exact and total).
+
+Entries are admission-controlled — a cached round keeps real HBM resident,
+so it charges the owning tenant's quota like any live shuffle — and
+invalidated on input-fingerprint change or ``remove_shuffle``.  Under quota
+pressure the keep/recompute decision follows the restage cost model of
+"Memory-efficient array redistribution through portable collective
+communication" (arXiv:2112.01075), already used by service/eviction.py:
+recomputing a shuffle costs roughly its footprint in staging traffic, so the
+*largest*-footprint entries are recomputed (evicted) first and the cache
+keeps the many small shuffles whose per-byte reuse value is highest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from sparkucx_tpu.analysis.config import COLLECTIVE_FIELDS, SERVE_PLANE_FIELDS
+from sparkucx_tpu.ops.planner import canonical_plan, lineage_hash
+
+#: Plan fields that change the exchanged BYTES: the lossy/content tiers.
+#: Everything else is schedule geometry or serve-plane tuning (see module
+#: docstring); tests/test_query.py cross-checks this partition against the
+#: analyzer's COLLECTIVE/SERVE_PLANE registries.
+BYTE_AFFECTING_PLAN_FIELDS = ("codec", "combine", "quantize_block", "quantize_mode")
+
+#: Collective-schedule fields pinned bit-identical by the plan executor's
+#: invariant: they shape WHEN/HOW bytes move, never the bytes.
+SCHEDULE_ONLY_PLAN_FIELDS = tuple(
+    f for f in COLLECTIVE_FIELDS if f not in BYTE_AFFECTING_PLAN_FIELDS
+)
+
+#: Serve-plane tuning that never enters a collective or the payload.
+SERVE_ONLY_PLAN_FIELDS = tuple(
+    f for f in SERVE_PLANE_FIELDS if f not in BYTE_AFFECTING_PLAN_FIELDS
+)
+
+
+def conf_byte_signature(conf) -> str:
+    """Canonical serialization of the conf tiers that affect shuffle bytes,
+    in the plan-field vocabulary (same keys ``plan_byte_signature`` keeps),
+    so the conf-derived and plan-derived views of "what shapes the bytes"
+    cannot diverge silently."""
+    return json.dumps(
+        {
+            "codec": conf.wire_compress_codec,
+            "combine": bool(conf.exchange_fused_combine),
+            "quantize_block": int(conf.quantize_block_size),
+            "quantize_mode": conf.quantize_mode,
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+
+
+def plan_byte_signature(plan) -> str:
+    """The byte-affecting view of a concrete ExchangePlan: two plans
+    differing only in schedule or serve-plane fields sign identically."""
+    return canonical_plan(plan, BYTE_AFFECTING_PLAN_FIELDS)
+
+
+def fingerprint_rows(payload: bytes) -> str:
+    """Content hash of a scan's serialized rows (the input fingerprint)."""
+    return hashlib.sha256(payload).hexdigest()
+
+
+def lineage_key(dag, root: str, fingerprints: Dict[str, str], conf) -> str:
+    """The cache key for the exchange stage ``root``: sub-DAG identity plus
+    the byte-affecting conf tiers."""
+    return lineage_hash(dag.canonical(root, fingerprints), conf_byte_signature(conf))
+
+
+@dataclass
+class CacheEntry:
+    """One retained sealed shuffle."""
+
+    app_id: str
+    key: str  #: lineage hash (hex)
+    shuffle_id: int
+    nbytes: int  #: serialized map-output footprint charged to the tenant
+    structure_sig: str  #: fingerprint-free canonical sub-DAG (staleness probe)
+    hits: int = 0
+
+
+class LineageCache:
+    """App-namespaced lineage-key -> sealed-shuffle map with admission
+    counters.  A leaf lock (no calls out under it): eviction DECISIONS are
+    returned to the caller, which tears the doomed shuffles down through the
+    manager (so the store/serve/encoded-chunk tiers all drop them) and then
+    confirms with :meth:`invalidate_shuffle`."""
+
+    def __init__(self, max_bytes: int = 0) -> None:
+        self.max_bytes = int(max_bytes)  #: 0 = no runner-level cap
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], CacheEntry] = {}
+        self._by_sid: Dict[int, Tuple[str, str]] = {}
+        self._attached: set = set()  #: id() of managers whose hook we hold
+        self.hits = 0
+        self.misses = 0
+        self.admissions = 0
+        self.invalidations = 0
+        self.evictions = 0
+
+    def attach(self, manager) -> None:
+        """Subscribe to the manager's shuffle teardown exactly once, so ANY
+        ``unregister_shuffle`` — ours or an external caller's — invalidates
+        the entry before a stale hit can be served."""
+        with self._lock:
+            if id(manager) in self._attached:
+                return
+            self._attached.add(id(manager))
+        manager.add_unregister_hook(self.invalidate_shuffle)
+
+    def lookup(self, app_id: str, key: str) -> Optional[CacheEntry]:
+        with self._lock:
+            e = self._entries.get((app_id, key))
+            if e is None:
+                self.misses += 1
+                return None
+            e.hits += 1
+            self.hits += 1
+            return e
+
+    def admit(
+        self, app_id: str, key: str, shuffle_id: int, nbytes: int, structure_sig: str
+    ) -> CacheEntry:
+        e = CacheEntry(app_id, key, shuffle_id, int(nbytes), structure_sig)
+        with self._lock:
+            self._entries[(app_id, key)] = e
+            self._by_sid[shuffle_id] = (app_id, key)
+            self.admissions += 1
+        return e
+
+    def invalidate_shuffle(self, shuffle_id: int) -> Optional[CacheEntry]:
+        """Drop the entry holding ``shuffle_id`` (manager unregister hook)."""
+        with self._lock:
+            k = self._by_sid.pop(shuffle_id, None)
+            if k is None:
+                return None
+            e = self._entries.pop(k, None)
+            if e is not None:
+                self.invalidations += 1
+            return e
+
+    def stale_entries(self, app_id: str, structure_sig: str, current_key: str) -> List[CacheEntry]:
+        """Entries for the same query structure whose lineage key differs —
+        the input fingerprint (or a byte tier) changed, so they will never
+        hit again.  The caller unregisters their shuffles (which confirms the
+        invalidation through the teardown hook) and releases the tenant."""
+        with self._lock:
+            return [
+                e
+                for e in self._entries.values()
+                if e.app_id == app_id and e.structure_sig == structure_sig and e.key != current_key
+            ]
+
+    def plan_eviction(self, needed: int, protect: Tuple[str, str] = ("", "")) -> List[CacheEntry]:
+        """Keep/recompute decision under pressure: pick entries to recompute
+        (= evict) until ``needed`` bytes free, LARGEST footprint first —
+        the arXiv:2112.01075 cost model says footprint approximates restage
+        cost, so per-byte the small popular entries are worth keeping.  Ties
+        break toward fewer hits, then key order (determinism)."""
+        with self._lock:
+            candidates = [
+                e for e in self._entries.values() if (e.app_id, e.key) != protect
+            ]
+        candidates.sort(key=lambda e: (-e.nbytes, e.hits, e.key))
+        doomed: List[CacheEntry] = []
+        freed = 0
+        for e in candidates:
+            if freed >= needed:
+                break
+            doomed.append(e)
+            freed += e.nbytes
+        return doomed
+
+    def note_eviction(self, n: int = 1) -> None:
+        with self._lock:
+            self.evictions += n
+
+    def cached_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "cache_hits": self.hits,
+                "cache_misses": self.misses,
+                "cache_admissions": self.admissions,
+                "cache_invalidations": self.invalidations,
+                "cache_evictions": self.evictions,
+                "cached_entries": len(self._entries),
+                "cached_bytes": sum(e.nbytes for e in self._entries.values()),
+            }

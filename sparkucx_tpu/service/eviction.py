@@ -173,6 +173,15 @@ class EvictionManager:
         if restage and self._store.round_tier(shuffle_id, round_idx) == "disk":
             self.restage(shuffle_id, round_idx)
 
+    def forget_shuffle(self, shuffle_id: int) -> None:
+        """Store hook on ``remove_shuffle``: drop the shuffle's LRU-clock
+        entries so the access table can't grow monotonically across shuffle
+        lifetimes (and a recycled shuffle id can't inherit the old id's
+        recency, surviving demotion sweeps it should lose)."""
+        with self._lock:
+            for key in [k for k in self._access if k[0] == shuffle_id]:
+                del self._access[key]
+
     def restage(self, shuffle_id: int, round_idx: int) -> bool:
         """Promote one round disk -> host, timed into ``eviction.restage``.
         Raises TenantQuotaExceededError when the owning tenant has no quota

@@ -56,6 +56,7 @@ class TpuShuffleManager:
         self._shuffle_dims: Dict[int, tuple] = {}
         self._lock = threading.Lock()
         self._stopped = False
+        self._unregister_hooks: List[Callable[[int], None]] = []
 
     @property
     def num_executors(self) -> int:
@@ -161,15 +162,25 @@ class TpuShuffleManager:
             merge_combiners=merge_combiners,
         )
 
+    def add_unregister_hook(self, fn: Callable[[int], None]) -> None:
+        """Subscribe to shuffle teardown.  Hooks fire after the store tiers
+        dropped the shuffle, so a subscriber (the query lineage cache) observing
+        the callback can trust that no tier can still serve those blocks."""
+        with self._lock:
+            self._unregister_hooks.append(fn)
+
     def unregister_shuffle(self, shuffle_id: int) -> None:
         """unregisterShuffle -> resolver.removeShuffle
         (CommonUcxShuffleManager.scala:103-106)."""
         with self._lock:
             self._shuffle_dims.pop(shuffle_id, None)
+            hooks = list(self._unregister_hooks)
         for resolver in self.resolvers:
             resolver.remove_shuffle(shuffle_id)
         # cluster-level metadata (store shuffles were removed via resolvers)
         self.cluster.drop_meta(shuffle_id)
+        for fn in hooks:
+            fn(shuffle_id)
 
     def stop(self) -> None:
         """stop() closes transports/resolvers (CommonUcxShuffleManager.scala:111-124)."""

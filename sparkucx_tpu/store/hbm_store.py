@@ -675,6 +675,10 @@ class HbmBlockStore:
         # under self._lock.
         if self.serve_cache is not None:
             self.serve_cache.invalidate_shuffle(shuffle_id)
+        if self.eviction is not None:
+            # the LRU access table must not outlive the shuffle: recycled ids
+            # (lineage-cache recomputes) would inherit stale recency
+            self.eviction.forget_shuffle(shuffle_id)
 
     def close(self) -> None:
         with self._lock:

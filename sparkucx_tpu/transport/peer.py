@@ -570,6 +570,26 @@ class BlockServer:
         with self._compress_lock:
             return dict(self.compress_stats)
 
+    def drop_shuffle_chunks(self, shuffle_id: int) -> int:
+        """Purge the shuffle's cached encodings from the encoded-chunk pool.
+
+        The pool's safety argument is that sealed blocks are immutable for
+        the life of their shuffle id — so when the id is unregistered (and a
+        later shuffle, or a recomputed lineage-cache round, may legitimately
+        reuse it) every cached encoding keyed by that id must go, or a serve
+        thread could ship stale bytes for a fresh block.  Returns the number
+        of chunks dropped."""
+        with self._compress_lock:
+            doomed = [
+                k for k in self._encoded_pool
+                if isinstance(k[0], ShuffleBlockId) and k[0].shuffle_id == shuffle_id
+            ]
+            for k in doomed:
+                _, enc = self._encoded_pool.pop(k)
+                if enc is not None:
+                    self._encoded_pool_bytes -= len(enc)
+            return len(doomed)
+
     def _accept_loop(self) -> None:
         while self._running:
             try:
@@ -2192,14 +2212,11 @@ class PeerTransport(ShuffleTransport):
         with self._tag_lock:
             return {sid: list(h) for sid, h in self._hot_shuffles.items()}
 
-    #: reader-side advertisement freshness: one HOT_SET_PULL round-trip per
-    #: primary at most every TTL, amortized over every fetch in between
-    _HOT_SET_TTL_S = 0.25
-
     def hot_holders(self, executor_id: ExecutorId, shuffle_id: int) -> List[ExecutorId]:
         """Current holder set the primary advertises for a hot shuffle, or
         ``[]`` when nothing is advertised (cold shuffle / tier off).  Served
-        from a short TTL cache so readers learn widened sets without a
+        from a TTL cache (``spark.shuffle.tpu.serve.holdersTtlMs``; 0 =
+        re-pull every fetch) so readers learn widened sets without a
         round-trip per fetch; pull failures are non-fatal (an empty table is
         cached, and the reader just keeps fetching from the primary)."""
         if self.conf.serve_hot_threshold_fetches_per_sec <= 0:
@@ -2207,7 +2224,8 @@ class PeerTransport(ShuffleTransport):
         now = time.monotonic()
         with self._tag_lock:
             cached = self._hot_holders_cache.get(executor_id)
-        if cached is not None and now - cached[0] < self._HOT_SET_TTL_S:
+        ttl_s = self.conf.serve_holders_ttl_ms / 1e3
+        if cached is not None and now - cached[0] < ttl_s:
             return list(cached[1].get(shuffle_id, []))
         try:
             table = unpack_hot_set(
@@ -2485,6 +2503,11 @@ class PeerTransport(ShuffleTransport):
             blocks = [self._registry.pop(b) for b in doomed]
         for block in blocks:
             block.close()
+        if self.server is not None:
+            # no tier may serve a stale hit after removal: the decoded-block
+            # ServeCache drops via store.remove_shuffle below, the encoded-
+            # chunk pool must drop here (same shuffle-id immutability scope)
+            self.server.drop_shuffle_chunks(shuffle_id)
         self.store.remove_shuffle(shuffle_id)
 
     def registered_block(self, block_id: BlockId) -> Optional[Block]:

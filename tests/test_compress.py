@@ -359,6 +359,38 @@ class TestEncodedPool:
             a.close()
             b.close()
 
+    def test_unregister_purges_pool_no_stale_serve(self):
+        """unregister_shuffle must drop the shuffle's cached encodings: a
+        recycled shuffle id (the lineage cache recomputes under the same id
+        space) with DIFFERENT bytes must never be served the old encoding."""
+        a, b = _pair(wire_compress_codec="rle")
+        try:
+            keep = ShuffleBlockId(7, 0, 0)
+            doomed = ShuffleBlockId(0, 0, 0)
+            old = bytes([1]) * (64 << 10)
+            other = bytes([2]) * (64 << 10)
+            b.register(doomed, BytesBlock(old))
+            b.register(keep, BytesBlock(other))
+            assert _fetch(a, [doomed, keep], [len(old), len(other)]) == [old, other]
+            assert any(k[0].shuffle_id == 0 for k in b.server._encoded_pool)
+
+            b.unregister_shuffle(0)
+            # shuffle 0's encodings are gone, shuffle 7's survive, and the
+            # byte accounting stayed exact
+            assert not any(k[0].shuffle_id == 0 for k in b.server._encoded_pool)
+            assert any(k[0].shuffle_id == 7 for k in b.server._encoded_pool)
+            assert b.server._encoded_pool_bytes == sum(
+                len(enc) for _, enc in b.server._encoded_pool.values() if enc
+            )
+
+            # same id, fresh bytes: the serve path re-encodes, no stale hit
+            fresh = bytes([3]) * (64 << 10)
+            b.register(doomed, BytesBlock(fresh))
+            assert _fetch(a, [doomed], [len(fresh)]) == [fresh]
+        finally:
+            a.close()
+            b.close()
+
 
 class TestCompressedReader:
     @pytest.mark.parametrize("codec", ["rle", "dict"])

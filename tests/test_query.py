@@ -1,0 +1,449 @@
+"""Query DAG runner + lineage-keyed cross-query shuffle reuse (sparkucx_tpu/query).
+
+Three concerns:
+
+* the runner composes the existing manager SPI into whole pipelines whose
+  results match the pure-CPU oracles (groupby / terasort / join shapes),
+* the lineage hash keys exactly the byte-affecting tiers — property tests
+  cross-checked against the analyzer's COLLECTIVE/SERVE_PLANE registries so
+  the two views of "what shapes the bytes" cannot drift,
+* the cache lifecycle: hits are bit-identical and skip the exchange, entries
+  die on input-fingerprint change or ``unregister_shuffle`` (every serve tier
+  included), admission charges the owning tenant, and quota pressure
+  recomputes largest-footprint entries first (arXiv:2112.01075).
+"""
+
+import dataclasses
+
+import pytest
+
+from sparkucx_tpu.analysis.config import COLLECTIVE_FIELDS, SERVE_PLANE_FIELDS
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.ops.skew import ExchangePlan
+from sparkucx_tpu.query import (
+    BYTE_AFFECTING_PLAN_FIELDS,
+    SCHEDULE_ONLY_PLAN_FIELDS,
+    SERVE_ONLY_PLAN_FIELDS,
+    LineageCache,
+    QueryRunner,
+    Stage,
+    StageDag,
+    conf_byte_signature,
+    lineage_key,
+    plan_byte_signature,
+)
+from sparkucx_tpu.service.eviction import EvictionManager
+from sparkucx_tpu.service.tenants import TenantRegistry
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+N_EXEC = 4
+
+
+def _conf(**kw):
+    kw.setdefault("staging_capacity_per_executor", 1 << 20)
+    kw.setdefault("num_executors", N_EXEC)
+    return TpuShuffleConf(**kw)
+
+
+def _groupby_dag():
+    return StageDag(
+        [
+            Stage.make("src", "scan"),
+            Stage.make("ex", "exchange", ["src"]),
+            Stage.make("agg", "aggregate", ["ex"]),
+        ]
+    )
+
+
+def _rows(n=600, keys=40, salt=0):
+    return [(i % keys, i + salt) for i in range(n)]
+
+
+def _sum_oracle(rows):
+    out = {}
+    for k, v in rows:
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# StageDag
+# ---------------------------------------------------------------------------
+
+
+class TestStageDag:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="empty"):
+            StageDag([])
+        with pytest.raises(ValueError, match="unknown op"):
+            StageDag([Stage.make("a", "scan"), Stage.make("b", "mapreduce", ["a"])])
+        with pytest.raises(ValueError, match="duplicate"):
+            StageDag([Stage.make("a", "scan"), Stage.make("a", "scan")])
+        with pytest.raises(ValueError, match="undefined"):
+            StageDag([Stage.make("e", "exchange", ["ghost"])])
+        with pytest.raises(ValueError, match="takes 2 input"):
+            StageDag([Stage.make("a", "scan"), Stage.make("j", "join", ["a"])])
+        with pytest.raises(ValueError, match="takes 0 input"):
+            StageDag([Stage.make("a", "scan"), Stage.make("b", "scan", ["a"])])
+
+    def test_canonical_is_deterministic_and_scoped(self):
+        dag = StageDag(
+            [
+                Stage.make("b", "scan"),
+                Stage.make("p", "scan"),
+                Stage.make("eb", "exchange", ["b"]),
+                Stage.make("ep", "exchange", ["p"]),
+                Stage.make("j", "join", ["eb", "ep"]),
+            ]
+        )
+        assert dag.canonical("eb") == dag.canonical("eb")
+        # the sub-DAG rooted at eb does not include the probe side
+        assert '"p"' not in dag.canonical("eb")
+        assert '"p"' in dag.canonical("j")
+        # scan fingerprints enter the serialization (and only under the root)
+        fps = {"b": "aa", "p": "bb"}
+        assert dag.canonical("eb", fps) != dag.canonical("eb")
+        assert "bb" not in dag.canonical("eb", fps)
+
+    def test_params_affect_canonical(self):
+        d1 = StageDag([Stage.make("s", "scan"), Stage.make("e", "exchange", ["s"])])
+        d2 = StageDag(
+            [Stage.make("s", "scan"), Stage.make("e", "exchange", ["s"], partitions=2)]
+        )
+        assert d1.canonical("e") != d2.canonical("e")
+
+
+# ---------------------------------------------------------------------------
+# lineage hash property tests (cross-checked vs the analyzer registries)
+# ---------------------------------------------------------------------------
+
+#: conf knob -> value that flips each byte-affecting tier
+_BYTE_TIER_CONFS = {
+    "wire_compress_codec": "rle",  # spark.shuffle.tpu.compress.codec
+    "quantize_mode": "int8",  # spark.shuffle.tpu.quantize.mode
+    "quantize_block_size": 64,  # spark.shuffle.tpu.quantize.blockSize
+    "exchange_fused_combine": True,  # spark.shuffle.tpu.exchange.fusedCombine
+}
+
+#: serve-plane-only knobs: tune serving/overlap, never the bytes
+_SERVE_TIER_CONFS = {
+    "fetch_hedge_ms": 7,  # spark.shuffle.tpu.fetch.hedgeMs
+    "wire_streams": 4,  # spark.shuffle.tpu.wire.streams
+    "pipeline_depth": 5,  # spark.shuffle.tpu.pipelineDepth
+}
+
+
+class TestLineageRegistryAlignment:
+    """The partition of ExchangePlan fields used by the lineage key must stay
+    exactly the analyzer's COLLECTIVE/SERVE_PLANE vocabulary — a new plan
+    field, or a field moving between registries, fails here."""
+
+    def test_partition_is_total_and_disjoint(self):
+        plan_fields = {f.name for f in dataclasses.fields(ExchangePlan)}
+        byte, sched, serve = (
+            set(BYTE_AFFECTING_PLAN_FIELDS),
+            set(SCHEDULE_ONLY_PLAN_FIELDS),
+            set(SERVE_ONLY_PLAN_FIELDS),
+        )
+        assert byte | sched | serve == plan_fields
+        assert not (byte & sched) and not (byte & serve) and not (sched & serve)
+
+    def test_derived_from_analyzer_registries(self):
+        assert set(SCHEDULE_ONLY_PLAN_FIELDS) <= set(COLLECTIVE_FIELDS)
+        assert set(SERVE_ONLY_PLAN_FIELDS) <= set(SERVE_PLANE_FIELDS)
+        assert set(BYTE_AFFECTING_PLAN_FIELDS) <= set(COLLECTIVE_FIELDS) | set(
+            SERVE_PLANE_FIELDS
+        )
+        # the byte tiers are exactly the lossy/content fields the ISSUE names
+        assert set(BYTE_AFFECTING_PLAN_FIELDS) == {
+            "codec",
+            "quantize_mode",
+            "quantize_block",
+            "combine",
+        }
+
+    def test_conf_signature_speaks_plan_vocabulary(self):
+        import json
+
+        assert set(json.loads(conf_byte_signature(_conf()))) == set(
+            BYTE_AFFECTING_PLAN_FIELDS
+        )
+
+
+class TestLineageKeyProperties:
+    def setup_method(self):
+        self.dag = _groupby_dag()
+        self.fps = {"src": "f" * 64}
+
+    def _key(self, conf):
+        return lineage_key(self.dag, "ex", self.fps, conf)
+
+    @pytest.mark.parametrize("field,value", sorted(_BYTE_TIER_CONFS.items()))
+    def test_byte_affecting_tiers_change_the_key(self, field, value):
+        base = self._key(_conf())
+        assert self._key(_conf(**{field: value})) != base
+
+    @pytest.mark.parametrize("field,value", sorted(_SERVE_TIER_CONFS.items()))
+    def test_serve_plane_tiers_do_not(self, field, value):
+        base = self._key(_conf())
+        assert self._key(_conf(**{field: value})) == base
+
+    def test_fingerprint_and_structure_change_the_key(self):
+        conf = _conf()
+        base = self._key(conf)
+        assert lineage_key(self.dag, "ex", {"src": "0" * 64}, conf) != base
+        wider = StageDag(
+            [
+                Stage.make("src", "scan"),
+                Stage.make("ex", "exchange", ["src"], partitions=2),
+            ]
+        )
+        assert lineage_key(wider, "ex", self.fps, conf) != base
+
+    def test_plan_byte_signature_ignores_schedule_and_serve_fields(self):
+        base = ExchangePlan(slot_rows=64, chunks_per_round=(2, 2))
+        sig = plan_byte_signature(base)
+        for variant in (
+            dataclasses.replace(base, slot_rows=128),
+            dataclasses.replace(base, chunks_per_round=(4,)),
+            dataclasses.replace(base, single_shot=True),
+            dataclasses.replace(base, round_order=(1, 0)),
+            dataclasses.replace(base, lowering="pallas"),
+            dataclasses.replace(base, pipeline_depth=7),
+            dataclasses.replace(base, streams=8),
+            dataclasses.replace(base, hedge_ms=11),
+        ):
+            assert plan_byte_signature(variant) == sig
+        for variant in (
+            dataclasses.replace(base, codec="rle"),
+            dataclasses.replace(base, quantize_mode="int8"),
+            dataclasses.replace(base, quantize_block=32),
+            dataclasses.replace(base, combine="dense"),
+        ):
+            assert plan_byte_signature(variant) != sig
+
+
+# ---------------------------------------------------------------------------
+# runner pipelines + cache lifecycle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def cached_manager():
+    mgr = TpuShuffleManager(_conf(query_cache_enabled=True), num_executors=N_EXEC)
+    yield mgr
+    mgr.stop()
+
+
+class TestQueryRunner:
+    def test_groupby_pipeline_and_reuse(self, cached_manager):
+        runner = QueryRunner(cached_manager, "appA")
+        dag, rows = _groupby_dag(), _rows()
+        cold = runner.run(dag, {"src": rows})
+        assert {k: v for part in cold for k, v in part} == _sum_oracle(rows)
+        warm = runner.run(dag, {"src": rows})
+        # the hit is bit-identical AND skipped the exchange entirely
+        assert warm == cold
+        snap = runner._snapshot()
+        assert snap["exchanges_executed"] == 1
+        assert snap["exchanges_reused"] == 1
+        assert snap["cache_hits"] == 1
+
+    def test_terasort_pipeline(self, cached_manager, rng):
+        runner = QueryRunner(cached_manager, "appSort")
+        dag = StageDag(
+            [
+                Stage.make("s", "scan"),
+                Stage.make("e", "exchange", ["s"]),
+                Stage.make("o", "sort", ["e"]),
+            ]
+        )
+        rows = [(int(k), i) for i, k in enumerate(rng.integers(0, 1 << 20, 500))]
+        out = runner.run(dag, {"s": rows})
+        assert [k for k, _ in out] == sorted(k for k, _ in rows)
+        # same keys AND payloads survive the shuffle
+        assert sorted(out) == sorted((k, v) for k, v in rows)
+
+    def test_join_pipeline(self, cached_manager):
+        runner = QueryRunner(cached_manager, "appJoin")
+        dag = StageDag(
+            [
+                Stage.make("b", "scan"),
+                Stage.make("p", "scan"),
+                Stage.make("eb", "exchange", ["b"]),
+                Stage.make("ep", "exchange", ["p"]),
+                Stage.make("j", "join", ["eb", "ep"]),
+            ]
+        )
+        build = [(i % 10, i) for i in range(30)]
+        probe = [(i % 10, 100 + i) for i in range(20)]
+        out = runner.run(dag, {"b": build, "p": probe})
+        got = sorted(row for part in out for row in part)
+        oracle = sorted(
+            (k, bv, pv) for k, bv in build for pk, pv in probe if pk == k
+        )
+        assert got == oracle
+
+    def test_shared_exchange_reused_across_dags(self, cached_manager):
+        """Two different queries over the same scan+exchange sub-DAG share
+        one sealed shuffle — the cross-QUERY in cross-query reuse."""
+        runner = QueryRunner(cached_manager, "appX")
+        rows = _rows()
+        agg = _groupby_dag()
+        srt = StageDag(
+            [
+                Stage.make("src", "scan"),
+                Stage.make("ex", "exchange", ["src"]),
+                Stage.make("out", "sort", ["ex"]),
+            ]
+        )
+        runner.run(agg, {"src": rows})
+        runner.run(srt, {"src": rows})
+        snap = runner._snapshot()
+        assert snap["exchanges_executed"] == 1 and snap["exchanges_reused"] == 1
+
+    def test_input_change_invalidates_stale_entry(self, cached_manager):
+        cache = LineageCache()
+        runner = QueryRunner(cached_manager, "appB", cache=cache)
+        dag = _groupby_dag()
+        runner.run(dag, {"src": _rows(salt=0)})
+        runner.run(dag, {"src": _rows(salt=1)})
+        snap = cache.snapshot()
+        # the first entry could never hit again: dropped, not leaked
+        assert snap["cache_invalidations"] == 1
+        assert snap["cached_entries"] == 1
+        assert runner._snapshot()["stale_invalidations"] == 1
+
+    def test_external_unregister_invalidates(self, cached_manager):
+        cache = LineageCache()
+        runner = QueryRunner(cached_manager, "appC", cache=cache)
+        dag, rows = _groupby_dag(), _rows()
+        cold = runner.run(dag, {"src": rows})
+        (sid,) = list(cache._by_sid)
+        cached_manager.unregister_shuffle(sid)  # external removal
+        assert cache.snapshot()["cached_entries"] == 0
+        again = runner.run(dag, {"src": rows})  # re-executes, same bytes
+        assert again == cold
+        assert runner._snapshot()["exchanges_executed"] == 2
+
+    def test_admission_charges_tenant_and_pressure_evicts_largest(
+        self, cached_manager
+    ):
+        cache = LineageCache()
+        tenants = TenantRegistry(default_quota_bytes=0)
+        runner = QueryRunner(cached_manager, "appQ", tenants=tenants, cache=cache)
+        big, small = _rows(n=800), _rows(n=100, keys=7)
+        dag_big = StageDag(
+            [Stage.make("big", "scan"), Stage.make("exb", "exchange", ["big"])]
+        )
+        dag_small = StageDag(
+            [Stage.make("small", "scan"), Stage.make("exs", "exchange", ["small"])]
+        )
+        runner.run(dag_big, {"big": big})
+        runner.run(dag_small, {"small": small})
+        entries = sorted(cache._entries.values(), key=lambda e: e.nbytes)
+        assert len(entries) == 2
+        assert tenants.usage("appQ") == sum(e.nbytes for e in entries)
+        # shrink the quota so the next admission must free bytes: the
+        # LARGEST resident is recomputed first (arXiv:2112.01075 footprint
+        # model), the small one stays
+        small_entry, big_entry = entries
+        tenants.register("appQ", hbm_quota_bytes=tenants.usage("appQ") + 1)
+        dag_mid = StageDag(
+            [Stage.make("mid", "scan"), Stage.make("exm", "exchange", ["mid"])]
+        )
+        runner.run(dag_mid, {"mid": _rows(n=400, keys=11)})
+        keys_left = set(cache._entries)
+        assert ("appQ", big_entry.key) not in keys_left  # largest evicted
+        assert ("appQ", small_entry.key) in keys_left  # smallest kept
+        assert cache.snapshot()["cache_evictions"] >= 1
+        # charge/release stayed balanced through the eviction
+        assert tenants.usage("appQ") == sum(e.nbytes for e in cache._entries.values())
+
+    def test_unadmittable_round_runs_uncached(self, cached_manager):
+        cache = LineageCache(max_bytes=1)  # spark.shuffle.tpu.query.cacheMaxBytes
+        runner = QueryRunner(cached_manager, "appU", cache=cache)
+        dag, rows = _groupby_dag(), _rows()
+        out = runner.run(dag, {"src": rows})
+        assert {k: v for part in out for k, v in part} == _sum_oracle(rows)
+        snap = runner._snapshot()
+        assert snap["uncached_rounds"] == 1 and snap["cached_entries"] == 0
+
+    def test_query_metrics_family_exported(self, cached_manager):
+        runner = QueryRunner(cached_manager, "appM")
+        runner.run(_groupby_dag(), {"src": _rows()})
+        fams = {s.family for s in cached_manager.cluster.metrics.snapshot()}
+        assert "query" in fams
+        names = {
+            s.name
+            for s in cached_manager.cluster.metrics.snapshot()
+            if s.family == "query"
+        }
+        assert {"queries", "cache_hits", "cache_misses", "cached_bytes"} <= names
+
+
+class TestOffPath:
+    def test_cache_disabled_is_cacheless_and_clean(self):
+        mgr = TpuShuffleManager(_conf(), num_executors=N_EXEC)
+        try:
+            assert mgr.conf.query_cache_enabled is False  # default off
+            runner = QueryRunner(mgr, "appOff")
+            dag, rows = _groupby_dag(), _rows()
+            out1 = runner.run(dag, {"src": rows})
+            out2 = runner.run(dag, {"src": rows})
+            assert out1 == out2
+            assert {k: v for part in out1 for k, v in part} == _sum_oracle(rows)
+            snap = runner._snapshot()
+            # every exchange executed; nothing cached, retained, or charged
+            assert snap["exchanges_executed"] == 2
+            assert snap["exchanges_reused"] == 0
+            assert "cache_hits" not in snap
+            assert not mgr._shuffle_dims
+        finally:
+            mgr.stop()
+
+    def test_conf_knobs_parse_and_validate(self):
+        conf = TpuShuffleConf.from_spark_conf(
+            {
+                "spark.shuffle.tpu.query.cacheEnabled": "true",
+                "spark.shuffle.tpu.query.cacheMaxBytes": "64m",
+            }
+        )
+        assert conf.query_cache_enabled is True
+        assert conf.query_cache_max_bytes == 64 << 20
+        with pytest.raises(ValueError, match="query_cache_max_bytes"):
+            TpuShuffleConf(query_cache_max_bytes=-1).validate()
+
+
+# ---------------------------------------------------------------------------
+# no-stale-tier invalidation: the eviction access table (store side)
+# ---------------------------------------------------------------------------
+
+
+class TestEvictionForgetShuffle:
+    def test_forget_shuffle_prunes_access_table(self):
+        ev = EvictionManager(store=None, restage_on_fetch=False)
+        ev.on_access(5, 0)
+        ev.on_access(5, 1)
+        ev.on_access(6, 0)
+        ev.forget_shuffle(5)
+        assert set(ev._access) == {(6, 0)}
+
+    def test_store_remove_shuffle_forgets(self):
+        mgr = TpuShuffleManager(_conf(), num_executors=N_EXEC)
+        try:
+            store = mgr.cluster.transports[0].store
+            ev = EvictionManager(store=store, restage_on_fetch=False)
+            store.eviction = ev
+            runner = QueryRunner(mgr, "appEv")
+            dag = StageDag(
+                [Stage.make("s", "scan"), Stage.make("e", "exchange", ["s"])]
+            )
+            runner.run(dag, {"s": _rows(n=200)})
+            ev.on_access(99, 0)  # unrelated shuffle keeps its clock
+            with_reads = [sid for sid, _ in ev._access]
+            # the runner's off-path teardown removed its shuffles from the
+            # store — and the store told the eviction manager
+            assert set(with_reads) == {99}
+        finally:
+            mgr.stop()

@@ -115,22 +115,26 @@ class TestServeKnobs:
                 "spark.shuffle.tpu.serve.hotThresholdFetchesPerSec": "25",
                 "spark.shuffle.tpu.serve.hotReplicas": "3",
                 "spark.shuffle.tpu.serve.cacheBytes": "4m",
+                "spark.shuffle.tpu.serve.holdersTtlMs": "100",
                 "spark.shuffle.tpu.compress.cacheBytes": "2m",
             }
         )
         assert conf.serve_hot_threshold_fetches_per_sec == 25.0
         assert conf.serve_hot_replicas == 3
         assert conf.serve_cache_bytes == 4 << 20
+        assert conf.serve_holders_ttl_ms == 100
         assert conf.compress_cache_bytes == 2 << 20
 
     def test_defaults_are_off(self):
         """Threshold 0 = no tracker, no HOT_SET_PULL traffic, no serve cache;
-        the compress pool cap keeps its historical 128 MiB default."""
+        the compress pool cap keeps its historical 128 MiB default, the
+        holder-set TTL its historical 250 ms."""
         conf = TpuShuffleConf()
         assert conf.serve_hot_threshold_fetches_per_sec == 0.0
         assert conf.serve_cache_bytes == 0
         assert conf.compress_cache_bytes == 128 << 20
         assert conf.serve_hot_replicas == 4  # inert while the threshold is 0
+        assert conf.serve_holders_ttl_ms == 250  # inert while the threshold is 0
 
     def test_validation_rejects_negative(self):
         with pytest.raises(ValueError):
@@ -139,6 +143,36 @@ class TestServeKnobs:
             TpuShuffleConf(serve_cache_bytes=-1).validate()
         with pytest.raises(ValueError):
             TpuShuffleConf(compress_cache_bytes=-1).validate()
+        with pytest.raises(ValueError):
+            TpuShuffleConf(serve_holders_ttl_ms=-1).validate()
+
+    def test_holders_ttl_governs_pull_rate(self, monkeypatch):
+        """The hot_holders cache honors ``serve.holdersTtlMs``: a long TTL
+        serves the cached table without a HOT_SET_PULL round-trip; TTL 0
+        means every call re-pulls (the freshest-possible setting)."""
+        ts = _cluster(
+            2, serve_hot_threshold_fetches_per_sec=5.0, serve_holders_ttl_ms=60_000
+        )
+        try:
+            pulls = []
+            real_pull = ts[1]._pull
+
+            def counting_pull(eid, am_id, timeout=1.0):
+                if am_id == AmId.HOT_SET_PULL:
+                    pulls.append(eid)
+                return real_pull(eid, am_id, timeout=timeout)
+
+            monkeypatch.setattr(ts[1], "_pull", counting_pull)
+            ts[1].hot_holders(0, 0)
+            ts[1].hot_holders(0, 0)
+            assert len(pulls) == 1  # second call inside the TTL: cached
+
+            ts[1].conf.serve_holders_ttl_ms = 0
+            ts[1].hot_holders(0, 0)
+            ts[1].hot_holders(0, 0)
+            assert len(pulls) == 3  # TTL 0: every call round-trips
+        finally:
+            _close_all(ts)
 
     def test_default_transport_has_no_popularity_plane(self):
         ts = _cluster(1)
@@ -621,7 +655,7 @@ class TestPopularityLifecycle:
             assert ts[0]._serve_view()["advertised_hot_shuffles"] == 0
 
             # past the reader-side TTL the advertisement is gone...
-            time.sleep(PeerTransport._HOT_SET_TTL_S + 0.1)
+            time.sleep(ts[3].conf.serve_holders_ttl_ms / 1e3 + 0.1)
             assert ts[3].hot_holders(0, 0) == []
             # ...but the widened replicas persist (never below the floor),
             # and the primary still serves the block bit-identically
